@@ -1,0 +1,183 @@
+"""Runtime lifecycle and identity API.
+
+Reference: horovod/common/basics.py — HorovodBasics (init/shutdown/rank/size/
+local_rank/..., built-with queries; SURVEY.md §2.4).  Where the reference
+loads a per-framework shared library over ctypes, this module drives the
+TPU-native core (native C++ when built, pure-Python local core otherwise)
+and additionally owns the global device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from .context import HorovodContext
+from .utils.env import Config, get_bool
+from .utils.logging import get_logger
+from .parallel import mesh as _mesh
+
+log = get_logger()
+
+
+def init(comm=None, process_sets: Optional[Sequence] = None,
+         config: Optional[Config] = None, build_mesh: bool = True) -> None:
+    """Initialize Horovod.
+
+    ``comm`` exists for signature parity with the reference (an MPI
+    communicator there); passing a list of ranks restricts the world like a
+    root communicator split would.  ``process_sets`` pre-registers process
+    sets exactly like the reference's ``hvd.init(process_sets=...)``.
+    """
+    if HorovodContext.initialized():
+        return
+    cfg = config or Config.from_env()
+    if comm is not None and not isinstance(comm, (list, tuple)):
+        raise ValueError(
+            "comm must be None or a list of ranks; MPI communicators do not "
+            "exist in the TPU build"
+        )
+    ctx = HorovodContext.init(cfg)
+
+    # Optional multi-host JAX runtime wiring (TPU pods): the launcher sets
+    # HOROVOD_JAX_DISTRIBUTED=1 plus coordinator env; analogous to how the
+    # reference's launcher passes rendezvous env to Gloo (SURVEY.md §3.4).
+    if get_bool("HOROVOD_JAX_DISTRIBUTED", False):  # pragma: no cover - pod only
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=os.environ.get("HOROVOD_JAX_COORDINATOR"),
+            num_processes=cfg.size,
+            process_id=cfg.rank,
+        )
+
+    if build_mesh:
+        try:
+            _mesh.build_global_mesh()
+        except Exception as exc:  # jax may be unusable in exotic setups
+            log.debug("global mesh not built: %s", exc)
+
+    if process_sets:
+        from .process_sets import add_process_set
+
+        for ps in process_sets:
+            add_process_set(ps)
+
+
+def shutdown() -> None:
+    HorovodContext.shutdown()
+    _mesh.reset()
+
+
+def is_initialized() -> bool:
+    return HorovodContext.initialized()
+
+
+def initialized() -> bool:  # reference alias
+    return HorovodContext.initialized()
+
+
+def rank() -> int:
+    return HorovodContext.instance().core.rank()
+
+
+def size() -> int:
+    return HorovodContext.instance().core.size()
+
+
+def local_rank() -> int:
+    return HorovodContext.instance().cfg.local_rank
+
+
+def local_size() -> int:
+    return HorovodContext.instance().cfg.local_size
+
+
+def cross_rank() -> int:
+    return HorovodContext.instance().cfg.cross_rank
+
+
+def cross_size() -> int:
+    return HorovodContext.instance().cfg.cross_size
+
+
+def is_homogeneous() -> bool:
+    """True if every host runs the same number of ranks."""
+    ctx = HorovodContext.instance()
+    return ctx.cfg.size % max(ctx.cfg.local_size, 1) == 0
+
+
+def num_devices() -> int:
+    """Local JAX device count (TPU-build extension)."""
+    import jax
+
+    return jax.local_device_count()
+
+
+# -- timeline ---------------------------------------------------------------
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    HorovodContext.instance().core.start_timeline(file_path, mark_cycles)
+
+
+def stop_timeline() -> None:
+    HorovodContext.instance().core.stop_timeline()
+
+
+# -- build-configuration queries (reference API parity) ---------------------
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    # The socket controller fills Gloo's role (MPI-free CPU control+data
+    # plane); report it under the reference's query for script parity.
+    return True
+
+
+def gloo_built() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def tpu_built() -> bool:
+    """TPU-build extension: the XLA/ICI data plane is always available."""
+    return True
+
+
+def native_core_built() -> bool:
+    """True if the C++ core library is importable."""
+    try:
+        from . import _core  # noqa: F401
+
+        return True
+    except Exception:
+        return False

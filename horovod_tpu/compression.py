@@ -1,0 +1,76 @@
+"""Gradient compression (reference: horovod/torch/compression.py,
+horovod/tensorflow/compression.py — Compression.none / Compression.fp16).
+
+On TPU the natural compressed wire type is bfloat16 (same 8-bit exponent as
+float32, so no loss-scaling is needed); ``Compression.fp16`` keeps the
+reference's name/semantics and ``Compression.bf16`` is the TPU-preferred
+variant.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _astype(tensor, dtype):
+    if isinstance(tensor, np.ndarray):
+        return tensor.astype(dtype)
+    import jax.numpy as jnp
+
+    return tensor.astype(dtype) if hasattr(tensor, "astype") else jnp.asarray(
+        tensor, dtype=dtype)
+
+
+class Compressor:
+    """Interface: compress before the wire, decompress after."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: str = "float16"
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = getattr(tensor, "dtype", None)
+        if dtype is not None and str(dtype) in ("float32", "float64"):
+            return _astype(tensor, cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is None:
+            return tensor
+        return _astype(tensor, ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = "float16"
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = "bfloat16"
+
+
+class Compression:
+    """Namespace matching the reference's ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

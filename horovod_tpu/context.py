@@ -1,0 +1,367 @@
+"""Global Horovod context: handle table, executor thread, host data plane.
+
+Reference analogs (SURVEY.md §2.1/§3.2): HorovodGlobalState (global_state.h),
+HandleManager (torch/handle_manager.cc), the ops layer's fuse/unfuse logic
+(ops/collective_operations.cc — MemcpyInFusionBuffer/MemcpyOutFusionBuffer)
+and op execution (ops/operation_manager.cc).
+
+The executor thread pops negotiated ``FusedResponse``s from the core backend
+and runs the data plane:
+
+- host arrays (numpy) → the core's fused host collectives (identity at np=1,
+  TCP in multi-process mode),
+- results are converted back to the framework type the caller handed in
+  (JAX array in → JAX array out).
+
+For device-resident SPMD collectives inside ``jit`` see
+``horovod_tpu.ops.collectives`` — those never pass through this queue; they
+compile straight to XLA collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .exceptions import HorovodInternalError
+from .runtime import CoreBackend, FusedResponse, PyLocalCore, TensorEntry
+from .utils.env import Config
+from .utils.logging import get_logger
+from .wire import DataType, OpType, ReduceOp, numpy_dtype, wire_dtype
+
+log = get_logger()
+
+_INT_TYPES = (
+    DataType.UINT8, DataType.INT8, DataType.UINT16, DataType.INT16,
+    DataType.INT32, DataType.INT64, DataType.BOOL,
+)
+
+
+def _select_backend(cfg: Config) -> CoreBackend:
+    """Pick the native C++ core when available, pure-Python otherwise.
+
+    Selection mirrors the reference's controller choice in
+    InitializeHorovodOnce (operations.cc): env overrides first —
+    HOROVOD_CONTROLLER=python or HVD_TPU_PURE_PY=1 force the pure-Python
+    local core; any other value (auto/local/socket) prefers the native core.
+    """
+    force_python = cfg.force_pure_python or cfg.controller == "python"
+    if not force_python:
+        try:
+            from ._core import NativeCore
+
+            return NativeCore()
+        except Exception as exc:  # pragma: no cover - build-environment dependent
+            if cfg.size > 1 or cfg.controller == "socket":
+                raise HorovodInternalError(
+                    f"native core required for size={cfg.size} "
+                    f"(controller={cfg.controller}) but unavailable: {exc}"
+                ) from exc
+            log.debug("native core unavailable (%s); using pure-Python local core", exc)
+    if cfg.size > 1:
+        raise HorovodInternalError(
+            "pure-Python core only supports single-process mode"
+        )
+    return PyLocalCore()
+
+
+class HorovodContext:
+    """Process-wide singleton created by ``hvd.init()``."""
+
+    _instance: Optional["HorovodContext"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.core = _select_backend(cfg)
+        self._entries: Dict[int, TensorEntry] = {}
+        self._entries_lock = threading.Lock()
+        self._inflight_names: set = set()
+        self._handle_counter = itertools.count(1)
+        self._noname_counter = itertools.count(0)
+        self._shutdown = threading.Event()
+        self.core.start(cfg)
+        self._executor = threading.Thread(
+            target=self._executor_loop, name="hvd-executor", daemon=True
+        )
+        self._executor.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    @classmethod
+    def instance(cls) -> "HorovodContext":
+        inst = cls._instance
+        if inst is None:
+            raise ValueError(
+                "Horovod has not been initialized; run hvd.init() first."
+            )
+        return inst
+
+    @classmethod
+    def initialized(cls) -> bool:
+        return cls._instance is not None
+
+    @classmethod
+    def init(cls, cfg: Optional[Config] = None) -> "HorovodContext":
+        with cls._instance_lock:
+            if cls._instance is not None:
+                return cls._instance
+            cls._instance = HorovodContext(cfg or Config.from_env())
+            return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is None:
+            return
+        inst._shutdown.set()
+        inst._executor.join(timeout=5.0)
+        inst.core.shutdown()
+        # Fail any still-pending handles so blocked synchronize() callers
+        # wake with an error instead of hanging forever.
+        with inst._entries_lock:
+            pending = [e for e in inst._entries.values() if not e.done.is_set()]
+        for e in pending:
+            e.error = "Horovod has been shut down"
+            e.done.set()
+
+    # -- enqueue ------------------------------------------------------------
+    def enqueue(
+        self,
+        array,
+        op: OpType,
+        name: Optional[str] = None,
+        reduce_op: ReduceOp = ReduceOp.SUM,
+        root_rank: int = 0,
+        splits=None,
+        process_set_id: int = 0,
+        prescale_factor: float = 1.0,
+        postscale_factor: float = 1.0,
+    ) -> int:
+        np_arr, was_jax, orig_dtype = _to_host(array)
+        dtype = wire_dtype(np_arr.dtype if orig_dtype is None else orig_dtype)
+        if name is None:
+            name = f"{op.name.lower()}.noname.{next(self._noname_counter)}"
+        if dtype in _INT_TYPES:
+            if reduce_op == ReduceOp.AVERAGE and op == OpType.ALLREDUCE:
+                raise ValueError(
+                    "hvd.Average is not supported for integer tensors; use hvd.Sum"
+                )
+            if prescale_factor != 1.0 or postscale_factor != 1.0:
+                raise ValueError("pre/postscale not supported for integer tensors")
+        if splits is not None:
+            splits = np.ascontiguousarray(np.asarray(splits, dtype=np.int64))
+
+        handle = next(self._handle_counter)
+        entry = TensorEntry(
+            handle=handle,
+            name=name,
+            op=op,
+            array=np_arr,
+            dtype=dtype,
+            reduce_op=reduce_op,
+            root_rank=root_rank,
+            splits=splits,
+            process_set_id=process_set_id,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            was_jax=was_jax,
+            orig_dtype=orig_dtype,
+        )
+        with self._entries_lock:
+            if name in self._inflight_names:
+                raise ValueError(
+                    f"a collective named {name!r} is already in flight; names must "
+                    "be unique among outstanding operations"
+                )
+            self._inflight_names.add(name)
+            self._entries[handle] = entry
+        self.core.enqueue(entry)
+        return handle
+
+    # -- completion ---------------------------------------------------------
+    def poll(self, handle: int) -> bool:
+        with self._entries_lock:
+            entry = self._entries.get(handle)
+        if entry is None:
+            raise ValueError(f"unknown handle {handle}")
+        return entry.done.is_set()
+
+    def synchronize(self, handle: int):
+        with self._entries_lock:
+            entry = self._entries.get(handle)
+        if entry is None:
+            raise ValueError(f"unknown handle {handle}")
+        entry.done.wait()
+        with self._entries_lock:
+            self._entries.pop(handle, None)
+            self._inflight_names.discard(entry.name)
+        if entry.error is not None:
+            raise HorovodInternalError(entry.error)
+        result = entry.result
+        if entry.op == OpType.ALLTOALL:
+            return _from_host(result, entry), entry.recv_splits
+        return _from_host(result, entry)
+
+    # -- executor / data plane ----------------------------------------------
+    def _executor_loop(self) -> None:
+        while not self._shutdown.is_set():
+            resp = self.core.pop_response(timeout=0.05)
+            if resp is None:
+                continue
+            entries = []
+            with self._entries_lock:
+                for h in resp.handles:
+                    e = self._entries.get(h)
+                    if e is not None:
+                        entries.append(e)
+            if not entries:
+                continue
+            try:
+                if resp.error:
+                    raise HorovodInternalError(resp.error)
+                self._execute(resp, entries)
+                for e in entries:
+                    e.done.set()
+            except Exception as exc:  # noqa: BLE001 - propagate via handle
+                for e in entries:
+                    e.error = str(exc)
+                    e.done.set()
+
+    def _execute(self, resp: FusedResponse, entries: List[TensorEntry]) -> None:
+        op = resp.op
+        psid = resp.process_set_id
+        if op == OpType.ALLREDUCE:
+            self._exec_allreduce(entries, psid)
+        elif op == OpType.ALLGATHER:
+            self._exec_allgather(entries[0], psid)
+        elif op == OpType.BROADCAST:
+            self._exec_broadcast(entries[0], psid)
+        elif op == OpType.ALLTOALL:
+            self._exec_alltoall(entries[0], psid)
+        elif op == OpType.REDUCESCATTER:
+            self._exec_reducescatter(entries[0], psid)
+        elif op == OpType.BARRIER:
+            self.core.barrier(psid)
+            for e in entries:
+                e.result = e.array
+        else:
+            raise HorovodInternalError(f"unsupported op {op}")
+
+    def _ps_size(self, psid: int) -> int:
+        return len(self.core.process_set_ranks(psid))
+
+    def _exec_allreduce(self, entries: List[TensorEntry], psid: int) -> None:
+        # MemcpyInFusionBuffer analog: pack members into one contiguous buffer.
+        dtype = entries[0].array.dtype
+        reduce_op = entries[0].reduce_op
+        if len(entries) == 1:
+            fused = entries[0].array.ravel().copy()
+        else:
+            fused = np.concatenate([e.array.ravel() for e in entries])
+        pre = entries[0].prescale_factor
+        if pre != 1.0:
+            fused = (fused.astype(np.float64) * pre).astype(dtype)
+        wire_op = ReduceOp.SUM if reduce_op in (ReduceOp.AVERAGE, ReduceOp.ADASUM) \
+            else reduce_op
+        if reduce_op == ReduceOp.ADASUM and self._ps_size(psid) > 1:
+            log.warning("Adasum host-path falls back to Average in this build")
+        fused = self.core.allreduce_buffer(fused, psid, wire_op)
+        if reduce_op in (ReduceOp.AVERAGE, ReduceOp.ADASUM):
+            n = self._ps_size(psid)
+            if n > 1:
+                fused = (fused.astype(np.float64) / n).astype(dtype)
+        post = entries[0].postscale_factor
+        if post != 1.0:
+            fused = (fused.astype(np.float64) * post).astype(dtype)
+        # MemcpyOutFusionBuffer analog.
+        offset = 0
+        for e in entries:
+            n = e.array.size
+            e.result = fused[offset:offset + n].reshape(e.array.shape)
+            offset += n
+
+    def _exec_allgather(self, e: TensorEntry, psid: int) -> None:
+        stacked, counts = self.core.allgather_buffer(
+            e.array.reshape(e.array.shape[0] if e.array.ndim else 1, -1)
+            if e.array.ndim else e.array.reshape(1, 1),
+            psid,
+        )
+        rest = e.array.shape[1:] if e.array.ndim else ()
+        e.result = np.asarray(stacked).reshape((int(np.sum(counts)),) + tuple(rest))
+
+    def _exec_broadcast(self, e: TensorEntry, psid: int) -> None:
+        e.result = self.core.broadcast_buffer(e.array, e.root_rank, psid)
+
+    def _exec_alltoall(self, e: TensorEntry, psid: int) -> None:
+        n = self._ps_size(psid)
+        splits = e.splits
+        if splits is None:
+            d0 = e.array.shape[0]
+            if d0 % n != 0:
+                raise HorovodInternalError(
+                    f"alltoall without splits requires first dim divisible by "
+                    f"process set size ({d0} vs {n})"
+                )
+            splits = np.full((n,), d0 // n, dtype=np.int64)
+        if int(splits.sum()) != e.array.shape[0]:
+            raise HorovodInternalError("alltoall splits do not sum to first dim")
+        buf = e.array.reshape(e.array.shape[0], -1)
+        out, recv_splits = self.core.alltoall_buffer(buf, splits, psid)
+        rest = e.array.shape[1:]
+        e.result = np.asarray(out).reshape((int(np.sum(recv_splits)),) + tuple(rest))
+        e.recv_splits = np.asarray(recv_splits, dtype=np.int64)
+
+    def _exec_reducescatter(self, e: TensorEntry, psid: int) -> None:
+        # Reduce everywhere, then keep this rank's slice of the first dim.
+        # Slicing rule matches the reference (ReducescatterOp): the first
+        # (d0 % size) ranks receive one extra row.
+        n = self._ps_size(psid)
+        dtype = e.array.dtype
+        fused = e.array.ravel().copy()
+        pre = e.prescale_factor
+        if pre != 1.0:
+            fused = (fused.astype(np.float64) * pre).astype(dtype)
+        wire_op = ReduceOp.SUM if e.reduce_op == ReduceOp.AVERAGE else e.reduce_op
+        fused = self.core.allreduce_buffer(fused, psid, wire_op)
+        if e.reduce_op == ReduceOp.AVERAGE:
+            fused = (fused.astype(np.float64) / max(n, 1)).astype(dtype)
+        if e.postscale_factor != 1.0:
+            fused = (fused.astype(np.float64) * e.postscale_factor).astype(dtype)
+        full = fused.reshape(e.array.shape)
+        d0 = e.array.shape[0]
+        ranks = self.core.process_set_ranks(psid)
+        my_pos = ranks.index(self.core.rank()) if self.core.rank() in ranks else 0
+        base, extra = divmod(d0, n)
+        start = my_pos * base + min(my_pos, extra)
+        length = base + (1 if my_pos < extra else 0)
+        e.result = full[start:start + length]
+
+
+def _to_host(array):
+    """Convert a framework array to a contiguous host numpy buffer."""
+    was_jax = False
+    orig_dtype = None
+    if not isinstance(array, np.ndarray):
+        try:
+            import jax
+
+            if isinstance(array, jax.Array):
+                was_jax = True
+                orig_dtype = array.dtype  # bfloat16 survives via ml_dtypes
+                return np.ascontiguousarray(np.asarray(array)), was_jax, orig_dtype
+        except ImportError:  # pragma: no cover
+            pass
+        array = np.asarray(array)
+    return np.ascontiguousarray(array), was_jax, orig_dtype
+
+
+def _from_host(result: np.ndarray, entry: TensorEntry):
+    if not entry.was_jax:
+        return result
+    import jax.numpy as jnp
+
+    return jnp.asarray(result, dtype=entry.orig_dtype)

@@ -1,0 +1,94 @@
+"""Object and parameter broadcast helpers.
+
+Reference: horovod/torch/functions.py (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object) and
+horovod/tensorflow/functions.py (broadcast_object, allgather_object);
+SURVEY.md §2.4.  Parameters here are JAX pytrees, so one implementation
+covers model params, optimizer state, and arbitrary picklable objects.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from . import basics
+from .mpi_ops import allgather, broadcast, grouped_allreduce  # noqa: F401
+from .mpi_ops import broadcast_async, synchronize
+from .process_sets import ProcessSet
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None,
+                         prefix: str = "broadcast.params") -> Any:
+    """Broadcast a pytree of arrays from ``root_rank`` to all ranks.
+
+    Returns the synchronized pytree (JAX arrays are immutable, so unlike the
+    reference's in-place torch variant this is functional).
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    handles = [
+        broadcast_async(leaf, root_rank, name=f"{prefix}.{i}",
+                        process_set=process_set)
+        for i, leaf in enumerate(leaves)
+    ]
+    new_leaves = [synchronize(h) for h in handles]
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast optax optimizer state (a pytree) from ``root_rank``."""
+    return broadcast_parameters(opt_state, root_rank, process_set,
+                                prefix="broadcast.opt_state")
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object (two-phase: size, then
+    payload — same protocol as the reference)."""
+    name = name or "broadcast.object"
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        sz = np.array([payload.size], dtype=np.int64)
+    else:
+        payload = None
+        sz = np.zeros(1, dtype=np.int64)
+    sz = np.asarray(broadcast(sz, root_rank, name=f"{name}.size",
+                              process_set=process_set))
+    if payload is None:
+        payload = np.zeros(int(sz[0]), dtype=np.uint8)
+    payload = np.asarray(broadcast(payload, root_rank, name=f"{name}.payload",
+                                   process_set=process_set))
+    return pickle.loads(payload.tobytes())
+
+
+def broadcast_object_fn(root_rank: int = 0, name: Optional[str] = None,
+                        process_set: Optional[ProcessSet] = None):
+    def _fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+
+    return _fn
+
+
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> list:
+    """Gather one picklable object per rank into a list ordered by rank."""
+    name = name or "allgather.object"
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    sizes = np.asarray(allgather(np.array([payload.size], dtype=np.int64),
+                                 name=f"{name}.size", process_set=process_set))
+    gathered = np.asarray(allgather(payload, name=f"{name}.payload",
+                                    process_set=process_set))
+    out = []
+    offset = 0
+    for s in sizes.ravel().tolist():
+        out.append(pickle.loads(gathered[offset:offset + s].tobytes()))
+        offset += s
+    return out

@@ -1,0 +1,9 @@
+"""``horovod.jax``-style binding alias: ``import horovod_tpu.jax as hvd``.
+
+The north-star API names a ``horovod/jax`` binding (BASELINE.json); the
+top-level package *is* that binding, and this module re-exports it under the
+expected name so reference-style imports work unchanged.
+"""
+
+from horovod_tpu import *  # noqa: F401,F403
+from horovod_tpu import __version__  # noqa: F401

@@ -1,0 +1,293 @@
+"""Public collective API: hvd.allreduce / allgather / broadcast / alltoall /
+reducescatter, in synchronous, async-handle, and grouped forms.
+
+Reference analogs: horovod/torch/mpi_ops.py (allreduce_async_/synchronize/
+poll handle API) and horovod/tensorflow/__init__.py (op wrappers); SURVEY.md
+§2.4, §3.2.  The module name is kept for import parity, though no MPI exists
+anywhere in this build.
+
+Dispatch is dual, matching how the two execution worlds meet on TPU:
+
+- **Traced** (argument is a JAX tracer, i.e. we are inside ``jit`` /
+  ``shard_map``): the call compiles directly to an XLA collective over the
+  named mesh axis (``horovod_tpu.ops.collectives``) — the ICI data plane.
+- **Eager**: the call enqueues into the core runtime, which negotiates
+  readiness across ranks, fuses, and executes — the Horovod spine
+  (``horovod_tpu.context``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .context import HorovodContext
+from .process_sets import ProcessSet, _resolve_psid
+from .wire import OpType, ReduceOp, Average, Sum, Min, Max, Product, Adasum
+from .ops import collectives as _jit_ops
+from .parallel import mesh as _mesh
+
+
+def _is_traced(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.core.Tracer)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else _mesh.mesh_axis_name()
+
+
+def _check_traced_args(process_set) -> None:
+    if process_set is not None:
+        raise ValueError(
+            "process_set is not supported in traced mode; run the collective "
+            "over a sub-mesh axis (axis_name=...) instead"
+        )
+
+
+def _check_eager_args(axis_name) -> None:
+    if axis_name is not None:
+        raise ValueError(
+            "axis_name is only meaningful inside jit/shard_map (traced mode); "
+            "eager collectives take process_set= instead"
+        )
+
+
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    if average is not None:
+        if op is not None:
+            raise ValueError("specify either op or the deprecated average=, not both")
+        return ReduceOp.AVERAGE if average else ReduceOp.SUM
+    return ReduceOp.AVERAGE if op is None else op
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None,
+              compression=None, op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None,
+              axis_name: Optional[str] = None):
+    """Average (default) or otherwise reduce ``tensor`` across ranks."""
+    rop = _resolve_op(op, average)
+    if _is_traced(tensor):
+        _check_traced_args(process_set)
+        return _jit_ops.allreduce(tensor, _axis(axis_name), rop,
+                                  prescale_factor, postscale_factor)
+    _check_eager_args(axis_name)
+    from .compression import NoneCompressor
+
+    compression = compression or NoneCompressor
+    compressed, ctx = compression.compress(tensor)
+    handle = allreduce_async(compressed, name=name, op=rop,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set)
+    return compression.decompress(synchronize(handle), ctx)
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    rop = _resolve_op(op, average)
+    return HorovodContext.instance().enqueue(
+        tensor, OpType.ALLREDUCE, name=name, reduce_op=rop,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_resolve_psid(process_set),
+    )
+
+
+# JAX arrays are immutable; the in-place variants exist for API parity and
+# simply return the reduced value.
+allreduce_ = allreduce
+allreduce_async_ = allreduce_async
+
+
+def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
+                      name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                      process_set: Optional[ProcessSet] = None,
+                      axis_name: Optional[str] = None) -> List:
+    """Allreduce a list of tensors as one atomic negotiation group
+    (reference: group_table.cc grouped_allreduce)."""
+    rop = _resolve_op(op, average)
+    if tensors and _is_traced(tensors[0]):
+        _check_traced_args(process_set)
+        ax = _axis(axis_name)
+        return [_jit_ops.allreduce(t, ax, rop, prescale_factor, postscale_factor)
+                for t in tensors]
+    _check_eager_args(axis_name)
+    handles = grouped_allreduce_async(
+        tensors, name=name, op=rop, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)
+    return [synchronize(h) for h in handles]
+
+
+def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: Optional[ProcessSet] = None) -> List[int]:
+    rop = _resolve_op(op, average)
+    ctx = HorovodContext.instance()
+    base = name or f"grouped.{id(tensors):x}"
+    return [
+        ctx.enqueue(t, OpType.ALLREDUCE, name=f"{base}.{i}", reduce_op=rop,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set_id=_resolve_psid(process_set))
+        for i, t in enumerate(tensors)
+    ]
+
+
+grouped_allreduce_ = grouped_allreduce
+grouped_allreduce_async_ = grouped_allreduce_async
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None,
+              axis_name: Optional[str] = None):
+    """Concatenate each rank's tensor along dim 0 (ranks may differ in dim 0
+    in eager mode; traced mode requires equal shapes — an XLA constraint)."""
+    if _is_traced(tensor):
+        _check_traced_args(process_set)
+        return _jit_ops.allgather(tensor, _axis(axis_name))
+    _check_eager_args(axis_name)
+    return synchronize(allgather_async(tensor, name=name, process_set=process_set))
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    return HorovodContext.instance().enqueue(
+        tensor, OpType.ALLGATHER, name=name,
+        process_set_id=_resolve_psid(process_set),
+    )
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None,
+              axis_name: Optional[str] = None):
+    if _is_traced(tensor):
+        _check_traced_args(process_set)
+        return _jit_ops.broadcast(tensor, root_rank, _axis(axis_name))
+    _check_eager_args(axis_name)
+    return synchronize(
+        broadcast_async(tensor, root_rank, name=name, process_set=process_set))
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    return HorovodContext.instance().enqueue(
+        tensor, OpType.BROADCAST, name=name, root_rank=root_rank,
+        process_set_id=_resolve_psid(process_set),
+    )
+
+
+broadcast_ = broadcast
+broadcast_async_ = broadcast_async
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None,
+             axis_name: Optional[str] = None):
+    """Distribute slices of dim 0 to all ranks.
+
+    Eager mode returns ``(received_tensor, received_splits)`` like the
+    reference's torch binding; traced mode requires equal splits (static
+    shapes) and returns just the tensor.
+    """
+    if _is_traced(tensor):
+        _check_traced_args(process_set)
+        if splits is not None:
+            raise ValueError(
+                "in-jit alltoall requires equal splits (XLA static shapes); "
+                "omit the splits argument"
+            )
+        return _jit_ops.alltoall(tensor, _axis(axis_name))
+    _check_eager_args(axis_name)
+    return HorovodContext.instance().synchronize(
+        alltoall_async(tensor, splits=splits, name=name, process_set=process_set))
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    return HorovodContext.instance().enqueue(
+        tensor, OpType.ALLTOALL, name=name, splits=splits,
+        process_set_id=_resolve_psid(process_set),
+    )
+
+
+# ---------------------------------------------------------------------------
+# reducescatter
+# ---------------------------------------------------------------------------
+
+def reducescatter(tensor, op: ReduceOp = ReduceOp.AVERAGE,
+                  name: Optional[str] = None,
+                  prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                  process_set: Optional[ProcessSet] = None,
+                  axis_name: Optional[str] = None):
+    if _is_traced(tensor):
+        _check_traced_args(process_set)
+        return _jit_ops.reducescatter(tensor, _axis(axis_name), op,
+                                      prescale_factor, postscale_factor)
+    _check_eager_args(axis_name)
+    return synchronize(reducescatter_async(
+        tensor, op=op, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
+
+
+def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
+                        name: Optional[str] = None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    return HorovodContext.instance().enqueue(
+        tensor, OpType.REDUCESCATTER, name=name, reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_resolve_psid(process_set),
+    )
+
+
+# ---------------------------------------------------------------------------
+# barrier / handle management
+# ---------------------------------------------------------------------------
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Block until all ranks of the set reach the barrier
+    (reference: horovod_barrier in operations.cc)."""
+    ctx = HorovodContext.instance()
+    h = ctx.enqueue(np.zeros((), dtype=np.float32), OpType.BARRIER,
+                    process_set_id=_resolve_psid(process_set))
+    ctx.synchronize(h)
+
+
+def synchronize(handle: int):
+    """Block until the async op behind ``handle`` completes; return its
+    result (reference: horovod/torch/mpi_ops.py synchronize)."""
+    return HorovodContext.instance().synchronize(handle)
+
+
+def poll(handle: int) -> bool:
+    """True if the async op behind ``handle`` has completed."""
+    return HorovodContext.instance().poll(handle)

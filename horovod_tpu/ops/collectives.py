@@ -1,0 +1,136 @@
+"""In-jit (traced) collective implementations: the XLA/ICI data plane.
+
+This is the TPU-native replacement for the reference's NCCL ops layer
+(horovod/common/ops/nccl_operations.cc — NCCLAllreduce/NCCLAllgather/
+NCCLBroadcast/NCCLAlltoall; SURVEY.md §2.2): where NCCL launches ring
+kernels on a CUDA stream, here each collective is a ``jax.lax`` primitive
+over a named mesh axis that XLA lowers onto ICI — fusion, overlap, and
+scheduling come from the compiler rather than hand-managed streams.
+
+These functions are called by ``horovod_tpu.mpi_ops`` when the input is a
+JAX tracer (i.e. inside ``jit``/``shard_map``), and may also be used
+directly in SPMD training code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..wire import ReduceOp
+
+AxisName = Union[str, Sequence[str]]
+
+
+def axis_size(axis_name: AxisName) -> int:
+    return lax.axis_size(axis_name)
+
+
+def allreduce(x, axis_name: AxisName, op: ReduceOp = ReduceOp.AVERAGE,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    if op == ReduceOp.AVERAGE:
+        out = lax.pmean(x, axis_name)
+    elif op == ReduceOp.SUM:
+        out = lax.psum(x, axis_name)
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(x, axis_name)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(x, axis_name)
+    elif op == ReduceOp.PRODUCT:
+        out = jnp.prod(lax.all_gather(x, axis_name, axis=0), axis=0)
+    elif op == ReduceOp.ADASUM:
+        out = adasum(x, axis_name)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return out
+
+
+def allgather(x, axis_name: AxisName):
+    """Concatenate along dim 0 across the axis (Horovod allgather semantics)."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def broadcast(x, root_rank: int, axis_name: AxisName):
+    """Every member receives root's value.
+
+    Implemented as a masked psum — one collective, no gather of the full
+    axis — which XLA lowers to an ICI broadcast-like pattern.
+    """
+    idx = lax.axis_index(axis_name)
+    # where() (not multiply-by-mask) so NaN/Inf in non-root shards are
+    # discarded rather than propagated through the sum.
+    contribution = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+    return lax.psum(contribution, axis_name)
+
+
+def alltoall(x, axis_name: AxisName):
+    """Equal-splits alltoall: first dim is split across the axis and the
+    received chunks are concatenated along dim 0 (lax.all_to_all)."""
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+
+def reducescatter(x, axis_name: AxisName, op: ReduceOp = ReduceOp.SUM,
+                  prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("in-jit reducescatter supports Sum and Average")
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        out = out / lax.axis_size(axis_name)
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return out
+
+
+def adasum(x, axis_name: AxisName):
+    """Adasum scale-invariant reduction over a mesh axis.
+
+    TPU-native version of the reference's recursive vector-halving/distance-
+    doubling Adasum (horovod/common/ops/adasum/adasum.h; SURVEY.md §2.2):
+    log2(n) rounds of pairwise combination, each round exchanging partners
+    via ``ppermute`` over ICI.  For a pair (a, b):
+
+        adasum(a, b) = (1 - a.b / (2|a|^2)) a + (1 - a.b / (2|b|^2)) b
+
+    Requires the axis size to be a power of two (as the reference does for
+    its pure Adasum path).
+    """
+    n = lax.axis_size(axis_name)
+    if n & (n - 1) != 0:
+        raise ValueError(f"Adasum requires a power-of-two axis size, got {n}")
+    rounds = n.bit_length() - 1
+    idx = lax.axis_index(axis_name)
+    out = x
+    for k in range(rounds):
+        stride = 1 << k
+        partner = idx ^ stride
+        perm = [(i, i ^ stride) for i in range(n)]
+        other = lax.ppermute(out, axis_name, perm)
+        a, b = out, other
+        dot = jnp.vdot(a, b).astype(jnp.float32)
+        na = jnp.vdot(a, a).astype(jnp.float32)
+        nb = jnp.vdot(b, b).astype(jnp.float32)
+        eps = jnp.asarray(1e-30, jnp.float32)
+        ca = (1.0 - dot / (2.0 * jnp.maximum(na, eps))).astype(x.dtype)
+        cb = (1.0 - dot / (2.0 * jnp.maximum(nb, eps))).astype(x.dtype)
+        combined = ca * a + cb * b
+        # Both members of a pair compute the same combined vector (the
+        # formula is symmetric), so no extra exchange is needed.
+        out = combined
+        del partner
+    return out
+
+
+def barrier(axis_name: AxisName):
+    """A collective no-op that forces synchronisation across the axis."""
+    token = jnp.zeros((), dtype=jnp.float32)
+    return lax.psum(token, axis_name)

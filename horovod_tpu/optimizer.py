@@ -1,0 +1,217 @@
+"""DistributedOptimizer: gradient averaging wrapped around optax.
+
+Reference analogs (SURVEY.md §2.4, §3.3): horovod/torch/optimizer.py
+(_DistributedOptimizer — per-parameter grad hooks → async allreduce,
+``backward_passes_per_step`` local aggregation, ``gradient_predivide_factor``)
+and horovod/tensorflow/__init__.py (DistributedOptimizer /
+DistributedGradientTape → _allreduce_grads).
+
+TPU-first design: an optax ``GradientTransformation`` is the JAX-native
+"optimizer", so ``hvd.DistributedOptimizer(tx)`` returns a new
+GradientTransformation whose ``update`` first averages gradients across
+ranks:
+
+- **inside jit / shard_map** (tracers): gradients compile to XLA
+  collectives over the named mesh axis — one fused psum per dtype after XLA's
+  collective combining, riding ICI;
+- **eager**: every leaf is enqueued async into the core runtime and then
+  synchronized, which is exactly the reference's hook-then-synchronize
+  overlap and engages tensor fusion in the core.
+
+``backward_passes_per_step`` accumulates gradients locally and only
+communicates (and applies the inner optimizer) every k-th call, built with
+``lax.cond`` so it stays jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .compression import Compression
+from .mpi_ops import allreduce_async, synchronize, _is_traced
+from .ops import collectives as _jit_ops
+from .parallel import mesh as _mesh
+from .process_sets import ProcessSet, _resolve_psid
+from .wire import ReduceOp
+
+
+def _tree_allreduce(grads, op: ReduceOp, compression,
+                    prescale_factor: float, postscale_factor: float,
+                    process_set: Optional[ProcessSet],
+                    axis_name: Optional[str], name_prefix: str = "grad"):
+    """Allreduce a pytree of gradients (traced → XLA; eager → fused async)."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+    if _is_traced(leaves[0]):
+        ax = axis_name if axis_name is not None else _mesh.mesh_axis_name()
+        out = []
+        for leaf in leaves:
+            comp, ctx = compression.compress(leaf)
+            red = _jit_ops.allreduce(comp, ax, op, prescale_factor,
+                                     postscale_factor)
+            out.append(compression.decompress(red, ctx))
+        return jax.tree_util.tree_unflatten(treedef, out)
+    # Eager: enqueue everything first (negotiation fuses the bucket), then wait.
+    handles, ctxs = [], []
+    for i, leaf in enumerate(leaves):
+        comp, ctx = compression.compress(leaf)
+        ctxs.append(ctx)
+        handles.append(
+            allreduce_async(comp, name=f"{name_prefix}.{i}", op=op,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor,
+                            process_set=process_set))
+    out = [compression.decompress(synchronize(h), ctx)
+           for h, ctx in zip(handles, ctxs)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def allreduce_gradients(grads, op: ReduceOp = ReduceOp.AVERAGE,
+                        compression=Compression.none,
+                        process_set: Optional[ProcessSet] = None,
+                        axis_name: Optional[str] = None):
+    """Average a pytree of gradients across ranks.
+
+    JAX analog of the reference's DistributedGradientTape._allreduce_grads:
+    use it directly around ``jax.grad`` when not going through optax.
+    """
+    return _tree_allreduce(grads, op, compression, 1.0, 1.0, process_set,
+                           axis_name)
+
+
+class DistributedOptState(NamedTuple):
+    inner_state: Any
+    accum: Any          # local gradient accumulator (backward_passes_per_step)
+    counter: jnp.ndarray  # int32 scalar
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = ReduceOp.AVERAGE,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set: Optional[ProcessSet] = None,
+                         axis_name: Optional[str] = None
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer with cross-rank gradient averaging.
+
+    ``named_parameters`` is accepted for reference-signature parity and
+    ignored (JAX pytrees carry structure already).  With
+    ``backward_passes_per_step > 1``, gradients accumulate locally and the
+    collective + inner update run every k-th call; other calls return zero
+    updates (parameters unchanged), matching the reference's local gradient
+    aggregation semantics.
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    if gradient_predivide_factor != 1.0:
+        if op != ReduceOp.AVERAGE:
+            raise ValueError(
+                "gradient_predivide_factor is only supported with op=Average")
+        prescale = 1.0 / gradient_predivide_factor
+    else:
+        prescale = 1.0
+
+    def reduce_grads(grads, divisor: int):
+        # Split averaging around the wire like the reference: prescale by
+        # 1/predivide before the sum, finish the average after.
+        if gradient_predivide_factor != 1.0:
+            eff_op = ReduceOp.SUM
+            post = gradient_predivide_factor  # completes 1/size with psum below
+            reduced = _tree_allreduce(grads, eff_op, compression, prescale,
+                                      post, process_set, axis_name)
+            n = _ps_world_size(process_set, axis_name, grads)
+            reduced = jax.tree_util.tree_map(lambda g: g / n, reduced)
+        else:
+            reduced = _tree_allreduce(grads, op, compression, 1.0, 1.0,
+                                      process_set, axis_name)
+        if divisor > 1:
+            reduced = jax.tree_util.tree_map(lambda g: g / divisor, reduced)
+        return reduced
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return DistributedOptState(
+            inner_state=optimizer.init(params),
+            accum=zeros,
+            counter=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def update_fn(grads, state: DistributedOptState, params=None):
+        if backward_passes_per_step == 1:
+            reduced = reduce_grads(grads, 1)
+            updates, inner = optimizer.update(reduced, state.inner_state, params)
+            return updates, DistributedOptState(inner, state.accum, state.counter)
+
+        accum = jax.tree_util.tree_map(jnp.add, state.accum, grads)
+        counter = state.counter + 1
+        k = backward_passes_per_step
+
+        if _is_traced(jax.tree_util.tree_leaves(grads)[0]):
+            ax = axis_name if axis_name is not None else _mesh.mesh_axis_name()
+
+            def _vary(tree):
+                # lax.cond requires both branches to agree on varying-manual-
+                # axes types; psum outputs are axis-invariant while held
+                # accumulators are varying, so cast everything to varying.
+                def cast(x):
+                    try:
+                        vma = jax.typeof(x).vma
+                    except Exception:
+                        return x
+                    axes = (ax,) if isinstance(ax, str) else tuple(ax)
+                    missing = tuple(a for a in axes if a not in vma)
+                    return jax.lax.pvary(x, missing) if missing else x
+
+                return jax.tree_util.tree_map(cast, tree)
+
+            def communicate(acc_inner):
+                acc, inner_state = acc_inner
+                reduced = reduce_grads(acc, k)
+                updates, inner = optimizer.update(reduced, inner_state, params)
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return _vary((updates, zeros, inner))
+
+            def hold(acc_inner):
+                acc, inner_state = acc_inner
+                zero_upd = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return _vary((zero_upd, acc, inner_state))
+
+            updates, accum, inner = jax.lax.cond(
+                counter % k == 0, communicate, hold, (accum, state.inner_state))
+            counter = jnp.where(counter % k == 0, 0, counter)
+            return updates, DistributedOptState(inner, accum, counter)
+
+        # Eager: plain Python control flow.
+        if int(counter) % k == 0:
+            reduced = reduce_grads(accum, k)
+            updates, inner = optimizer.update(reduced, state.inner_state, params)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            return updates, DistributedOptState(inner, zeros,
+                                                jnp.zeros((), jnp.int32))
+        zero_upd = jax.tree_util.tree_map(jnp.zeros_like, grads)
+        return zero_upd, DistributedOptState(state.inner_state, accum, counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# Reference-name alias: the TF binding calls the same concept a
+# DistributedGradientTape; in optax terms both are gradient transformations.
+DistributedGradientTransformation = DistributedOptimizer
+
+
+def _ps_world_size(process_set, axis_name, grads) -> Any:
+    leaves = jax.tree_util.tree_leaves(grads)
+    if leaves and _is_traced(leaves[0]):
+        ax = axis_name if axis_name is not None else _mesh.mesh_axis_name()
+        return jax.lax.axis_size(ax)
+    from .context import HorovodContext
+
+    return len(HorovodContext.instance().core.process_set_ranks(
+        _resolve_psid(process_set)))

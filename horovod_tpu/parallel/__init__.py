@@ -1,0 +1,7 @@
+from .mesh import (  # noqa: F401
+    build_global_mesh,
+    global_mesh,
+    set_global_mesh,
+    mesh_axis_name,
+    sub_mesh,
+)

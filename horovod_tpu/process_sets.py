@@ -1,0 +1,126 @@
+"""Process sets: collectives over subsets of ranks.
+
+Reference: horovod/common/process_sets.py (ProcessSet, add_process_set,
+remove_process_set) over horovod/common/process_set.cc ProcessSetTable
+(SURVEY.md §2.1, §2.4).  On TPU, a process set additionally maps to a
+sub-mesh of the global device mesh (see horovod_tpu.parallel.mesh), which is
+what makes hand-rolled TP/PP/SP cheap to layer on top.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Union
+
+from .context import HorovodContext
+
+
+class ProcessSet:
+    """A subset of Horovod ranks over which collectives may run.
+
+    Construct with explicit ranks (``ProcessSet([0, 1])``) or with ranges,
+    then register with :func:`add_process_set` (or pass via
+    ``hvd.init(process_sets=[...])``).
+    """
+
+    process_set_id: Optional[int] = None
+
+    def __init__(self, ranks_or_range: Union[Sequence[int], range, Iterable[int]]):
+        self.ranks: List[int] = sorted(set(int(r) for r in ranks_or_range))
+        self.process_set_id = None
+
+    def _check_registered(self) -> None:
+        if self.process_set_id is None:
+            raise ValueError(
+                "process set is not registered; call hvd.add_process_set() first"
+            )
+
+    def included(self) -> bool:
+        """True if this process's rank belongs to the set."""
+        self._check_registered()
+        return HorovodContext.instance().core.rank() in self.ranks
+
+    def rank(self) -> int:
+        """Rank of this process within the set (-1 if not included)."""
+        self._check_registered()
+        my = HorovodContext.instance().core.rank()
+        return self.ranks.index(my) if my in self.ranks else -1
+
+    def size(self) -> int:
+        self._check_registered()
+        return len(self.ranks)
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ProcessSet) and self.ranks == other.ranks
+
+    def __hash__(self):
+        return hash(tuple(self.ranks))
+
+
+class _GlobalProcessSet(ProcessSet):
+    """The implicit set of all ranks, always registered with id 0."""
+
+    def __init__(self):
+        self.process_set_id = 0
+        self.ranks = []  # lazily resolved: all ranks
+
+    def _check_registered(self) -> None:
+        pass
+
+    def _resolve(self) -> List[int]:
+        return HorovodContext.instance().core.process_set_ranks(0)
+
+    def included(self) -> bool:
+        return True
+
+    def rank(self) -> int:
+        return HorovodContext.instance().core.rank()
+
+    def size(self) -> int:
+        return len(self._resolve())
+
+    def __repr__(self) -> str:
+        return "ProcessSet(global)"
+
+
+global_process_set = _GlobalProcessSet()
+
+
+def add_process_set(process_set: Union[ProcessSet, Sequence[int]]) -> ProcessSet:
+    """Register a process set; must be called identically on every rank.
+
+    Ids are assigned deterministically from registration order, which keeps
+    all ranks agreeing without an extra negotiation round (the reference
+    synchronises dynamically under HOROVOD_DYNAMIC_PROCESS_SETS; here
+    symmetric registration is the contract, validated by the controller
+    during negotiation).
+    """
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    ctx = HorovodContext.instance()
+    world = ctx.core.process_set_ranks(0)
+    for r in process_set.ranks:
+        if r not in world:
+            raise ValueError(f"rank {r} is not part of the global process set")
+    process_set.process_set_id = ctx.core.add_process_set(process_set.ranks)
+    return process_set
+
+
+def remove_process_set(process_set: ProcessSet) -> bool:
+    if process_set.process_set_id in (None, 0):
+        return False
+    HorovodContext.instance().core.remove_process_set(process_set.process_set_id)
+    process_set.process_set_id = None
+    return True
+
+
+def _resolve_psid(process_set: Optional[ProcessSet]) -> int:
+    if process_set is None:
+        return 0
+    if isinstance(process_set, int):
+        return process_set
+    if process_set.process_set_id is None:
+        raise ValueError("process set is not registered; call add_process_set()")
+    return process_set.process_set_id

@@ -1,0 +1,371 @@
+"""The enqueue → negotiate → fuse → execute spine (Python side).
+
+This module is the TPU-native re-imagining of the reference's core runtime
+(horovod/common/operations.cc — EnqueueTensorAllreduce/BackgroundThreadLoop/
+RunLoopOnce, tensor_queue.cc, global_state.h; SURVEY.md §3.2):
+
+- Framework threads *enqueue* named tensors and receive integer handles
+  (reference: EnqueueTensorAllreduce + HandleManager).
+- A *core backend* (native C++ library when available, pure-Python fallback)
+  runs the background cycle loop: readiness negotiation across ranks, tensor
+  fusion into buckets, response caching, stall inspection.
+- An *executor thread* pops fused responses from the core and runs the data
+  plane: XLA collectives for device-sharded arrays, the core's host collectives
+  (TCP) for host arrays in multi-process mode, identity at size()==1.
+- ``synchronize(handle)`` blocks on completion; ``poll(handle)`` checks.
+
+The crucial TPU-first property: a response list is negotiated to be *identical
+on every rank*, so in multi-host SPMD mode every host dispatches the same
+cached, jitted fused-collective XLA program — negotiation keeps hosts in
+lockstep, XLA+ICI move the bytes (no NCCL/MPI anywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .exceptions import HorovodInternalError
+from .wire import DataType, OpType, ReduceOp, numpy_dtype, wire_dtype
+from .utils.env import Config
+from .utils.logging import get_logger
+from .utils.timeline import Timeline
+
+log = get_logger()
+
+
+@dataclasses.dataclass
+class TensorEntry:
+    """One enqueued collective (reference: TensorTableEntry, tensor_queue.h)."""
+
+    handle: int
+    name: str
+    op: OpType
+    array: np.ndarray  # host buffer (data plane input)
+    dtype: DataType
+    reduce_op: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    splits: Optional[np.ndarray] = None  # alltoall send splits (per-rank rows)
+    process_set_id: int = 0
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    # completion
+    result: Any = None
+    recv_splits: Optional[np.ndarray] = None  # alltoall receive splits
+    error: Optional[str] = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+    # framework round-trip info
+    was_jax: bool = False
+    orig_dtype: Any = None
+    sharding: Any = None
+
+
+@dataclasses.dataclass
+class FusedResponse:
+    """A negotiated, fused unit of work (reference: Response, message.h).
+
+    ``handles`` lists member tensors in the globally agreed order.  All ranks
+    produce byte-identical responses for the same cycle, which is what lets
+    the data plane be a single SPMD XLA program.
+    """
+
+    op: OpType
+    dtype: DataType
+    process_set_id: int
+    handles: List[int]
+    error: Optional[str] = None
+
+
+class CoreBackend:
+    """Control-plane interface implemented by the native core and the
+    pure-Python fallback.
+
+    Control plane: start/enqueue/pop_response/shutdown.
+    Host data plane (fused contiguous buffers): *_buffer methods. The local
+    (single-process) implementations are identities; the socket controller
+    implements them over TCP (reference analog: Gloo CPU ops).
+    """
+
+    name = "base"
+
+    def start(self, cfg: Config) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+    def enqueue(self, entry: TensorEntry) -> None:
+        raise NotImplementedError
+
+    def pop_response(self, timeout: float) -> Optional[FusedResponse]:
+        raise NotImplementedError
+
+    # -- identity / topology ------------------------------------------------
+    def rank(self) -> int:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    # -- process sets -------------------------------------------------------
+    def add_process_set(self, ranks: Sequence[int]) -> int:
+        raise NotImplementedError
+
+    def remove_process_set(self, process_set_id: int) -> None:
+        raise NotImplementedError
+
+    def process_set_ranks(self, process_set_id: int) -> List[int]:
+        raise NotImplementedError
+
+    # -- host data plane (fused buffers) ------------------------------------
+    def allreduce_buffer(self, buf: np.ndarray, process_set_id: int,
+                         reduce_op: ReduceOp) -> np.ndarray:
+        raise NotImplementedError
+
+    def allgather_buffer(self, buf: np.ndarray, process_set_id: int):
+        """Returns (concatenated bytes of all ranks' buffers, per-rank counts)."""
+        raise NotImplementedError
+
+    def broadcast_buffer(self, buf: np.ndarray, root_rank: int,
+                         process_set_id: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def alltoall_buffer(self, buf: np.ndarray, splits: np.ndarray,
+                        process_set_id: int):
+        """Returns (received buffer, received splits)."""
+        raise NotImplementedError
+
+    def barrier(self, process_set_id: int) -> None:
+        raise NotImplementedError
+
+    # -- observability ------------------------------------------------------
+    def start_timeline(self, path: str, mark_cycles: bool) -> None:
+        raise NotImplementedError
+
+    def stop_timeline(self) -> None:
+        raise NotImplementedError
+
+
+class _ProcessSetTable:
+    """Shared process-set bookkeeping (reference: process_set.cc ProcessSetTable)."""
+
+    def __init__(self, world_ranks: List[int]):
+        self._lock = threading.Lock()
+        self._sets: Dict[int, List[int]] = {0: list(world_ranks)}
+        self._next_id = 1
+
+    def add(self, ranks: Sequence[int]) -> int:
+        ranks = sorted(set(int(r) for r in ranks))
+        with self._lock:
+            psid = self._next_id
+            self._next_id += 1
+            self._sets[psid] = ranks
+            return psid
+
+    def remove(self, psid: int) -> None:
+        if psid == 0:
+            raise ValueError("cannot remove the global process set")
+        with self._lock:
+            self._sets.pop(psid, None)
+
+    def ranks(self, psid: int) -> List[int]:
+        with self._lock:
+            if psid not in self._sets:
+                raise ValueError(f"unknown process set id {psid}")
+            return list(self._sets[psid])
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return list(self._sets)
+
+
+class PyLocalCore(CoreBackend):
+    """Pure-Python core for single-process mode (and a behavioural reference
+    for the native core).  Runs the same cycle loop: drain the tensor queue
+    every ``cycle_time_ms``, fuse allreduces into buckets bounded by
+    ``fusion_threshold_bytes``, emit responses in submission order, watch for
+    stalls.  Reference analogs: operations.cc RunLoopOnce + controller.cc
+    ComputeResponseList with a single rank.
+    """
+
+    name = "pylocal"
+
+    def __init__(self):
+        self._cfg: Optional[Config] = None
+        self._queue: List[TensorEntry] = []
+        self._queue_lock = threading.Lock()
+        # entries enqueued but not yet covered by an emitted response —
+        # the population the stall inspector watches (reference:
+        # stall_inspector.cc tracks request-to-response latency per tensor)
+        self._awaiting: Dict[int, TensorEntry] = {}
+        self._responses: List[FusedResponse] = []
+        self._resp_lock = threading.Lock()
+        self._resp_cv = threading.Condition(self._resp_lock)
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._psets: Optional[_ProcessSetTable] = None
+        self.timeline = Timeline()
+        self._last_stall_warn = 0.0
+
+    def start(self, cfg: Config) -> None:
+        self._cfg = cfg
+        self._psets = _ProcessSetTable(list(range(cfg.size)))
+        if cfg.timeline_path:
+            self.timeline.start(cfg.timeline_path, cfg.timeline_mark_cycles)
+        self._thread = threading.Thread(
+            target=self._cycle_loop, name="hvd-background", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.timeline.stop()
+
+    def rank(self) -> int:
+        return self._cfg.rank if self._cfg else 0
+
+    def size(self) -> int:
+        return self._cfg.size if self._cfg else 1
+
+    def enqueue(self, entry: TensorEntry) -> None:
+        self.timeline.begin(entry.name, f"NEGOTIATE_{entry.op.name}")
+        with self._queue_lock:
+            self._queue.append(entry)
+            self._awaiting[entry.handle] = entry
+
+    def pop_response(self, timeout: float) -> Optional[FusedResponse]:
+        with self._resp_cv:
+            if not self._responses:
+                self._resp_cv.wait(timeout)
+            if self._responses:
+                return self._responses.pop(0)
+            return None
+
+    def add_process_set(self, ranks: Sequence[int]) -> int:
+        return self._psets.add(ranks)
+
+    def remove_process_set(self, psid: int) -> None:
+        self._psets.remove(psid)
+
+    def process_set_ranks(self, psid: int) -> List[int]:
+        return self._psets.ranks(psid)
+
+    # Single-rank host data plane: collectives over one rank are identities.
+    def allreduce_buffer(self, buf, psid, reduce_op):
+        return buf
+
+    def allgather_buffer(self, buf, psid):
+        return buf, np.array([buf.shape[0]], dtype=np.int64)
+
+    def broadcast_buffer(self, buf, root_rank, psid):
+        return buf
+
+    def alltoall_buffer(self, buf, splits, psid):
+        return buf, np.asarray(splits, dtype=np.int64)
+
+    def barrier(self, psid):
+        return None
+
+    def start_timeline(self, path, mark_cycles):
+        self.timeline.start(path, mark_cycles)
+
+    def stop_timeline(self):
+        self.timeline.stop()
+
+    # -- cycle loop ---------------------------------------------------------
+    def _cycle_loop(self) -> None:
+        cfg = self._cfg
+        period = max(cfg.cycle_time_ms, 0.05) / 1000.0
+        while not self._shutdown.is_set():
+            time.sleep(period)
+            self.timeline.mark_cycle()
+            with self._queue_lock:
+                pending, self._queue = self._queue, []
+            if pending:
+                responses = self._compute_responses(pending)
+                with self._queue_lock:
+                    for r in responses:
+                        for h in r.handles:
+                            self._awaiting.pop(h, None)
+                with self._resp_cv:
+                    self._responses.extend(responses)
+                    self._resp_cv.notify_all()
+            self._check_stalls()
+
+    def _compute_responses(self, pending: List[TensorEntry]) -> List[FusedResponse]:
+        """Single-rank negotiation: everything enqueued is ready; fuse
+        consecutive allreduces of matching (dtype, process set, reduce op)
+        up to the fusion threshold — same bucketing rule the native
+        controller uses."""
+        responses: List[FusedResponse] = []
+        bucket: List[TensorEntry] = []
+        bucket_bytes = 0
+
+        def flush() -> None:
+            nonlocal bucket, bucket_bytes
+            if bucket:
+                for e in bucket:
+                    self.timeline.end(e.name, f"NEGOTIATE_{e.op.name}")
+                responses.append(
+                    FusedResponse(
+                        op=OpType.ALLREDUCE,
+                        dtype=bucket[0].dtype,
+                        process_set_id=bucket[0].process_set_id,
+                        handles=[e.handle for e in bucket],
+                    )
+                )
+                bucket, bucket_bytes = [], 0
+
+        for e in pending:
+            if e.op == OpType.ALLREDUCE:
+                nbytes = int(e.array.nbytes)
+                fusable = (
+                    bucket
+                    and bucket[0].dtype == e.dtype
+                    and bucket[0].process_set_id == e.process_set_id
+                    and bucket[0].reduce_op == e.reduce_op
+                    and bucket[0].prescale_factor == e.prescale_factor
+                    and bucket[0].postscale_factor == e.postscale_factor
+                    and bucket_bytes + nbytes <= self._cfg.fusion_threshold_bytes
+                )
+                if not fusable:
+                    flush()
+                bucket.append(e)
+                bucket_bytes += nbytes
+            else:
+                flush()
+                self.timeline.end(e.name, f"NEGOTIATE_{e.op.name}")
+                responses.append(
+                    FusedResponse(
+                        op=e.op,
+                        dtype=e.dtype,
+                        process_set_id=e.process_set_id,
+                        handles=[e.handle],
+                    )
+                )
+        flush()
+        return responses
+
+    def _check_stalls(self) -> None:
+        cfg = self._cfg
+        if not cfg.stall_check_enabled:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_warn < cfg.stall_warning_s:
+            return
+        with self._queue_lock:
+            stalled = [e.name for e in self._awaiting.values()
+                       if now - e.enqueued_at > cfg.stall_warning_s]
+        if stalled:
+            self._last_stall_warn = now
+            log.warning(
+                "Stall detected: %d tensor(s) waiting > %.0fs for negotiation: %s",
+                len(stalled), cfg.stall_warning_s, ", ".join(stalled[:8]),
+            )

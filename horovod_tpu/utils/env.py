@@ -1,0 +1,152 @@
+"""Centralised HOROVOD_* environment-variable parsing.
+
+TPU-native analog of the reference's horovod/common/utils/env_parser.cc
+(ParseStallInspectorFromEnv, SetBoolFromEnv, ...; SURVEY.md §2.1).  The same
+variable names are kept wherever they are meaningful on TPU so existing
+Horovod launch scripts keep working; CUDA/NCCL-only knobs are accepted but
+ignored (listed in IGNORED_VARS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Variables that exist in the reference but have no TPU meaning. Parsed and
+# ignored (with a debug log) so reference launch scripts run unmodified.
+IGNORED_VARS = (
+    "HOROVOD_GPU_OPERATIONS",
+    "HOROVOD_CPU_OPERATIONS",
+    "HOROVOD_NUM_NCCL_STREAMS",
+    "HOROVOD_MLSL_BGT_AFFINITY",
+    "HOROVOD_GPU_ALLREDUCE",
+    "HOROVOD_GPU_ALLGATHER",
+    "HOROVOD_GPU_BROADCAST",
+    "HOROVOD_GPU_ALLTOALL",
+    "HOROVOD_ADASUM_MPI_CHUNK_SIZE",
+)
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, same default as reference
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_WARNING_S = 60.0
+DEFAULT_ELASTIC_TIMEOUT_S = 600.0
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration snapshot, one per `hvd.init()`.
+
+    Field-for-field parity with the env vars consumed by the reference core
+    (fusion threshold / cycle time / cache / autotune / timeline / stall
+    inspector), plus the rendezvous variables set by the launcher.
+    """
+
+    # Identity (set by the launcher; single-process defaults otherwise).
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+
+    # Control plane.
+    controller: str = "auto"  # auto | local | socket
+    rendezvous_addr: str = "127.0.0.1"
+    rendezvous_port: int = 0
+
+    # Core tuning.
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    cache_enabled: bool = True
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+
+    # Observability.
+    timeline_path: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    log_level: str = "warning"
+
+    # Stall inspector.
+    stall_check_enabled: bool = True
+    stall_warning_s: float = DEFAULT_STALL_WARNING_S
+    stall_shutdown_s: float = 0.0  # 0 = never shut down
+
+    # Elastic.
+    elastic_timeout_s: float = DEFAULT_ELASTIC_TIMEOUT_S
+    elastic_enabled: bool = False
+
+    # Native core selection (TPU-build specific).
+    force_pure_python: bool = False
+
+    @staticmethod
+    def from_env() -> "Config":
+        env = os.environ
+        return Config(
+            rank=get_int("HOROVOD_RANK", 0),
+            size=get_int("HOROVOD_SIZE", 1),
+            local_rank=get_int("HOROVOD_LOCAL_RANK", 0),
+            local_size=get_int("HOROVOD_LOCAL_SIZE", 1),
+            cross_rank=get_int("HOROVOD_CROSS_RANK", 0),
+            cross_size=get_int("HOROVOD_CROSS_SIZE", 1),
+            controller=env.get("HOROVOD_CONTROLLER", "auto").lower(),
+            # Same variable names the reference's Gloo rendezvous uses
+            # (SURVEY.md §1 control-plane env vars) so launcher scripts match.
+            rendezvous_addr=env.get(
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR",
+                env.get("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1"),
+            ),
+            rendezvous_port=get_int(
+                "HOROVOD_GLOO_RENDEZVOUS_PORT", get_int("HOROVOD_RENDEZVOUS_PORT", 0)
+            ),
+            fusion_threshold_bytes=get_int(
+                "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD
+            ),
+            cycle_time_ms=get_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=get_int("HOROVOD_CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY),
+            cache_enabled=get_int("HOROVOD_CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY) > 0,
+            autotune=get_bool("HOROVOD_AUTOTUNE", False),
+            autotune_log=env.get("HOROVOD_AUTOTUNE_LOG"),
+            timeline_path=env.get("HOROVOD_TIMELINE"),
+            timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
+            log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+            stall_check_enabled=not get_bool("HOROVOD_STALL_CHECK_DISABLE", False),
+            stall_warning_s=get_float(
+                "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_WARNING_S
+            ),
+            stall_shutdown_s=get_float("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0),
+            elastic_timeout_s=get_float(
+                "HOROVOD_ELASTIC_TIMEOUT", DEFAULT_ELASTIC_TIMEOUT_S
+            ),
+            elastic_enabled=get_bool("HOROVOD_ELASTIC", False),
+            force_pure_python=get_bool("HVD_TPU_PURE_PY", False),
+        )

@@ -1,0 +1,46 @@
+"""Leveled logging, analog of the reference's horovod/common/logging.cc.
+
+Controlled by HOROVOD_LOG_LEVEL (trace|debug|info|warning|error|fatal) and
+HOROVOD_LOG_TIMESTAMP, same contract as the reference core.  The native core
+has its own C++ logger with the same env contract; this is the Python side.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(5, "TRACE")
+
+_logger: logging.Logger | None = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is not None:
+        return _logger
+    logger = logging.getLogger("horovod_tpu")
+    level = _LEVELS.get(os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+                        logging.WARNING)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        if os.environ.get("HOROVOD_LOG_TIMESTAMP", "1") not in ("0", "false"):
+            fmt = "[%(asctime)s] [hvd-tpu] [%(levelname)s] %(message)s"
+        else:
+            fmt = "[hvd-tpu] [%(levelname)s] %(message)s"
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+    logger.propagate = False
+    _logger = logger
+    return logger

@@ -1,0 +1,126 @@
+"""Wire-level enums shared between the Python layer and the C++ core.
+
+These integer values are the ABI of libhvd_tpu_core.so (horovod_tpu/cpp/common.h)
+and of the socket negotiation protocol — keep them in sync with the C++ side.
+
+Reference analog: horovod/common/message.h (Request::RequestType,
+Response::ResponseType, DataType) — SURVEY.md §2.1 "Wire messages".
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class OpType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    ALLTOALL = 3
+    REDUCESCATTER = 4
+    BARRIER = 5
+    JOIN = 6
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction selector for allreduce/reducescatter.
+
+    AVERAGE is implemented as SUM followed by division by the process-set size
+    (applied in the data plane, matching the reference's postscale handling).
+    """
+
+    AVERAGE = 0
+    SUM = 1
+    MIN = 2
+    MAX = 3
+    PRODUCT = 4
+    # Adasum-equivalent scale-invariant reduction (reference:
+    # horovod/common/ops/adasum/*): implemented in the XLA data plane.
+    ADASUM = 5
+
+
+# Public aliases with the reference's names (hvd.Average, hvd.Sum, ...).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+Adasum = ReduceOp.ADASUM
+
+
+class DataType(enum.IntEnum):
+    UINT8 = 0
+    INT8 = 1
+    INT32 = 2
+    INT64 = 3
+    FLOAT16 = 4
+    FLOAT32 = 5
+    FLOAT64 = 6
+    BOOL = 7
+    BFLOAT16 = 8
+    UINT16 = 9
+    INT16 = 10
+
+
+class StatusCode(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+_NUMPY_TO_WIRE = {
+    np.dtype(np.uint8): DataType.UINT8,
+    np.dtype(np.int8): DataType.INT8,
+    np.dtype(np.uint16): DataType.UINT16,
+    np.dtype(np.int16): DataType.INT16,
+    np.dtype(np.int32): DataType.INT32,
+    np.dtype(np.int64): DataType.INT64,
+    np.dtype(np.float16): DataType.FLOAT16,
+    np.dtype(np.float32): DataType.FLOAT32,
+    np.dtype(np.float64): DataType.FLOAT64,
+    np.dtype(np.bool_): DataType.BOOL,
+}
+
+_WIRE_TO_NUMPY = {v: k for k, v in _NUMPY_TO_WIRE.items()}
+
+_ITEMSIZE = {
+    DataType.UINT8: 1,
+    DataType.INT8: 1,
+    DataType.BOOL: 1,
+    DataType.UINT16: 2,
+    DataType.INT16: 2,
+    DataType.FLOAT16: 2,
+    DataType.BFLOAT16: 2,
+    DataType.INT32: 4,
+    DataType.FLOAT32: 4,
+    DataType.INT64: 8,
+    DataType.FLOAT64: 8,
+}
+
+
+def wire_dtype(dtype) -> DataType:
+    """Map a numpy/JAX dtype to the wire enum (bfloat16-aware)."""
+    name = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+    if name == "bfloat16":
+        return DataType.BFLOAT16
+    try:
+        return _NUMPY_TO_WIRE[np.dtype(dtype)]
+    except (KeyError, TypeError) as exc:
+        raise ValueError(f"unsupported dtype for collective: {dtype!r}") from exc
+
+
+def numpy_dtype(wire: DataType):
+    if wire == DataType.BFLOAT16:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return _WIRE_TO_NUMPY[DataType(wire)]
+
+
+def itemsize(wire: DataType) -> int:
+    return _ITEMSIZE[DataType(wire)]

@@ -1,0 +1,58 @@
+"""Single-process lifecycle/identity tests (reference analog: the np=1
+slices of test/parallel/test_torch.py plus basics coverage; SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_init_shutdown_cycle():
+    hvd.init()
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.is_homogeneous()
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    # re-init after shutdown must work
+    hvd.init()
+    assert hvd.is_initialized()
+    hvd.shutdown()
+
+
+def test_double_init_is_idempotent():
+    hvd.init()
+    hvd.init()
+    assert hvd.size() == 1
+    hvd.shutdown()
+
+
+def test_uninitialized_raises():
+    with pytest.raises(ValueError):
+        hvd.rank()
+
+
+def test_build_queries():
+    assert hvd.tpu_built()
+    assert not hvd.nccl_built()
+    assert not hvd.cuda_built()
+    assert not hvd.mpi_built()
+    assert not hvd.mpi_enabled()
+    assert hvd.gloo_built()
+
+
+def test_timeline(tmp_path, hvd_single):
+    import json
+
+    path = str(tmp_path / "timeline.json")
+    hvd.start_timeline(path, mark_cycles=True)
+    x = np.ones(4, dtype=np.float32)
+    hvd.allreduce(x, name="timeline.t0")
+    hvd.stop_timeline()
+    with open(path) as f:
+        events = json.load(f)
+    assert any(ev.get("args", {}).get("tensor") == "timeline.t0" for ev in events
+               if ev.get("ph") == "B")

@@ -1,0 +1,179 @@
+"""Eager (enqueue-path) collective semantics at np=1.
+
+The reference's parallel suite runs every op x dtype x scale combination
+(test/parallel/test_torch.py); at one rank the expected values are exact, so
+these pin the contract cheaply.  Multi-process variants live in
+tests/parallel.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+DTYPES = [np.float32, np.float64, np.float16, np.int32, np.int64, np.uint8]
+
+
+@pytest.mark.usefixtures("hvd_single")
+class TestEagerOps:
+    def test_allreduce_average_identity(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = hvd.allreduce(x, name="ar.avg")
+        np.testing.assert_allclose(out, x)
+
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_allreduce_sum_dtypes(self, dtype):
+        x = (np.arange(8) % 5).astype(dtype)
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"ar.sum.{np.dtype(dtype).name}")
+        np.testing.assert_array_equal(out, x)
+        assert out.dtype == x.dtype
+
+    @pytest.mark.parametrize("op", [hvd.Min, hvd.Max, hvd.Product])
+    def test_allreduce_minmaxprod(self, op):
+        x = np.array([1.0, -2.0, 3.0], dtype=np.float32)
+        out = hvd.allreduce(x, op=op, name=f"ar.{op.name}")
+        np.testing.assert_allclose(out, x)
+
+    def test_allreduce_average_int_raises(self):
+        with pytest.raises(ValueError):
+            hvd.allreduce(np.ones(3, dtype=np.int32), op=hvd.Average)
+
+    def test_allreduce_prescale_postscale(self):
+        x = np.full(5, 2.0, dtype=np.float32)
+        out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                            postscale_factor=4.0, name="ar.scale")
+        np.testing.assert_allclose(out, x * 0.5 * 4.0)
+
+    def test_allreduce_bf16(self):
+        import jax.numpy as jnp
+
+        x = jnp.ones((4, 4), dtype=jnp.bfloat16) * 3
+        out = hvd.allreduce(x, op=hvd.Sum, name="ar.bf16")
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, dtype=np.float32), 3.0)
+
+    def test_allreduce_jax_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.linspace(0, 1, 16).reshape(4, 4)
+        out = hvd.allreduce(x, name="ar.jax")
+        assert isinstance(out, jax.Array)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+    def test_allreduce_async_poll(self):
+        import time
+
+        x = np.ones(3, dtype=np.float32)
+        h = hvd.allreduce_async(x, name="ar.async")
+        deadline = time.monotonic() + 10
+        while not hvd.poll(h) and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert hvd.poll(h)
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(out, x)
+        # handle is released after synchronize
+        with pytest.raises(ValueError):
+            hvd.poll(h)
+
+    def test_grouped_allreduce(self):
+        xs = [np.full(4, float(i), dtype=np.float32) for i in range(5)]
+        outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name="ar.grouped")
+        assert len(outs) == 5
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o, xs[i])
+
+    def test_fusion_many_small_tensors(self):
+        # Reference-style fusion exercise: many small tensors in flight at
+        # once must all complete correctly (test/parallel pattern).
+        handles = [
+            hvd.allreduce_async(np.full(16, float(i), dtype=np.float32),
+                                op=hvd.Sum, name=f"fuse.{i}")
+            for i in range(64)
+        ]
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(hvd.synchronize(h), float(i))
+
+    def test_allgather(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = hvd.allgather(x, name="ag.0")
+        np.testing.assert_allclose(out, x)
+
+    def test_broadcast(self):
+        x = np.arange(4, dtype=np.int64)
+        out = hvd.broadcast(x, root_rank=0, name="bc.0")
+        np.testing.assert_array_equal(out, x)
+
+    def test_alltoall_with_splits(self):
+        x = np.arange(10, dtype=np.float32).reshape(5, 2)
+        out, recv_splits = hvd.alltoall(x, splits=[5], name="a2a.0")
+        np.testing.assert_allclose(out, x)
+        np.testing.assert_array_equal(recv_splits, [5])
+
+    def test_alltoall_bad_splits_raises(self):
+        x = np.arange(10, dtype=np.float32).reshape(5, 2)
+        with pytest.raises(hvd.HorovodInternalError):
+            hvd.alltoall(x, splits=[3], name="a2a.bad")
+
+    def test_reducescatter(self):
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        out = hvd.reducescatter(x, op=hvd.Sum, name="rs.0")
+        np.testing.assert_allclose(out, x)
+
+    def test_barrier(self):
+        hvd.barrier()
+
+    def test_duplicate_inflight_name_raises(self):
+        h = hvd.allreduce_async(np.ones(2, np.float32), name="dup")
+        with pytest.raises(ValueError):
+            hvd.allreduce_async(np.ones(2, np.float32), name="dup")
+        hvd.synchronize(h)
+
+    def test_compression_fp16(self):
+        x = np.linspace(-1, 1, 64, dtype=np.float32)
+        out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16,
+                            name="ar.fp16")
+        assert np.asarray(out).dtype == np.float32
+        np.testing.assert_allclose(out, x, atol=1e-3)
+
+
+@pytest.mark.usefixtures("hvd_single")
+class TestObjects:
+    def test_broadcast_object(self):
+        obj = {"a": 1, "b": [1, 2, 3], "c": "hello"}
+        assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+    def test_allgather_object(self):
+        out = hvd.allgather_object({"rank": hvd.rank()})
+        assert out == [{"rank": 0}]
+
+    def test_broadcast_parameters(self):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((3, 3)), "b": jnp.zeros(3)}
+        out = hvd.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+@pytest.mark.usefixtures("hvd_single")
+class TestProcessSets:
+    def test_global_set(self):
+        ps = hvd.global_process_set
+        assert ps.process_set_id == 0
+        assert ps.included()
+        assert ps.rank() == 0
+        assert ps.size() == 1
+
+    def test_add_remove(self):
+        ps = hvd.add_process_set([0])
+        assert ps.process_set_id is not None
+        assert ps.included()
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            process_set=ps, name="ps.ar")
+        np.testing.assert_allclose(out, 1.0)
+        assert hvd.remove_process_set(ps)
+        assert not hvd.remove_process_set(hvd.global_process_set)
+
+    def test_add_invalid_rank_raises(self):
+        with pytest.raises(ValueError):
+            hvd.add_process_set([0, 5])

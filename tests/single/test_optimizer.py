@@ -1,0 +1,165 @@
+"""DistributedOptimizer semantics (reference analog: the optimizer slices of
+test/parallel/test_torch.py + gradient_aggregation tests; SURVEY.md §3.3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+
+pytestmark = pytest.mark.usefixtures("hvd_single")
+
+N_DEV = 8
+
+
+def test_distributed_optimizer_eager_matches_plain_sgd():
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+    grads = {"w": jnp.full(4, 2.0), "b": jnp.ones(2)}
+    state = tx.init(params)
+    updates, state = tx.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    # size()==1: average is identity, so this must equal plain SGD
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 1.0 - 0.1 * 2.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_params["b"]), -0.1, rtol=1e-6)
+
+
+def test_distributed_optimizer_in_jit_averages_across_mesh():
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("hvd",))
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), axis_name="hvd")
+    params = jnp.zeros(N_DEV)
+
+    def per_rank(p, g):
+        state = tx.init(p)
+        updates, _ = tx.update(g, state, p)
+        return optax.apply_updates(p, updates)
+
+    # per-rank grad = rank index; average = 3.5; update = -3.5 everywhere
+    grads = jnp.arange(N_DEV, dtype=jnp.float32)
+    out = shard_map(per_rank, mesh=mesh, in_specs=(P(), P("hvd")),
+                    out_specs=P())(params, grads)
+    np.testing.assert_allclose(np.asarray(out), -3.5, rtol=1e-6)
+
+
+def test_backward_passes_per_step_eager():
+    k = 3
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=k)
+    params = jnp.zeros(2)
+    state = tx.init(params)
+    grads = jnp.ones(2)
+    p = params
+    for i in range(k - 1):
+        updates, state = tx.update(grads, state, p)
+        p = optax.apply_updates(p, updates)
+        np.testing.assert_allclose(np.asarray(p), 0.0)  # held
+    updates, state = tx.update(grads, state, p)
+    p = optax.apply_updates(p, updates)
+    # accumulated k*1.0, divided by k -> average grad 1.0, lr 1.0
+    np.testing.assert_allclose(np.asarray(p), -1.0, rtol=1e-6)
+    # counter reset: next k-1 steps hold again
+    updates, state = tx.update(grads, state, p)
+    np.testing.assert_allclose(np.asarray(optax.apply_updates(p, updates)),
+                               np.asarray(p))
+
+
+def test_backward_passes_per_step_jit():
+    k = 2
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("hvd",))
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=k,
+                                  axis_name="hvd")
+
+    def per_rank(p, g):
+        state = tx.init(p)
+        u1, state = tx.update(g, state, p)
+        p1 = optax.apply_updates(p, u1)
+        u2, state = tx.update(g, state, p1)
+        return optax.apply_updates(p1, u2)
+
+    grads = jnp.arange(N_DEV, dtype=jnp.float32)
+    out = shard_map(per_rank, mesh=mesh, in_specs=(P(), P("hvd")),
+                    out_specs=P(), check_vma=False)(jnp.zeros(N_DEV), grads)
+    # two identical passes accumulated, /k -> mean grad 3.5, one update
+    np.testing.assert_allclose(np.asarray(out), -3.5, rtol=1e-6)
+
+
+def test_gradient_predivide_factor():
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), gradient_predivide_factor=2.0)
+    params = jnp.zeros(3)
+    state = tx.init(params)
+    grads = jnp.full(3, 4.0)
+    updates, _ = tx.update(grads, state, params)
+    # predivide by 2, sum over 1 rank, postscale 2 / size 1 -> net identity
+    np.testing.assert_allclose(np.asarray(optax.apply_updates(params, updates)),
+                               -4.0, rtol=1e-6)
+
+
+def test_predivide_requires_average():
+    with pytest.raises(ValueError):
+        hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Sum,
+                                 gradient_predivide_factor=2.0)
+
+
+def test_allreduce_gradients_helper():
+    grads = {"a": jnp.ones(3), "b": jnp.full(2, 5.0)}
+    out = hvd.allreduce_gradients(grads)
+    np.testing.assert_allclose(np.asarray(out["a"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["b"]), 5.0)
+
+
+def test_compression_in_optimizer():
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                  compression=hvd.Compression.fp16)
+    params = jnp.zeros(4)
+    state = tx.init(params)
+    grads = jnp.full(4, 0.5)
+    updates, _ = tx.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(optax.apply_updates(params, updates)),
+                               -0.5, atol=1e-3)
+
+
+def test_mnist_mlp_end_to_end_sharded():
+    """The BASELINE.json config-1 smoke test: MNIST-style MLP trained
+    data-parallel over the mesh with DistributedOptimizer."""
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("hvd",))
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 32).astype(np.float32)
+    y = (rng.rand(64) * 10).astype(np.int32)
+
+    params = {
+        "w1": jnp.asarray(rng.randn(32, 64).astype(np.float32) * 0.1),
+        "b1": jnp.zeros(64),
+        "w2": jnp.asarray(rng.randn(64, 10).astype(np.float32) * 0.1),
+        "b2": jnp.zeros(10),
+    }
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd")
+
+    def loss_fn(p, xb, yb):
+        h = jax.nn.relu(xb @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+    def step(p, state, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        updates, state = tx.update(grads, state, p)
+        return optax.apply_updates(p, updates), state, hvd.allreduce(
+            loss, axis_name="hvd")
+
+    state = tx.init(params)
+    sharded_step = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    jitted = jax.jit(sharded_step)
+    losses = []
+    p, s = params, state
+    for _ in range(5):
+        p, s, loss = jitted(p, s, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"

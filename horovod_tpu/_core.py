@@ -1,0 +1,307 @@
+"""ctypes binding over the native core (libhvd_tpu_core.so).
+
+Python analog of the reference's HorovodBasics ctypes facade
+(horovod/common/basics.py; SURVEY.md §2.4), except the library here is the
+TPU-native core (horovod_tpu/cpp/) rather than a per-framework build.  The
+library is built on demand with `make` the first time it is needed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .runtime import CoreBackend, FusedResponse, TensorEntry
+from .utils.env import Config
+from .utils.logging import get_logger
+from .wire import DataType, OpType, ReduceOp, wire_dtype
+
+log = get_logger()
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libhvd_tpu_core.so")
+
+_LOG_LEVELS = {"trace": 0, "debug": 1, "info": 2, "warning": 3, "error": 4,
+               "fatal": 5}
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            log.info("building native core in %s", _CPP_DIR)
+            subprocess.run(["make", "-s"], cwd=_CPP_DIR, check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
+        _declare(lib)
+        _lib = lib
+        return lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.hvd_init.restype = c.c_int
+    lib.hvd_init.argtypes = [
+        c.c_int, c.c_int, c.c_int, c.c_int,        # rank size local_rank local_size
+        c.c_char_p, c.c_char_p, c.c_int,           # controller addr port
+        c.c_double, c.c_longlong, c.c_int, c.c_int,  # cycle fusion cache autotune
+        c.c_char_p, c.c_char_p, c.c_int,           # autotune_log timeline mark
+        c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
+    ]
+    lib.hvd_shutdown.restype = c.c_int
+    lib.hvd_is_initialized.restype = c.c_int
+    lib.hvd_rank.restype = c.c_int
+    lib.hvd_size.restype = c.c_int
+    lib.hvd_enqueue.restype = c.c_longlong
+    lib.hvd_enqueue.argtypes = [
+        c.c_longlong, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_longlong,
+        c.POINTER(c.c_longlong), c.c_int, c.c_int, c.c_int, c.c_double,
+        c.c_double, c.POINTER(c.c_longlong), c.c_int,
+    ]
+    lib.hvd_pop_response.restype = c.c_int
+    lib.hvd_pop_response.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.hvd_allreduce_buffer.restype = c.c_int
+    lib.hvd_allreduce_buffer.argtypes = [
+        c.c_longlong, c.c_void_p, c.c_longlong, c.c_int, c.c_int, c.c_int]
+    lib.hvd_allgather_buffer.restype = c.c_int
+    lib.hvd_allgather_buffer.argtypes = [
+        c.c_longlong, c.c_void_p, c.c_longlong, c.c_int,
+        c.POINTER(c.c_void_p), c.POINTER(c.c_longlong),
+        c.POINTER(c.c_longlong), c.c_int, c.POINTER(c.c_int)]
+    lib.hvd_broadcast_buffer.restype = c.c_int
+    lib.hvd_broadcast_buffer.argtypes = [
+        c.c_longlong, c.c_void_p, c.c_longlong, c.c_int, c.c_int]
+    lib.hvd_alltoall_buffer.restype = c.c_int
+    lib.hvd_alltoall_buffer.argtypes = [
+        c.c_longlong, c.c_void_p, c.POINTER(c.c_longlong), c.c_int,
+        c.c_longlong, c.c_int, c.POINTER(c.c_void_p),
+        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong), c.POINTER(c.c_int)]
+    lib.hvd_barrier.restype = c.c_int
+    lib.hvd_barrier.argtypes = [c.c_longlong, c.c_int]
+    lib.hvd_free.argtypes = [c.c_void_p]
+    lib.hvd_add_process_set.restype = c.c_int
+    lib.hvd_add_process_set.argtypes = [c.POINTER(c.c_int), c.c_int]
+    lib.hvd_remove_process_set.restype = c.c_int
+    lib.hvd_remove_process_set.argtypes = [c.c_int]
+    lib.hvd_process_set_ranks.restype = c.c_int
+    lib.hvd_process_set_ranks.argtypes = [c.c_int, c.POINTER(c.c_int), c.c_int]
+    lib.hvd_start_timeline.argtypes = [c.c_char_p, c.c_int]
+    lib.hvd_stop_timeline.argtypes = []
+    lib.hvd_last_error.restype = c.c_char_p
+
+
+class NativeCoreError(RuntimeError):
+    pass
+
+
+class NativeCore(CoreBackend):
+    """The C++ core as a CoreBackend: negotiation, fusion, caching, stall
+    inspection and the host data plane all run natively; Python only packs
+    fusion buffers and runs device-side XLA programs."""
+
+    name = "native"
+
+    def __init__(self):
+        self._lib = _load_library()
+        self._cfg: Optional[Config] = None
+        self._current_seq = -1
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, cfg: Config) -> None:
+        self._cfg = cfg
+        controller = cfg.controller
+        if controller in ("auto",):
+            controller = "socket" if cfg.size > 1 else "local"
+        rc = self._lib.hvd_init(
+            cfg.rank, cfg.size, cfg.local_rank, cfg.local_size,
+            controller.encode(), cfg.rendezvous_addr.encode(),
+            cfg.rendezvous_port, cfg.cycle_time_ms,
+            cfg.fusion_threshold_bytes, cfg.cache_capacity,
+            1 if cfg.autotune else 0,
+            (cfg.autotune_log or "").encode(),
+            (cfg.timeline_path or "").encode(),
+            1 if cfg.timeline_mark_cycles else 0,
+            cfg.stall_warning_s if cfg.stall_check_enabled else 0.0,
+            cfg.stall_shutdown_s,
+            _LOG_LEVELS.get(cfg.log_level, 3),
+        )
+        if rc != 0:
+            raise NativeCoreError(
+                f"native core init failed (rc={rc}): {self._last_error()}")
+
+    def shutdown(self) -> None:
+        if self._lib.hvd_is_initialized():
+            self._lib.hvd_shutdown()
+
+    def _last_error(self) -> str:
+        msg = self._lib.hvd_last_error()
+        return msg.decode() if msg else "unknown"
+
+    def rank(self) -> int:
+        return self._lib.hvd_rank()
+
+    def size(self) -> int:
+        return self._lib.hvd_size()
+
+    # -- control plane ------------------------------------------------------
+    def enqueue(self, entry: TensorEntry) -> None:
+        shape = (ctypes.c_longlong * max(len(entry.array.shape), 1))(
+            *entry.array.shape)
+        if entry.splits is not None:
+            splits = (ctypes.c_longlong * len(entry.splits))(
+                *[int(s) for s in entry.splits])
+            nsplits = len(entry.splits)
+        else:
+            splits = None
+            nsplits = 0
+        rc = self._lib.hvd_enqueue(
+            entry.handle, entry.name.encode(), int(entry.op),
+            int(entry.dtype), int(entry.reduce_op), entry.array.nbytes,
+            shape, len(entry.array.shape), entry.process_set_id,
+            entry.root_rank, entry.prescale_factor, entry.postscale_factor,
+            splits, nsplits)
+        if rc == -2:
+            raise ValueError(f"duplicate in-flight tensor name {entry.name!r}")
+        if rc != 0:
+            raise NativeCoreError(f"enqueue failed rc={rc}")
+
+    def pop_response(self, timeout: float) -> Optional[FusedResponse]:
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_pop_response(buf, cap, int(timeout * 1000))
+        if n <= 0:
+            return None
+        obj = json.loads(buf.raw[:n].decode())
+        self._current_seq = obj.get("seq", -1)
+        return FusedResponse(
+            op=OpType(obj["op"]),
+            dtype=DataType(obj["dtype"]),
+            process_set_id=obj["psid"],
+            handles=list(obj["handles"]),
+            error=obj["error"] or None,
+        )
+
+    # -- process sets -------------------------------------------------------
+    def add_process_set(self, ranks: Sequence[int]) -> int:
+        arr = (ctypes.c_int * len(ranks))(*[int(r) for r in ranks])
+        psid = self._lib.hvd_add_process_set(arr, len(ranks))
+        if psid < 0:
+            raise NativeCoreError("add_process_set failed")
+        return psid
+
+    def remove_process_set(self, process_set_id: int) -> None:
+        self._lib.hvd_remove_process_set(process_set_id)
+
+    def process_set_ranks(self, process_set_id: int) -> List[int]:
+        cap = max(self.size(), 1)
+        out = (ctypes.c_int * cap)()
+        n = self._lib.hvd_process_set_ranks(process_set_id, out, cap)
+        if n < 0:
+            raise ValueError(f"unknown process set id {process_set_id}")
+        return [out[i] for i in range(n)]
+
+    # -- host data plane ----------------------------------------------------
+    def _check(self, rc: int, what: str) -> None:
+        if rc != 0:
+            from .exceptions import HorovodInternalError
+
+            raise HorovodInternalError(
+                f"{what} failed (rc={rc}): {self._last_error()}")
+
+    def allreduce_buffer(self, buf: np.ndarray, psid: int,
+                         reduce_op: ReduceOp) -> np.ndarray:
+        buf = np.ascontiguousarray(buf)
+        rc = self._lib.hvd_allreduce_buffer(
+            self._current_seq, buf.ctypes.data_as(ctypes.c_void_p), buf.size,
+            int(wire_dtype(buf.dtype)), int(reduce_op), psid)
+        self._check(rc, "allreduce")
+        return buf
+
+    def allgather_buffer(self, buf: np.ndarray, psid: int):
+        buf = np.ascontiguousarray(buf)
+        d0 = buf.shape[0] if buf.ndim else 1
+        row_bytes = (buf.nbytes // d0) if d0 > 0 else int(
+            np.prod(buf.shape[1:], dtype=np.int64) * buf.itemsize) or buf.itemsize
+        out_ptr = ctypes.c_void_p()
+        out_len = ctypes.c_longlong()
+        cap = max(self.size(), 1)
+        counts = (ctypes.c_longlong * cap)()
+        n_counts = ctypes.c_int()
+        rc = self._lib.hvd_allgather_buffer(
+            self._current_seq, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
+            psid, ctypes.byref(out_ptr), ctypes.byref(out_len), counts, cap,
+            ctypes.byref(n_counts))
+        self._check(rc, "allgather")
+        try:
+            raw = ctypes.string_at(out_ptr.value, out_len.value) \
+                if out_len.value else b""
+        finally:
+            self._lib.hvd_free(out_ptr)
+        flat = np.frombuffer(raw, dtype=buf.dtype).copy()
+        rows = flat.size // (row_bytes // buf.itemsize) if row_bytes else 0
+        stacked = flat.reshape(rows, -1) if rows else flat.reshape(0, 1)
+        row_counts = np.array(
+            [counts[i] // row_bytes for i in range(n_counts.value)],
+            dtype=np.int64)
+        return stacked, row_counts
+
+    def broadcast_buffer(self, buf: np.ndarray, root_rank: int,
+                         psid: int) -> np.ndarray:
+        buf = np.ascontiguousarray(buf).copy()
+        rc = self._lib.hvd_broadcast_buffer(
+            self._current_seq, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
+            root_rank, psid)
+        self._check(rc, "broadcast")
+        return buf
+
+    def alltoall_buffer(self, buf: np.ndarray, splits: np.ndarray,
+                        psid: int):
+        buf = np.ascontiguousarray(buf)
+        d0 = buf.shape[0] if buf.ndim else 1
+        row_bytes = (buf.nbytes // d0) if d0 > 0 else buf.itemsize
+        csplits = (ctypes.c_longlong * len(splits))(*[int(s) for s in splits])
+        out_ptr = ctypes.c_void_p()
+        out_len = ctypes.c_longlong()
+        cap = max(len(splits), 1)
+        recv = (ctypes.c_longlong * cap)()
+        n_recv = ctypes.c_int()
+        rc = self._lib.hvd_alltoall_buffer(
+            self._current_seq, buf.ctypes.data_as(ctypes.c_void_p), csplits,
+            len(splits), row_bytes, psid, ctypes.byref(out_ptr),
+            ctypes.byref(out_len), recv, ctypes.byref(n_recv))
+        self._check(rc, "alltoall")
+        try:
+            raw = ctypes.string_at(out_ptr.value, out_len.value) \
+                if out_len.value else b""
+        finally:
+            self._lib.hvd_free(out_ptr)
+        flat = np.frombuffer(raw, dtype=buf.dtype).copy()
+        recv_splits = np.array([recv[i] for i in range(n_recv.value)],
+                               dtype=np.int64)
+        total_rows = int(recv_splits.sum())
+        out = flat.reshape(total_rows, -1) if total_rows else flat.reshape(0, 1)
+        return out, recv_splits
+
+    def barrier(self, process_set_id: int) -> None:
+        rc = self._lib.hvd_barrier(self._current_seq, process_set_id)
+        self._check(rc, "barrier")
+
+    # -- observability ------------------------------------------------------
+    def start_timeline(self, path: str, mark_cycles: bool) -> None:
+        self._lib.hvd_start_timeline(path.encode(), 1 if mark_cycles else 0)
+
+    def stop_timeline(self) -> None:
+        self._lib.hvd_stop_timeline()

@@ -1,0 +1,143 @@
+// Shared types for the native core.
+//
+// TPU-native re-implementation of the reference core's message/type layer
+// (horovod/common/common.h, message.h — DataType, Request/Response types;
+// SURVEY.md §2.1).  Enum values are ABI shared with horovod_tpu/wire.py —
+// keep them in sync.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class OpType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  REDUCESCATTER = 4,
+  BARRIER = 5,
+  JOIN = 6,
+};
+
+enum class ReduceOp : int32_t {
+  AVERAGE = 0,
+  SUM = 1,
+  MIN = 2,
+  MAX = 3,
+  PRODUCT = 4,
+  ADASUM = 5,
+};
+
+enum class DataType : int32_t {
+  UINT8 = 0,
+  INT8 = 1,
+  INT32 = 2,
+  INT64 = 3,
+  FLOAT16 = 4,
+  FLOAT32 = 5,
+  FLOAT64 = 6,
+  BOOL = 7,
+  BFLOAT16 = 8,
+  UINT16 = 9,
+  INT16 = 10,
+};
+
+inline int ItemSize(DataType t) {
+  switch (t) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::UINT16:
+    case DataType::INT16:
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64:
+    case DataType::FLOAT64:
+      return 8;
+  }
+  return 1;
+}
+
+enum class StatusCode : int32_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+struct Status {
+  StatusCode code = StatusCode::OK;
+  std::string reason;
+  bool ok() const { return code == StatusCode::OK; }
+  static Status OK() { return Status{}; }
+  static Status Error(StatusCode c, std::string r) { return Status{c, std::move(r)}; }
+};
+
+// One enqueued collective request (reference: Request in message.h +
+// TensorTableEntry in common.h).  The core never owns tensor *data* — the
+// data plane moves bytes (socket path) or is an XLA program (device path);
+// the core owns *negotiation metadata* only.
+struct TensorRequest {
+  int64_t handle = 0;          // per-process handle (Python side registry)
+  std::string name;            // globally unique key for negotiation
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  int64_t nbytes = 0;          // payload size (fusion accounting)
+  std::vector<int64_t> shape;  // for cross-rank validation
+  int32_t process_set_id = 0;
+  int32_t root_rank = 0;       // broadcast
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<int64_t> splits; // alltoall send splits
+  double enqueued_at = 0.0;    // monotonic seconds (stall inspection)
+};
+
+// A negotiated unit of work: one tensor or a fused bucket of allreduces
+// (reference: Response in message.h).
+struct Response {
+  OpType op = OpType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  int32_t process_set_id = 0;
+  std::vector<std::string> names;    // global agreement keyed by name
+  std::vector<TensorRequest> metas;  // full metadata (cache determinism)
+  std::vector<int64_t> handles;      // local handles (filled per rank)
+  std::string error;                 // non-empty -> deliver failure
+  bool cache_hit = false;
+  int64_t seq = -1;  // global data-op sequence (tags data-plane frames)
+};
+
+struct CoreConfig {
+  int rank = 0;
+  int size = 1;
+  int local_rank = 0;
+  int local_size = 1;
+  std::string controller = "auto";   // local | socket
+  std::string rendezvous_addr = "127.0.0.1";
+  int rendezvous_port = 0;
+  double cycle_time_ms = 1.0;
+  int64_t fusion_threshold = 64LL * 1024 * 1024;
+  int cache_capacity = 1024;
+  bool autotune = false;
+  std::string autotune_log;
+  std::string timeline_path;
+  bool timeline_mark_cycles = false;
+  double stall_warn_s = 60.0;
+  double stall_shutdown_s = 0.0;
+  int log_level = 2;  // 0=trace .. 5=fatal
+};
+
+double MonotonicSeconds();
+
+}  // namespace hvdtpu

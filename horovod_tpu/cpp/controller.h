@@ -1,0 +1,122 @@
+// Controller: readiness negotiation + fusion + process sets + data plane.
+//
+// Reference: horovod/common/controller.h (Controller::ComputeResponseList),
+// process_set.h (ProcessSetTable); SURVEY.md §2.1.  Two implementations:
+// LocalController (single process — everything is immediately ready) and
+// SocketController (rank-0 coordinator over TCP with response-cache
+// bit-vectors and a coordinator-rooted host data plane, the Gloo-CPU-path
+// analog).  On TPU pods the *device* data plane is XLA-over-ICI (driven from
+// Python); the controller's job is to keep hosts in lockstep so every host
+// dispatches the same fused XLA program.
+#pragma once
+
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+class ProcessSetTable {
+ public:
+  void InitGlobal(int world_size);
+  int Add(const std::vector<int>& ranks);
+  void Remove(int id);
+  bool Ranks(int id, std::vector<int>* out) const;
+  bool Contains(int id, int rank) const;
+
+ private:
+  mutable std::mutex mu_;
+  std::map<int, std::vector<int>> sets_;
+  int next_id_ = 1;
+};
+
+// Deterministic fusion: group consecutive ready allreduces that share
+// (dtype, process set, reduce op, pre/postscale) into buckets bounded by
+// fusion_threshold bytes (reference: fusion_buffer_manager.h + the bucketing
+// in Controller::ComputeResponseList).  Identical input order on every rank
+// yields byte-identical responses.
+std::vector<Response> FuseRequests(const std::vector<TensorRequest>& ready,
+                                   int64_t fusion_threshold);
+
+class Controller {
+ public:
+  explicit Controller(const CoreConfig& cfg) : cfg_(cfg) {}
+  virtual ~Controller() = default;
+
+  virtual Status Initialize() = 0;
+  virtual void Shutdown() {}
+
+  // One negotiation cycle: feed newly enqueued local requests, receive the
+  // globally agreed (identical on all ranks) response list.
+  virtual Status ComputeResponses(std::vector<TensorRequest>& new_requests,
+                                  std::vector<Response>* out) = 0;
+
+  // Host data plane over fused contiguous buffers.
+  virtual Status AllreduceBuffer(void* buf, int64_t count, DataType dtype,
+                                 ReduceOp op, int process_set_id) = 0;
+  virtual Status AllgatherBuffer(const void* in, int64_t nbytes,
+                                 int process_set_id, std::string* out,
+                                 std::vector<int64_t>* nbytes_per_rank) = 0;
+  virtual Status BroadcastBuffer(void* buf, int64_t nbytes, int root_rank,
+                                 int process_set_id) = 0;
+  virtual Status AlltoallBuffer(const void* in,
+                                const std::vector<int64_t>& splits,
+                                int64_t row_bytes, int process_set_id,
+                                std::string* out,
+                                std::vector<int64_t>* recv_splits) = 0;
+  virtual Status Barrier(int process_set_id) = 0;
+
+  int rank() const { return cfg_.rank; }
+  int size() const { return cfg_.size; }
+  ProcessSetTable& process_sets() { return process_sets_; }
+
+  // Coordinator-side stall report: tensor -> ranks that have not announced
+  // it yet (reference: stall_inspector.cc per-rank missing lists).
+  virtual std::string StallReport(double older_than_s) { return ""; }
+
+ protected:
+  CoreConfig cfg_;
+  ProcessSetTable process_sets_;
+};
+
+// Single-process controller: negotiation is trivial, data plane is identity.
+class LocalController : public Controller {
+ public:
+  explicit LocalController(const CoreConfig& cfg) : Controller(cfg) {}
+  Status Initialize() override;
+  Status ComputeResponses(std::vector<TensorRequest>& new_requests,
+                          std::vector<Response>* out) override;
+  Status AllreduceBuffer(void*, int64_t, DataType, ReduceOp, int) override {
+    return Status::OK();
+  }
+  Status AllgatherBuffer(const void* in, int64_t nbytes, int,
+                         std::string* out,
+                         std::vector<int64_t>* nbytes_per_rank) override {
+    out->assign(static_cast<const char*>(in), nbytes);
+    nbytes_per_rank->assign(1, nbytes);
+    return Status::OK();
+  }
+  Status BroadcastBuffer(void*, int64_t, int, int) override {
+    return Status::OK();
+  }
+  Status AlltoallBuffer(const void* in, const std::vector<int64_t>& splits,
+                        int64_t row_bytes, int, std::string* out,
+                        std::vector<int64_t>* recv_splits) override {
+    int64_t rows = 0;
+    for (auto s : splits) rows += s;
+    out->assign(static_cast<const char*>(in), rows * row_bytes);
+    *recv_splits = splits;
+    return Status::OK();
+  }
+  Status Barrier(int) override { return Status::OK(); }
+};
+
+// Typed elementwise reduction into `acc` (used by the socket data plane).
+void ReduceInto(void* acc, const void* contrib, int64_t count, DataType dtype,
+                ReduceOp op);
+
+}  // namespace hvdtpu

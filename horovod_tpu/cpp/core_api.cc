@@ -1,0 +1,455 @@
+// C API + background cycle loop: the heart of the native core.
+//
+// Reference: horovod/common/operations.cc (horovod_init / EnqueueTensor* /
+// InitializeHorovodOnce / BackgroundThreadLoop / RunLoopOnce) and
+// global_state.h (HorovodGlobalState); SURVEY.md §2.1, §3.1-3.2.
+//
+// The Python layer (horovod_tpu/_core.py) drives this over ctypes:
+//   hvd_enqueue(...)        -> framework thread submits named tensors
+//   background thread       -> negotiates + fuses every cycle
+//   hvd_pop_response(...)   -> executor thread pops fused responses (JSON)
+//   hvd_*_buffer(...)       -> executor runs the host data plane
+// Device (TPU) responses are executed in Python as jitted XLA collectives;
+// the core guarantees every rank pops byte-identical response lists.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <thread>
+#include <unordered_map>
+
+#include "common.h"
+#include "controller.h"
+#include "logging.h"
+#include "parameter_manager.h"
+#include "socket_controller.h"
+#include "timeline.h"
+
+namespace hvdtpu {
+
+namespace {
+
+int g_log_level = WARNING;
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if (static_cast<unsigned char>(c) < 0x20) {
+      out += ' ';
+    } else {
+      out.push_back(c);
+    }
+  }
+  return out;
+}
+
+struct GlobalState {
+  CoreConfig cfg;
+  std::unique_ptr<Controller> controller;
+
+  std::mutex queue_mu;
+  std::vector<TensorRequest> queue;
+  std::unordered_map<std::string, int64_t> outstanding;  // name -> handle
+
+  std::mutex out_mu;
+  std::condition_variable out_cv;
+  std::deque<std::string> out_responses;  // JSON lines for Python
+
+  std::thread background;
+  std::atomic<bool> shutdown{false};
+  std::atomic<bool> aborted{false};
+
+  Timeline timeline;
+  ParameterManager params;
+  std::atomic<int64_t> fusion_threshold{64LL << 20};
+  double cycle_ms = 1.0;
+  double last_stall_check = 0.0;
+
+  std::mutex err_mu;
+  std::string last_error;
+};
+
+GlobalState* g = nullptr;
+
+void SetLastError(const std::string& msg) {
+  std::lock_guard<std::mutex> l(g->err_mu);
+  g->last_error = msg;
+}
+
+std::string ResponseToJson(const Response& r) {
+  std::ostringstream os;
+  os << "{\"op\":" << static_cast<int>(r.op)
+     << ",\"dtype\":" << static_cast<int>(r.dtype)
+     << ",\"psid\":" << r.process_set_id << ",\"seq\":" << r.seq
+     << ",\"cache_hit\":" << (r.cache_hit ? 1 : 0) << ",\"error\":\""
+     << JsonEscape(r.error) << "\",\"handles\":[";
+  for (size_t i = 0; i < r.handles.size(); ++i) {
+    if (i) os << ',';
+    os << r.handles[i];
+  }
+  os << "]}";
+  return os.str();
+}
+
+void DeliverResponse(const Response& r) {
+  std::lock_guard<std::mutex> l(g->out_mu);
+  g->out_responses.push_back(ResponseToJson(r));
+  g->out_cv.notify_all();
+}
+
+void FailAllOutstanding(const std::string& reason) {
+  Response err;
+  err.error = reason;
+  {
+    std::lock_guard<std::mutex> l(g->queue_mu);
+    for (auto& kv : g->outstanding) err.handles.push_back(kv.second);
+    g->outstanding.clear();
+    for (auto& r : g->queue) err.handles.push_back(r.handle);
+    g->queue.clear();
+  }
+  if (!err.handles.empty()) DeliverResponse(err);
+}
+
+void BackgroundLoop() {
+  auto& cfg = g->cfg;
+  double stall_period = cfg.stall_warn_s > 0 ? cfg.stall_warn_s : 60.0;
+  while (!g->shutdown.load()) {
+    std::this_thread::sleep_for(
+        std::chrono::microseconds(static_cast<int64_t>(g->cycle_ms * 1000)));
+    g->timeline.MarkCycle();
+
+    std::vector<TensorRequest> newreqs;
+    {
+      std::lock_guard<std::mutex> l(g->queue_mu);
+      newreqs.swap(g->queue);
+    }
+    if (g->aborted.load()) {
+      if (!newreqs.empty()) {
+        Response err;
+        err.error = "Horovod controller has been aborted";
+        for (auto& r : newreqs) err.handles.push_back(r.handle);
+        DeliverResponse(err);
+      }
+      continue;
+    }
+
+    std::vector<Response> responses;
+    Status s = g->controller->ComputeResponses(newreqs, &responses);
+    if (!s.ok()) {
+      if (g->shutdown.load()) break;
+      g->aborted.store(true);
+      SetLastError(s.reason);
+      HVD_LOG(ERROR) << "negotiation failed: " << s.reason;
+      FailAllOutstanding("Horovod negotiation failed: " + s.reason);
+      continue;
+    }
+
+    int64_t bytes = 0;
+    for (auto& r : responses) {
+      // Map globally agreed names to this rank's local handles.
+      std::lock_guard<std::mutex> l(g->queue_mu);
+      for (const auto& name : r.names) {
+        auto it = g->outstanding.find(name);
+        if (it != g->outstanding.end()) {
+          r.handles.push_back(it->second);
+          g->outstanding.erase(it);
+          g->timeline.End(name, "NEGOTIATE");
+        }
+      }
+      for (const auto& m : r.metas) bytes += m.nbytes;
+    }
+    for (const auto& r : responses) {
+      if (!r.handles.empty()) DeliverResponse(r);
+    }
+    if (bytes > 0) g->params.RecordBytes(bytes);
+
+    int64_t fusion = g->fusion_threshold.load();
+    double cycle = g->cycle_ms;
+    if (g->params.Tick(&fusion, &cycle)) {
+      g->fusion_threshold.store(fusion);
+      g->cycle_ms = cycle;
+      g->cfg.fusion_threshold = fusion;
+      HVD_LOG(DEBUG) << "autotune: fusion=" << fusion << " cycle_ms=" << cycle;
+    }
+
+    double now = MonotonicSeconds();
+    if (cfg.stall_warn_s > 0 && now - g->last_stall_check > stall_period) {
+      g->last_stall_check = now;
+      std::string report = g->controller->StallReport(cfg.stall_warn_s);
+      if (!report.empty()) {
+        HVD_LOG(WARNING)
+            << "Stall detected: tensors submitted on some ranks but not "
+               "others: "
+            << report;
+      }
+      std::lock_guard<std::mutex> l(g->queue_mu);
+      std::ostringstream local;
+      int n = 0;
+      for (auto& kv : g->outstanding) {
+        (void)kv;
+        ++n;
+      }
+      if (n > 0 && g->cfg.size == 1) {
+        HVD_LOG(WARNING) << "Stall: " << n
+                         << " tensor(s) pending negotiation locally";
+      }
+    }
+  }
+}
+
+}  // namespace
+
+int GetLogLevel() { return g_log_level; }
+void SetLogLevel(int level) { g_log_level = level; }
+
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+extern "C" {
+
+int hvd_init(int rank, int size, int local_rank, int local_size,
+             const char* controller, const char* addr, int port,
+             double cycle_ms, long long fusion, int cache_cap, int autotune,
+             const char* autotune_log, const char* timeline_path,
+             int timeline_mark_cycles, double stall_warn_s,
+             double stall_shutdown_s, int log_level) {
+  if (g != nullptr) return -1;
+  g = new GlobalState();
+  auto& cfg = g->cfg;
+  cfg.rank = rank;
+  cfg.size = size;
+  cfg.local_rank = local_rank;
+  cfg.local_size = local_size;
+  cfg.controller = controller ? controller : "auto";
+  cfg.rendezvous_addr = addr ? addr : "127.0.0.1";
+  cfg.rendezvous_port = port;
+  cfg.cycle_time_ms = cycle_ms;
+  cfg.fusion_threshold = fusion;
+  cfg.cache_capacity = cache_cap;
+  cfg.autotune = autotune != 0;
+  cfg.autotune_log = autotune_log ? autotune_log : "";
+  cfg.timeline_path = timeline_path ? timeline_path : "";
+  cfg.timeline_mark_cycles = timeline_mark_cycles != 0;
+  cfg.stall_warn_s = stall_warn_s;
+  cfg.stall_shutdown_s = stall_shutdown_s;
+  SetLogLevel(log_level);
+  g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
+  g->fusion_threshold.store(fusion);
+
+  if (cfg.size > 1 || cfg.controller == "socket") {
+    g->controller = std::make_unique<SocketController>(cfg);
+  } else {
+    g->controller = std::make_unique<LocalController>(cfg);
+  }
+  Status s = g->controller->Initialize();
+  if (!s.ok()) {
+    SetLastError(s.reason);
+    HVD_LOG(ERROR) << "init failed: " << s.reason;
+    delete g;
+    g = nullptr;
+    return -2;
+  }
+  if (!cfg.timeline_path.empty()) {
+    g->timeline.Start(cfg.timeline_path, cfg.timeline_mark_cycles);
+  }
+  if (cfg.autotune) {
+    g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log);
+  }
+  g->background = std::thread(BackgroundLoop);
+  return 0;
+}
+
+int hvd_shutdown() {
+  if (g == nullptr) return -1;
+  g->shutdown.store(true);
+  g->controller->Shutdown();
+  if (g->background.joinable()) g->background.join();
+  FailAllOutstanding("Horovod has been shut down");
+  g->timeline.Stop();
+  {
+    std::lock_guard<std::mutex> l(g->out_mu);
+    g->out_cv.notify_all();
+  }
+  delete g;
+  g = nullptr;
+  return 0;
+}
+
+int hvd_is_initialized() { return g != nullptr ? 1 : 0; }
+int hvd_rank() { return g ? g->cfg.rank : -1; }
+int hvd_size() { return g ? g->cfg.size : -1; }
+int hvd_local_rank() { return g ? g->cfg.local_rank : -1; }
+int hvd_local_size() { return g ? g->cfg.local_size : -1; }
+
+long long hvd_enqueue(long long handle, const char* name, int op, int dtype,
+                      int reduce_op, long long nbytes, const long long* shape,
+                      int ndim, int psid, int root_rank, double prescale,
+                      double postscale, const long long* splits, int nsplits) {
+  if (g == nullptr) return -1;
+  TensorRequest r;
+  r.handle = handle;
+  r.name = name;
+  r.op = static_cast<OpType>(op);
+  r.dtype = static_cast<DataType>(dtype);
+  r.reduce_op = static_cast<ReduceOp>(reduce_op);
+  r.nbytes = nbytes;
+  r.shape.assign(shape, shape + ndim);
+  r.process_set_id = psid;
+  r.root_rank = root_rank;
+  r.prescale = prescale;
+  r.postscale = postscale;
+  if (splits && nsplits > 0) r.splits.assign(splits, splits + nsplits);
+  r.enqueued_at = MonotonicSeconds();
+  {
+    std::lock_guard<std::mutex> l(g->queue_mu);
+    if (g->outstanding.count(r.name)) return -2;  // duplicate in flight
+    g->outstanding[r.name] = handle;
+    g->queue.push_back(std::move(r));
+  }
+  g->timeline.Begin(name, "NEGOTIATE");
+  return 0;
+}
+
+// Returns: >0 = JSON length written, 0 = timeout, -1 = not initialized,
+// -2 = buffer too small (len stored in *needed).
+int hvd_pop_response(char* buf, int cap, int timeout_ms) {
+  if (g == nullptr) return -1;
+  std::unique_lock<std::mutex> l(g->out_mu);
+  if (g->out_responses.empty()) {
+    g->out_cv.wait_for(l, std::chrono::milliseconds(timeout_ms));
+  }
+  if (g->out_responses.empty()) return 0;
+  const std::string& s = g->out_responses.front();
+  if (static_cast<int>(s.size()) + 1 > cap) return -2;
+  std::memcpy(buf, s.data(), s.size());
+  buf[s.size()] = '\0';
+  int n = static_cast<int>(s.size());
+  g->out_responses.pop_front();
+  return n;
+}
+
+static void SetSeq(long long seq) {
+  auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+  if (sc) sc->SetCurrentSeq(seq);
+}
+
+static int StatusToInt(const Status& s) {
+  if (s.ok()) return 0;
+  SetLastError(s.reason);
+  return -static_cast<int>(s.code);
+}
+
+int hvd_allreduce_buffer(long long seq, void* buf, long long count, int dtype,
+                         int reduce_op, int psid) {
+  if (g == nullptr) return -1;
+  SetSeq(seq);
+  g->timeline.Begin("seq." + std::to_string(seq), "DATA_ALLREDUCE");
+  Status s = g->controller->AllreduceBuffer(
+      buf, count, static_cast<DataType>(dtype),
+      static_cast<ReduceOp>(reduce_op), psid);
+  g->timeline.End("seq." + std::to_string(seq), "DATA_ALLREDUCE");
+  return StatusToInt(s);
+}
+
+// Allgather: returns malloc'd buffer in *out (caller frees via hvd_free).
+int hvd_allgather_buffer(long long seq, const void* in, long long nbytes,
+                         int psid, void** out, long long* out_len,
+                         long long* counts, int counts_cap, int* n_counts) {
+  if (g == nullptr) return -1;
+  SetSeq(seq);
+  std::string gathered;
+  std::vector<int64_t> per_rank;
+  Status s =
+      g->controller->AllgatherBuffer(in, nbytes, psid, &gathered, &per_rank);
+  if (!s.ok()) return StatusToInt(s);
+  if (static_cast<int>(per_rank.size()) > counts_cap) return -3;
+  char* mem = static_cast<char*>(std::malloc(gathered.size()));
+  std::memcpy(mem, gathered.data(), gathered.size());
+  *out = mem;
+  *out_len = static_cast<long long>(gathered.size());
+  for (size_t i = 0; i < per_rank.size(); ++i) counts[i] = per_rank[i];
+  *n_counts = static_cast<int>(per_rank.size());
+  return 0;
+}
+
+int hvd_broadcast_buffer(long long seq, void* buf, long long nbytes, int root,
+                         int psid) {
+  if (g == nullptr) return -1;
+  SetSeq(seq);
+  return StatusToInt(g->controller->BroadcastBuffer(buf, nbytes, root, psid));
+}
+
+int hvd_alltoall_buffer(long long seq, const void* in, const long long* splits,
+                        int nsplits, long long row_bytes, int psid, void** out,
+                        long long* out_len, long long* recv_splits,
+                        int* n_recv) {
+  if (g == nullptr) return -1;
+  SetSeq(seq);
+  std::vector<int64_t> sp(splits, splits + nsplits);
+  std::string received;
+  std::vector<int64_t> rsp;
+  Status s = g->controller->AlltoallBuffer(in, sp, row_bytes, psid, &received,
+                                           &rsp);
+  if (!s.ok()) return StatusToInt(s);
+  char* mem = static_cast<char*>(std::malloc(received.size()));
+  std::memcpy(mem, received.data(), received.size());
+  *out = mem;
+  *out_len = static_cast<long long>(received.size());
+  for (size_t i = 0; i < rsp.size(); ++i) recv_splits[i] = rsp[i];
+  *n_recv = static_cast<int>(rsp.size());
+  return 0;
+}
+
+int hvd_barrier(long long seq, int psid) {
+  if (g == nullptr) return -1;
+  SetSeq(seq);
+  return StatusToInt(g->controller->Barrier(psid));
+}
+
+void hvd_free(void* p) { std::free(p); }
+
+int hvd_add_process_set(const int* ranks, int n) {
+  if (g == nullptr) return -1;
+  std::vector<int> v(ranks, ranks + n);
+  return g->controller->process_sets().Add(v);
+}
+
+int hvd_remove_process_set(int id) {
+  if (g == nullptr) return -1;
+  g->controller->process_sets().Remove(id);
+  return 0;
+}
+
+int hvd_process_set_ranks(int id, int* out, int cap) {
+  if (g == nullptr) return -1;
+  std::vector<int> ranks;
+  if (!g->controller->process_sets().Ranks(id, &ranks)) return -2;
+  if (static_cast<int>(ranks.size()) > cap) return -3;
+  for (size_t i = 0; i < ranks.size(); ++i) out[i] = ranks[i];
+  return static_cast<int>(ranks.size());
+}
+
+void hvd_start_timeline(const char* path, int mark_cycles) {
+  if (g) g->timeline.Start(path, mark_cycles != 0);
+}
+
+void hvd_stop_timeline() {
+  if (g) g->timeline.Stop();
+}
+
+const char* hvd_last_error() {
+  if (g == nullptr) return "not initialized";
+  std::lock_guard<std::mutex> l(g->err_mu);
+  return g->last_error.c_str();
+}
+
+}  // extern "C"

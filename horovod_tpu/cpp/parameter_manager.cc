@@ -1,0 +1,90 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+
+#include "common.h"
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+constexpr int64_t kMinFusion = 1 << 20;         // 1 MiB
+constexpr int64_t kMaxFusion = 512LL << 20;     // 512 MiB
+constexpr double kMinCycleMs = 0.2;
+constexpr double kMaxCycleMs = 100.0;
+}  // namespace
+
+void ParameterManager::Initialize(int64_t fusion_threshold,
+                                  double cycle_time_ms,
+                                  const std::string& log_path) {
+  fusion_ = best_fusion_ = fusion_threshold;
+  cycle_ms_ = best_cycle_ = cycle_time_ms;
+  window_start_ = MonotonicSeconds();
+  active_ = true;
+  if (!log_path.empty()) {
+    log_ = std::fopen(log_path.c_str(), "w");
+    if (log_) std::fputs("time_s,fusion_bytes,cycle_ms,score_bytes_per_s\n", log_);
+  }
+}
+
+ParameterManager::~ParameterManager() {
+  if (log_) std::fclose(log_);
+}
+
+void ParameterManager::RecordBytes(int64_t bytes) { bytes_ += bytes; }
+
+void ParameterManager::Log(double score) {
+  if (!log_) return;
+  std::fprintf(log_, "%.3f,%lld,%.3f,%.1f\n", MonotonicSeconds(),
+               static_cast<long long>(fusion_), cycle_ms_, score);
+  std::fflush(log_);
+}
+
+void ParameterManager::Score(double score) {
+  Log(score);
+  if (warmup_windows_ > 0) {
+    --warmup_windows_;
+    best_score_ = std::max(best_score_, score);
+    return;
+  }
+  if (score >= best_score_) {
+    // Keep climbing in the same direction on the same knob.
+    best_score_ = score;
+    best_fusion_ = fusion_;
+    best_cycle_ = cycle_ms_;
+  } else {
+    // Revert and move to the next knob/direction.
+    fusion_ = best_fusion_;
+    cycle_ms_ = best_cycle_;
+    if (direction_ == 1) {
+      direction_ = -1;
+    } else {
+      direction_ = 1;
+      knob_ = (knob_ + 1) % 2;
+    }
+  }
+  if (knob_ == 0) {
+    int64_t next = direction_ > 0 ? fusion_ * 2 : fusion_ / 2;
+    fusion_ = std::min(kMaxFusion, std::max(kMinFusion, next));
+  } else {
+    double next = direction_ > 0 ? cycle_ms_ * 2 : cycle_ms_ / 2;
+    cycle_ms_ = std::min(kMaxCycleMs, std::max(kMinCycleMs, next));
+  }
+}
+
+bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
+  if (!active_) return false;
+  double now = MonotonicSeconds();
+  if (now - window_start_ < window_s_) return false;
+  double score = static_cast<double>(bytes_) / (now - window_start_);
+  bytes_ = 0;
+  window_start_ = now;
+  int64_t old_fusion = fusion_;
+  double old_cycle = cycle_ms_;
+  Score(score);
+  *fusion_threshold = fusion_;
+  *cycle_time_ms = cycle_ms_;
+  return fusion_ != old_fusion || cycle_ms_ != old_cycle;
+}
+
+}  // namespace hvdtpu

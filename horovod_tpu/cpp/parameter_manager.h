@@ -1,0 +1,50 @@
+// Online autotuning of fusion threshold and cycle time.
+//
+// Reference: horovod/common/parameter_manager.h (ParameterManager with
+// Bayesian optimization; SURVEY.md §2.1).  This build uses coordinate-wise
+// hill climbing on the same score (negotiated tensor bytes per second),
+// which converges for the two monotone-ish knobs involved and needs no
+// linear-algebra dependency; the tuned values flow back into the cycle loop
+// exactly as in the reference (HOROVOD_AUTOTUNE / HOROVOD_AUTOTUNE_LOG).
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class ParameterManager {
+ public:
+  void Initialize(int64_t fusion_threshold, double cycle_time_ms,
+                  const std::string& log_path);
+  ~ParameterManager();
+
+  // Record bytes covered by emitted responses.
+  void RecordBytes(int64_t bytes);
+
+  // Called every cycle; returns true when parameters changed.
+  bool Tick(int64_t* fusion_threshold, double* cycle_time_ms);
+
+ private:
+  void Score(double score);
+  void Log(double score);
+
+  bool active_ = false;
+  int64_t bytes_ = 0;
+  double window_start_ = 0;
+  double window_s_ = 2.0;
+
+  int64_t fusion_ = 0;
+  double cycle_ms_ = 1.0;
+  int knob_ = 0;       // 0: fusion, 1: cycle
+  int direction_ = 1;  // +1 double, -1 halve
+  double best_score_ = -1;
+  int64_t best_fusion_ = 0;
+  double best_cycle_ = 1.0;
+  int warmup_windows_ = 1;
+  FILE* log_ = nullptr;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,692 @@
+#include "socket_controller.h"
+
+#include <poll.h>
+
+#include <algorithm>
+#include <cstring>
+#include <sstream>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+constexpr double kConnectTimeoutS = 60.0;
+
+}  // namespace
+
+// Serialization of the data-plane frame header.
+static void WriteDataHeader(Writer* w, int rank, int64_t seq, OpType op,
+                            DataType dtype, ReduceOp rop, int psid, int root,
+                            int64_t row_bytes,
+                            const std::vector<int64_t>& splits) {
+  w->PutI32(rank);
+  w->PutI64(seq);
+  w->PutI32(static_cast<int32_t>(op));
+  w->PutI32(static_cast<int32_t>(dtype));
+  w->PutI32(static_cast<int32_t>(rop));
+  w->PutI32(psid);
+  w->PutI32(root);
+  w->PutI64(row_bytes);
+  w->PutI64Vec(splits);
+}
+
+SocketController::SocketController(const CoreConfig& cfg)
+    : Controller(cfg), cache_(cfg.cache_capacity) {}
+
+SocketController::~SocketController() { Shutdown(); }
+
+Status SocketController::Initialize() {
+  process_sets_.InitGlobal(cfg_.size);
+  if (is_coordinator()) {
+    if (!listener_.Listen("0.0.0.0", cfg_.rendezvous_port)) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "coordinator failed to listen on port " +
+                               std::to_string(cfg_.rendezvous_port));
+    }
+    ctrl_socks_.resize(cfg_.size);
+    data_socks_.resize(cfg_.size);
+    int needed = 2 * (cfg_.size - 1);
+    double deadline = MonotonicSeconds() + kConnectTimeoutS;
+    while (needed > 0) {
+      if (MonotonicSeconds() > deadline) {
+        return Status::Error(StatusCode::PRECONDITION_ERROR,
+                             "rendezvous timeout waiting for workers");
+      }
+      Socket s = listener_.Accept(1.0);
+      if (!s.valid()) continue;
+      std::string hello;
+      if (!s.RecvFrame(&hello)) continue;
+      Reader r(hello);
+      int rank = r.GetI32();
+      int channel = r.GetI32();
+      if (rank <= 0 || rank >= cfg_.size || (channel != 0 && channel != 1)) {
+        return Status::Error(StatusCode::INVALID_ARGUMENT,
+                             "bad HELLO from worker");
+      }
+      if (channel == 0) {
+        ctrl_socks_[rank] = std::move(s);
+      } else {
+        data_socks_[rank] = std::move(s);
+      }
+      --needed;
+    }
+    data_shutdown_ = false;
+    data_thread_ = std::thread([this] { DataServiceLoop(); });
+  } else {
+    if (!coord_ctrl_.Connect(cfg_.rendezvous_addr, cfg_.rendezvous_port,
+                             kConnectTimeoutS) ||
+        !coord_data_.Connect(cfg_.rendezvous_addr, cfg_.rendezvous_port,
+                             kConnectTimeoutS)) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "worker failed to reach coordinator at " +
+                               cfg_.rendezvous_addr + ":" +
+                               std::to_string(cfg_.rendezvous_port));
+    }
+    Writer hello_ctrl;
+    hello_ctrl.PutI32(cfg_.rank);
+    hello_ctrl.PutI32(0);
+    Writer hello_data;
+    hello_data.PutI32(cfg_.rank);
+    hello_data.PutI32(1);
+    if (!coord_ctrl_.SendFrame(hello_ctrl.data()) ||
+        !coord_data_.SendFrame(hello_data.data())) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR, "HELLO failed");
+    }
+  }
+  initialized_ = true;
+  return Status::OK();
+}
+
+void SocketController::Shutdown() {
+  if (!initialized_) return;
+  initialized_ = false;
+  aborted_ = true;
+  {
+    std::lock_guard<std::mutex> l(data_mu_);
+    data_shutdown_ = true;
+    data_cv_.notify_all();
+  }
+  coord_ctrl_.Close();
+  coord_data_.Close();
+  for (auto& s : ctrl_socks_) s.Close();
+  for (auto& s : data_socks_) s.Close();
+  listener_.Close();
+  if (data_thread_.joinable()) data_thread_.join();
+}
+
+// ---------------------------------------------------------------------------
+// Negotiation
+// ---------------------------------------------------------------------------
+
+Status SocketController::ComputeResponses(
+    std::vector<TensorRequest>& new_requests, std::vector<Response>* out) {
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  return is_coordinator() ? CoordinatorCycle(new_requests, out)
+                          : WorkerCycle(new_requests, out);
+}
+
+void SocketController::Announce(int rank, TensorRequest req,
+                                std::vector<Response>* errors) {
+  // Process-set registration happens on each rank's Python thread and may
+  // race announcements arriving from faster ranks; an unknown process set
+  // is therefore *deferred* (the tensor stays pending until the local
+  // registration lands), not an error.  Membership is validated once the
+  // set is known, at readiness-check time.
+  std::vector<int> members;
+  if (process_sets_.Ranks(req.process_set_id, &members) &&
+      !std::binary_search(members.begin(), members.end(), rank)) {
+    Response e;
+    e.error = "rank " + std::to_string(rank) +
+              " is not in process set of tensor " + req.name;
+    e.names.push_back(req.name);
+    e.metas.push_back(req);
+    errors->push_back(std::move(e));
+    return;
+  }
+  auto it = pending_.find(req.name);
+  if (it == pending_.end()) {
+    Pending p;
+    p.meta = req;
+    p.order = arrival_counter_++;
+    p.first_seen = MonotonicSeconds();
+    p.announced.insert(rank);
+    pending_.emplace(req.name, std::move(p));
+    return;
+  }
+  // Cross-rank consistency validation (reference: ComputeResponseList's
+  // error construction for mismatched shapes/dtypes).
+  Pending& p = it->second;
+  std::string mismatch;
+  if (p.meta.op != req.op) {
+    mismatch = "operation type";
+  } else if (p.meta.dtype != req.dtype) {
+    mismatch = "dtype";
+  } else if (p.meta.reduce_op != req.reduce_op) {
+    mismatch = "reduce op";
+  } else if (p.meta.process_set_id != req.process_set_id) {
+    mismatch = "process set";
+  } else if (p.meta.root_rank != req.root_rank) {
+    mismatch = "root rank";
+  } else if (p.meta.prescale != req.prescale ||
+             p.meta.postscale != req.postscale) {
+    mismatch = "scale factors";
+  } else if (req.op == OpType::ALLREDUCE || req.op == OpType::BROADCAST ||
+             req.op == OpType::REDUCESCATTER) {
+    if (p.meta.shape != req.shape) mismatch = "shape";
+  } else if (req.op == OpType::ALLGATHER || req.op == OpType::ALLTOALL) {
+    // first dim may differ per rank; trailing dims must match
+    if (std::vector<int64_t>(p.meta.shape.begin() +
+                                 (p.meta.shape.empty() ? 0 : 1),
+                             p.meta.shape.end()) !=
+        std::vector<int64_t>(req.shape.begin() + (req.shape.empty() ? 0 : 1),
+                             req.shape.end())) {
+      mismatch = "trailing shape";
+    }
+  }
+  if (!mismatch.empty()) {
+    Response e;
+    e.error = "Mismatched " + mismatch + " for tensor " + req.name +
+              " across ranks";
+    e.names.push_back(req.name);
+    e.metas.push_back(p.meta);
+    errors->push_back(std::move(e));
+    pending_.erase(it);
+    return;
+  }
+  p.announced.insert(rank);
+}
+
+Status SocketController::CoordinatorCycle(
+    std::vector<TensorRequest>& new_requests, std::vector<Response>* out) {
+  std::vector<Response> errors;
+  // Own announcements first (deterministic: coordinator, then rank order).
+  for (auto& r : new_requests) Announce(0, std::move(r), &errors);
+  for (int rank = 1; rank < cfg_.size; ++rank) {
+    std::string frame;
+    if (!ctrl_socks_[rank].RecvFrame(&frame)) {
+      aborted_ = true;
+      return Status::Error(StatusCode::ABORTED,
+                           "lost connection to rank " + std::to_string(rank));
+    }
+    Reader rd(frame);
+    int32_t n_cached = rd.GetI32();
+    for (int32_t i = 0; i < n_cached; ++i) {
+      int64_t id = rd.GetI64();
+      TensorRequest req;
+      if (cache_.Get(id, &req)) {
+        Announce(rank, std::move(req), &errors);
+      } else {
+        Response e;
+        e.error = "response cache divergence: unknown cache id " +
+                  std::to_string(id) + " from rank " + std::to_string(rank);
+        errors.push_back(std::move(e));
+      }
+    }
+    int32_t n_full = rd.GetI32();
+    for (int32_t i = 0; i < n_full; ++i) {
+      Announce(rank, DeserializeRequest(&rd), &errors);
+    }
+  }
+
+  // Collect ready tensors in deterministic (arrival-order) sequence.
+  std::vector<std::pair<int64_t, std::string>> ready_names;
+  for (auto& kv : pending_) {
+    std::vector<int> members;
+    if (!process_sets_.Ranks(kv.second.meta.process_set_id, &members)) {
+      continue;  // set not registered yet on this (coordinator) rank
+    }
+    bool ready = true;
+    for (int m : members) {
+      if (!kv.second.announced.count(m)) {
+        ready = false;
+        break;
+      }
+    }
+    if (ready) ready_names.emplace_back(kv.second.order, kv.first);
+  }
+  std::sort(ready_names.begin(), ready_names.end());
+  std::vector<TensorRequest> ready;
+  ready.reserve(ready_names.size());
+  for (auto& [ord, name] : ready_names) {
+    ready.push_back(pending_[name].meta);
+    pending_.erase(name);
+  }
+
+  *out = FuseRequests(ready, cfg_.fusion_threshold);
+  out->insert(out->begin(), errors.begin(), errors.end());
+  UpdateCachesAndSeq(out);
+
+  // Broadcast the identical response list to every worker.
+  Writer w;
+  w.PutI32(static_cast<int32_t>(out->size()));
+  for (const auto& r : *out) SerializeResponse(r, &w);
+  const std::string payload = w.data();
+  for (int rank = 1; rank < cfg_.size; ++rank) {
+    if (!ctrl_socks_[rank].SendFrame(payload)) {
+      aborted_ = true;
+      return Status::Error(StatusCode::ABORTED,
+                           "failed to send responses to rank " +
+                               std::to_string(rank));
+    }
+  }
+  return Status::OK();
+}
+
+Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
+                                     std::vector<Response>* out) {
+  Writer w;
+  // Cache hits travel as bare ids (the reference's bit-vector fast path).
+  std::vector<int64_t> cached;
+  std::vector<const TensorRequest*> full;
+  for (const auto& r : new_requests) {
+    int64_t id = cache_.Lookup(r);
+    if (id >= 0) {
+      cached.push_back(id);
+    } else {
+      full.push_back(&r);
+    }
+  }
+  w.PutI32(static_cast<int32_t>(cached.size()));
+  for (int64_t id : cached) w.PutI64(id);
+  w.PutI32(static_cast<int32_t>(full.size()));
+  for (const auto* r : full) SerializeRequest(*r, &w);
+  if (!coord_ctrl_.SendFrame(w.data())) {
+    aborted_ = true;
+    return Status::Error(StatusCode::ABORTED, "lost coordinator (send)");
+  }
+  std::string frame;
+  if (!coord_ctrl_.RecvFrame(&frame)) {
+    aborted_ = true;
+    return Status::Error(StatusCode::ABORTED, "lost coordinator (recv)");
+  }
+  Reader rd(frame);
+  int32_t n = rd.GetI32();
+  out->clear();
+  out->reserve(n);
+  for (int32_t i = 0; i < n; ++i) out->push_back(DeserializeResponse(&rd));
+  // Local seq counter mirrors the coordinator's (sanity only) and caches are
+  // updated from the metas carried by each response — identical on all
+  // ranks, so cache ids agree without extra synchronisation.
+  for (auto& r : *out) {
+    if (r.error.empty()) {
+      for (const auto& m : r.metas) cache_.Insert(m);
+      if (r.seq >= 0) seq_counter_ = r.seq + 1;
+    }
+  }
+  return Status::OK();
+}
+
+void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {
+  for (auto& r : *responses) {
+    if (!r.error.empty()) continue;
+    bool all_cached = true;
+    for (const auto& m : r.metas) {
+      if (cache_.Lookup(m) < 0) all_cached = false;
+      cache_.Insert(m);
+    }
+    r.cache_hit = all_cached;
+    r.seq = seq_counter_++;
+  }
+}
+
+std::string SocketController::StallReport(double older_than_s) {
+  if (!is_coordinator()) return "";
+  double now = MonotonicSeconds();
+  std::ostringstream os;
+  for (const auto& kv : pending_) {
+    if (now - kv.second.first_seen < older_than_s) continue;
+    std::vector<int> members;
+    process_sets_.Ranks(kv.second.meta.process_set_id, &members);
+    os << kv.first << " (waiting on ranks:";
+    for (int m : members) {
+      if (!kv.second.announced.count(m)) os << " " << m;
+    }
+    os << "); ";
+  }
+  return os.str();
+}
+
+// ---------------------------------------------------------------------------
+// Data plane
+// ---------------------------------------------------------------------------
+
+Status SocketController::MemberDataOp(const DataOpHeader& h,
+                                      const std::string& payload,
+                                      std::string* reply) {
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  if (is_coordinator()) {
+    {
+      std::lock_guard<std::mutex> l(data_mu_);
+      local_contrib_.emplace_back(h, payload);
+      data_cv_.notify_all();
+    }
+    std::unique_lock<std::mutex> l(data_mu_);
+    data_cv_.wait(l, [&] {
+      return data_shutdown_ || local_reply_.count(h.seq) > 0;
+    });
+    if (data_shutdown_ && !local_reply_.count(h.seq)) {
+      return Status::Error(StatusCode::ABORTED, "shutdown during data op");
+    }
+    *reply = std::move(local_reply_[h.seq]);
+    local_reply_.erase(h.seq);
+    return Status::OK();
+  }
+  Writer w;
+  WriteDataHeader(&w, cfg_.rank, h.seq, h.op, h.dtype, h.reduce_op,
+                  h.process_set_id, h.root_rank, h.row_bytes, h.splits);
+  w.PutString(payload);
+  if (!coord_data_.SendFrame(w.data())) {
+    aborted_ = true;
+    return Status::Error(StatusCode::ABORTED, "data plane send failed");
+  }
+  if (!coord_data_.RecvFrame(reply)) {
+    aborted_ = true;
+    return Status::Error(StatusCode::ABORTED, "data plane recv failed");
+  }
+  return Status::OK();
+}
+
+void SocketController::DataServiceLoop() {
+  std::vector<pollfd> pfds;
+  std::vector<int> pfd_ranks;
+  for (int rank = 1; rank < cfg_.size; ++rank) {
+    pfds.push_back(pollfd{data_socks_[rank].fd(), POLLIN, 0});
+    pfd_ranks.push_back(rank);
+  }
+  while (true) {
+    // Drain local (rank 0) contributions.
+    {
+      std::lock_guard<std::mutex> l(data_mu_);
+      if (data_shutdown_) return;
+      while (!local_contrib_.empty()) {
+        auto [h, payload] = std::move(local_contrib_.front());
+        local_contrib_.pop_front();
+        DataOpState& st = data_ops_[h.seq];
+        st.header = h;
+        st.header_set = true;
+        st.contributions[0] = std::move(payload);
+      }
+    }
+    // Poll worker sockets.
+    if (!pfds.empty()) {
+      int rc = ::poll(pfds.data(), pfds.size(), 20);
+      if (rc > 0) {
+        for (size_t i = 0; i < pfds.size(); ++i) {
+          if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+          std::string frame;
+          if (!data_socks_[pfd_ranks[i]].RecvFrame(&frame)) {
+            // Worker gone: fail all outstanding ops it belonged to.
+            std::lock_guard<std::mutex> l(data_mu_);
+            if (data_shutdown_) return;
+            aborted_ = true;
+            data_shutdown_ = true;
+            data_cv_.notify_all();
+            return;
+          }
+          Reader rd(frame);
+          DataOpHeader h;
+          int rank = rd.GetI32();
+          h.seq = rd.GetI64();
+          h.op = static_cast<OpType>(rd.GetI32());
+          h.dtype = static_cast<DataType>(rd.GetI32());
+          h.reduce_op = static_cast<ReduceOp>(rd.GetI32());
+          h.process_set_id = rd.GetI32();
+          h.root_rank = rd.GetI32();
+          h.row_bytes = rd.GetI64();
+          h.splits = rd.GetI64Vec();
+          std::string payload = rd.GetString();
+          std::lock_guard<std::mutex> l(data_mu_);
+          DataOpState& st = data_ops_[h.seq];
+          st.header = h;
+          st.header_set = true;
+          st.contributions[rank] = std::move(payload);
+        }
+      }
+    } else {
+      // Single-process-set-of-one corner: nothing to poll, just pace.
+      std::unique_lock<std::mutex> l(data_mu_);
+      data_cv_.wait_for(l, std::chrono::milliseconds(5), [this] {
+        return data_shutdown_ || !local_contrib_.empty();
+      });
+      if (data_shutdown_) return;
+      continue;
+    }
+    // Complete any ops whose member set is fully present.
+    std::vector<int64_t> done;
+    {
+      std::lock_guard<std::mutex> l(data_mu_);
+      for (auto& kv : data_ops_) {
+        DataOpState& st = kv.second;
+        if (!st.header_set) continue;
+        std::vector<int> members;
+        if (!process_sets_.Ranks(st.header.process_set_id, &members)) continue;
+        bool complete = true;
+        for (int m : members) {
+          if (!st.contributions.count(m)) {
+            complete = false;
+            break;
+          }
+        }
+        if (complete) done.push_back(kv.first);
+      }
+    }
+    for (int64_t seq : done) {
+      DataOpState st;
+      {
+        std::lock_guard<std::mutex> l(data_mu_);
+        st = std::move(data_ops_[seq]);
+        data_ops_.erase(seq);
+      }
+      CompleteDataOp(st);
+    }
+  }
+}
+
+void SocketController::ExecuteDataOp(
+    const DataOpHeader& h, const std::map<int, std::string>& contribs,
+    const std::vector<int>& members, std::map<int, std::string>* replies) {
+  // Uniform reply frame: [i64 meta vec][payload string].
+  auto make_reply = [](const std::vector<int64_t>& meta,
+                       const std::string& payload) {
+    Writer w;
+    w.PutI64Vec(meta);
+    w.PutString(payload);
+    return w.Take();
+  };
+  switch (h.op) {
+    case OpType::ALLREDUCE:
+    case OpType::REDUCESCATTER: {
+      std::string acc = contribs.at(members.front());
+      int item = ItemSize(h.dtype);
+      int64_t count = static_cast<int64_t>(acc.size()) / item;
+      for (size_t i = 1; i < members.size(); ++i) {
+        const std::string& c = contribs.at(members[i]);
+        ReduceInto(&acc[0], c.data(), count, h.dtype, h.reduce_op);
+      }
+      std::string reply = make_reply({}, acc);
+      for (int m : members) (*replies)[m] = reply;
+      break;
+    }
+    case OpType::ALLGATHER: {
+      std::string all;
+      std::vector<int64_t> counts;
+      for (int m : members) {
+        const std::string& c = contribs.at(m);
+        counts.push_back(static_cast<int64_t>(c.size()));
+        all += c;
+      }
+      std::string reply = make_reply(counts, all);
+      for (int m : members) (*replies)[m] = reply;
+      break;
+    }
+    case OpType::BROADCAST: {
+      const std::string& payload = contribs.at(h.root_rank);
+      std::string reply = make_reply({}, payload);
+      for (int m : members) (*replies)[m] = reply;
+      break;
+    }
+    case OpType::ALLTOALL: {
+      // splits live per-contribution: we re-read them from each sender's
+      // header copy — but headers are per-op here, so senders pack their
+      // splits at the front of the payload instead.
+      // Payload layout: [i64 n][splits...][bytes]
+      std::map<int, std::vector<int64_t>> splits;
+      std::map<int, std::string> bufs;
+      for (int m : members) {
+        Reader rd(contribs.at(m));
+        splits[m] = rd.GetI64Vec();
+        bufs[m] = rd.GetString();
+      }
+      for (size_t j = 0; j < members.size(); ++j) {
+        int dest = members[j];
+        std::string out;
+        std::vector<int64_t> recv_splits;
+        for (int src : members) {
+          const auto& sp = splits[src];
+          int64_t offset_rows = 0;
+          for (size_t k = 0; k < j; ++k) offset_rows += sp[k];
+          int64_t rows = sp[j];
+          out.append(bufs[src].data() + offset_rows * h.row_bytes,
+                     rows * h.row_bytes);
+          recv_splits.push_back(rows);
+        }
+        (*replies)[dest] = make_reply(recv_splits, out);
+      }
+      break;
+    }
+    case OpType::BARRIER:
+    case OpType::JOIN: {
+      std::string reply = make_reply({}, "");
+      for (int m : members) (*replies)[m] = reply;
+      break;
+    }
+  }
+}
+
+void SocketController::CompleteDataOp(DataOpState& st) {
+  std::vector<int> members;
+  process_sets_.Ranks(st.header.process_set_id, &members);
+  std::map<int, std::string> replies;
+  ExecuteDataOp(st.header, st.contributions, members, &replies);
+  for (auto& [rank, reply] : replies) {
+    if (rank == 0) {
+      std::lock_guard<std::mutex> l(data_mu_);
+      local_reply_[st.header.seq] = std::move(reply);
+      data_cv_.notify_all();
+    } else {
+      if (!data_socks_[rank].SendFrame(reply)) {
+        HVD_LOG(WARNING) << "data reply to rank " << rank << " failed";
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Public data-plane API (called from the Python executor thread)
+// ---------------------------------------------------------------------------
+
+namespace {
+// Parse the uniform reply frame.
+void ParseReply(const std::string& reply, std::vector<int64_t>* meta,
+                std::string* payload) {
+  Reader rd(reply);
+  *meta = rd.GetI64Vec();
+  *payload = rd.GetString();
+}
+}  // namespace
+
+Status SocketController::AllreduceBuffer(void* buf, int64_t count,
+                                         DataType dtype, ReduceOp op,
+                                         int psid) {
+  DataOpHeader h;
+  h.seq = current_seq_;
+  h.op = OpType::ALLREDUCE;
+  h.dtype = dtype;
+  h.reduce_op = op;
+  h.process_set_id = psid;
+  int64_t nbytes = count * ItemSize(dtype);
+  std::string payload(static_cast<const char*>(buf), nbytes);
+  std::string reply;
+  Status s = MemberDataOp(h, payload, &reply);
+  if (!s.ok()) return s;
+  std::vector<int64_t> meta;
+  std::string out;
+  ParseReply(reply, &meta, &out);
+  std::memcpy(buf, out.data(), nbytes);
+  return Status::OK();
+}
+
+Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
+                                         int psid, std::string* out,
+                                         std::vector<int64_t>* per_rank) {
+  DataOpHeader h;
+  h.seq = current_seq_;
+  h.op = OpType::ALLGATHER;
+  h.process_set_id = psid;
+  std::string payload(static_cast<const char*>(in), nbytes);
+  std::string reply;
+  Status s = MemberDataOp(h, payload, &reply);
+  if (!s.ok()) return s;
+  ParseReply(reply, per_rank, out);
+  return Status::OK();
+}
+
+Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
+                                         int root_rank, int psid) {
+  DataOpHeader h;
+  h.seq = current_seq_;
+  h.op = OpType::BROADCAST;
+  h.process_set_id = psid;
+  h.root_rank = root_rank;
+  std::string payload;
+  if (cfg_.rank == root_rank) {
+    payload.assign(static_cast<const char*>(buf), nbytes);
+  }
+  std::string reply;
+  Status s = MemberDataOp(h, payload, &reply);
+  if (!s.ok()) return s;
+  std::vector<int64_t> meta;
+  std::string out;
+  ParseReply(reply, &meta, &out);
+  if (static_cast<int64_t>(out.size()) != nbytes) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "broadcast size mismatch across ranks");
+  }
+  std::memcpy(buf, out.data(), nbytes);
+  return Status::OK();
+}
+
+Status SocketController::AlltoallBuffer(const void* in,
+                                        const std::vector<int64_t>& splits,
+                                        int64_t row_bytes, int psid,
+                                        std::string* out,
+                                        std::vector<int64_t>* recv_splits) {
+  DataOpHeader h;
+  h.seq = current_seq_;
+  h.op = OpType::ALLTOALL;
+  h.process_set_id = psid;
+  h.row_bytes = row_bytes;
+  int64_t rows = 0;
+  for (auto v : splits) rows += v;
+  Writer w;
+  w.PutI64Vec(splits);
+  w.PutString(std::string(static_cast<const char*>(in), rows * row_bytes));
+  std::string reply;
+  Status s = MemberDataOp(h, w.data(), &reply);
+  if (!s.ok()) return s;
+  ParseReply(reply, recv_splits, out);
+  return Status::OK();
+}
+
+Status SocketController::Barrier(int psid) {
+  DataOpHeader h;
+  h.seq = current_seq_;
+  h.op = OpType::BARRIER;
+  h.process_set_id = psid;
+  std::string reply;
+  return MemberDataOp(h, "", &reply);
+}
+
+}  // namespace hvdtpu

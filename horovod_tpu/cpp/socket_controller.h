@@ -1,0 +1,146 @@
+// Multi-process controller: rank-0 coordinator negotiation over TCP plus a
+// coordinator-rooted host data plane.
+//
+// Reference analogs (SURVEY.md §2.1, §3.2): controller.cc
+// Controller::ComputeResponseList (rank-0 request intersection), gloo/
+// (MPI-free CPU transport + rendezvous), response_cache.cc (bit-vector
+// steady state), stall_inspector.cc (per-rank missing lists).
+//
+// Protocol (per negotiation cycle, lock-step):
+//   worker -> coord : CYCLE frame = [n_cached, cached_ids...,
+//                                    n_requests, full requests...]
+//   coord  -> worker: RESPONSES frame = [n, responses...]
+// A tensor becomes ready when every rank of its process set has announced
+// it; readiness order is deterministic, so the fused response list is
+// byte-identical on every rank — which is what lets the TPU device path
+// dispatch one cached fused XLA program per response with no further
+// coordination.
+//
+// Data plane: members send DATA frames (tagged by the response's global
+// seq) to the coordinator's data service thread, which combines and
+// replies.  Host arrays only — the TPU path never touches these sockets.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "controller.h"
+#include "response_cache.h"
+#include "socketio.h"
+
+namespace hvdtpu {
+
+class SocketController : public Controller {
+ public:
+  explicit SocketController(const CoreConfig& cfg);
+  ~SocketController() override;
+
+  Status Initialize() override;
+  void Shutdown() override;
+
+  Status ComputeResponses(std::vector<TensorRequest>& new_requests,
+                          std::vector<Response>* out) override;
+
+  Status AllreduceBuffer(void* buf, int64_t count, DataType dtype, ReduceOp op,
+                         int process_set_id) override;
+  Status AllgatherBuffer(const void* in, int64_t nbytes, int process_set_id,
+                         std::string* out,
+                         std::vector<int64_t>* nbytes_per_rank) override;
+  Status BroadcastBuffer(void* buf, int64_t nbytes, int root_rank,
+                         int process_set_id) override;
+  Status AlltoallBuffer(const void* in, const std::vector<int64_t>& splits,
+                        int64_t row_bytes, int process_set_id,
+                        std::string* out,
+                        std::vector<int64_t>* recv_splits) override;
+  Status Barrier(int process_set_id) override;
+
+  std::string StallReport(double older_than_s) override;
+
+  // The executor calls this before each data-plane op to tag frames.
+  void SetCurrentSeq(int64_t seq) { current_seq_ = seq; }
+
+ private:
+  struct Pending {
+    TensorRequest meta;
+    std::set<int> announced;
+    int64_t order = 0;      // arrival order at coordinator (determinism)
+    double first_seen = 0;  // stall inspection
+  };
+
+  // -- negotiation ----------------------------------------------------------
+  Status CoordinatorCycle(std::vector<TensorRequest>& new_requests,
+                          std::vector<Response>* out);
+  Status WorkerCycle(std::vector<TensorRequest>& new_requests,
+                     std::vector<Response>* out);
+  void Announce(int rank, TensorRequest req, std::vector<Response>* errors);
+  void UpdateCachesAndSeq(std::vector<Response>* responses);
+
+  // -- data plane -----------------------------------------------------------
+  struct DataOpHeader {
+    int64_t seq = 0;
+    OpType op = OpType::BARRIER;
+    DataType dtype = DataType::FLOAT32;
+    ReduceOp reduce_op = ReduceOp::SUM;
+    int32_t process_set_id = 0;
+    int32_t root_rank = 0;
+    int64_t row_bytes = 0;
+    std::vector<int64_t> splits;
+  };
+  struct DataOpState {
+    DataOpHeader header;
+    std::map<int, std::string> contributions;  // rank -> payload
+    bool header_set = false;
+  };
+  // Executes a data op as a member (worker: over the socket; coordinator:
+  // via the local channel to the data service thread).
+  Status MemberDataOp(const DataOpHeader& h, const std::string& payload,
+                      std::string* reply);
+  void DataServiceLoop();
+  void CompleteDataOp(DataOpState& st);
+  static void ExecuteDataOp(const DataOpHeader& h,
+                            const std::map<int, std::string>& contribs,
+                            const std::vector<int>& members,
+                            std::map<int, std::string>* replies);
+
+  // -- wiring ---------------------------------------------------------------
+  bool is_coordinator() const { return cfg_.rank == 0; }
+
+  Listener listener_;
+  // coordinator: per-worker sockets (index = rank, [0] unused)
+  std::vector<Socket> ctrl_socks_;
+  std::vector<Socket> data_socks_;
+  // worker: connections to the coordinator
+  Socket coord_ctrl_;
+  Socket coord_data_;
+
+  ResponseCache cache_;
+  std::map<std::string, Pending> pending_;  // coordinator only
+  int64_t arrival_counter_ = 0;
+  int64_t seq_counter_ = 0;   // global data-op sequence (all ranks agree)
+  int64_t current_seq_ = -1;  // seq for the next data op on this rank
+
+  // coordinator data service
+  std::thread data_thread_;
+  std::mutex data_mu_;
+  std::condition_variable data_cv_;
+  std::map<int64_t, DataOpState> data_ops_;
+  std::map<int64_t, std::map<int, std::string>> data_replies_;
+  bool data_shutdown_ = false;
+  // local (rank 0) contribution channel into the data service
+  std::deque<std::pair<DataOpHeader, std::string>> local_contrib_;
+  std::map<int64_t, std::string> local_reply_;
+  std::map<int64_t, std::vector<int64_t>> reply_splits_;  // seq -> counts
+
+  bool initialized_ = false;
+  bool aborted_ = false;
+};
+
+}  // namespace hvdtpu

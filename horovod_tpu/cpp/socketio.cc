@@ -1,0 +1,213 @@
+#include "socketio.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+double MonotonicSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---- request/response serialization ---------------------------------------
+
+void SerializeRequest(const TensorRequest& r, Writer* w) {
+  w->PutString(r.name);
+  w->PutI32(static_cast<int32_t>(r.op));
+  w->PutI32(static_cast<int32_t>(r.dtype));
+  w->PutI32(static_cast<int32_t>(r.reduce_op));
+  w->PutI64(r.nbytes);
+  w->PutI64Vec(r.shape);
+  w->PutI32(r.process_set_id);
+  w->PutI32(r.root_rank);
+  w->PutF64(r.prescale);
+  w->PutF64(r.postscale);
+  w->PutI64Vec(r.splits);
+}
+
+TensorRequest DeserializeRequest(Reader* r) {
+  TensorRequest t;
+  t.name = r->GetString();
+  t.op = static_cast<OpType>(r->GetI32());
+  t.dtype = static_cast<DataType>(r->GetI32());
+  t.reduce_op = static_cast<ReduceOp>(r->GetI32());
+  t.nbytes = r->GetI64();
+  t.shape = r->GetI64Vec();
+  t.process_set_id = r->GetI32();
+  t.root_rank = r->GetI32();
+  t.prescale = r->GetF64();
+  t.postscale = r->GetF64();
+  t.splits = r->GetI64Vec();
+  return t;
+}
+
+void SerializeResponse(const Response& r, Writer* w) {
+  w->PutI32(static_cast<int32_t>(r.op));
+  w->PutI32(static_cast<int32_t>(r.dtype));
+  w->PutI32(r.process_set_id);
+  w->PutString(r.error);
+  w->PutU8(r.cache_hit ? 1 : 0);
+  w->PutI64(r.seq);
+  w->PutI32(static_cast<int32_t>(r.metas.size()));
+  for (const auto& m : r.metas) SerializeRequest(m, w);
+}
+
+Response DeserializeResponse(Reader* r) {
+  Response resp;
+  resp.op = static_cast<OpType>(r->GetI32());
+  resp.dtype = static_cast<DataType>(r->GetI32());
+  resp.process_set_id = r->GetI32();
+  resp.error = r->GetString();
+  resp.cache_hit = r->GetU8() != 0;
+  resp.seq = r->GetI64();
+  int32_t n = r->GetI32();
+  resp.metas.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    resp.metas.push_back(DeserializeRequest(r));
+    resp.names.push_back(resp.metas.back().name);
+  }
+  return resp;
+}
+
+// ---- Socket ---------------------------------------------------------------
+
+Socket::~Socket() { Close(); }
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void Socket::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Socket::Connect(const std::string& addr, int port, double timeout_s) {
+  double deadline = MonotonicSeconds() + timeout_s;
+  while (MonotonicSeconds() < deadline) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, addr.c_str(), &sa.sin_addr) != 1) {
+      ::close(fd);
+      return false;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
+      fd_ = fd;
+      return true;
+    }
+    ::close(fd);
+    // Rendezvous race: the coordinator may not be listening yet.
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  return false;
+}
+
+bool Socket::SendAll(const void* p, size_t n) {
+  const char* c = static_cast<const char*>(p);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd_, c + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool Socket::RecvAll(void* p, size_t n) {
+  char* c = static_cast<char*>(p);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd_, c + got, n - got, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool Socket::SendFrame(const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  if (!SendAll(&len, 4)) return false;
+  return payload.empty() || SendAll(payload.data(), payload.size());
+}
+
+bool Socket::RecvFrame(std::string* payload) {
+  uint32_t len = 0;
+  if (!RecvAll(&len, 4)) return false;
+  payload->resize(len);
+  if (len == 0) return true;
+  return RecvAll(&(*payload)[0], len);
+}
+
+// ---- Listener -------------------------------------------------------------
+
+Listener::~Listener() { Close(); }
+
+void Listener::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Listener::Listen(const std::string& addr, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, addr.c_str(), &sa.sin_addr) != 1) return false;
+  if (::bind(fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    HVD_LOG(ERROR) << "bind(" << addr << ":" << port << ") failed: " << errno;
+    return false;
+  }
+  socklen_t slen = sizeof(sa);
+  ::getsockname(fd_, reinterpret_cast<sockaddr*>(&sa), &slen);
+  port_ = ntohs(sa.sin_port);
+  return ::listen(fd_, 64) == 0;
+}
+
+Socket Listener::Accept(double timeout_s) {
+  pollfd pfd{fd_, POLLIN, 0};
+  int rc = ::poll(&pfd, 1, static_cast<int>(timeout_s * 1000));
+  if (rc <= 0) return Socket();
+  int cfd = ::accept(fd_, nullptr, nullptr);
+  if (cfd < 0) return Socket();
+  int one = 1;
+  ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return Socket(cfd);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,129 @@
+// Minimal TCP framing + binary serialization for the control/data planes.
+//
+// TPU-native analog of the reference's wire layer (horovod/common/wire/ +
+// gloo HTTP rendezvous; SURVEY.md §2.1 "Wire messages"): length-prefixed
+// frames over blocking sockets, little-endian scalar encoding.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// ---- byte buffer ----------------------------------------------------------
+
+class Writer {
+ public:
+  void PutI32(int32_t v) { PutRaw(&v, 4); }
+  void PutI64(int64_t v) { PutRaw(&v, 8); }
+  void PutF64(double v) { PutRaw(&v, 8); }
+  void PutU8(uint8_t v) { PutRaw(&v, 1); }
+  void PutString(const std::string& s) {
+    PutI32(static_cast<int32_t>(s.size()));
+    PutRaw(s.data(), s.size());
+  }
+  void PutI64Vec(const std::vector<int64_t>& v) {
+    PutI32(static_cast<int32_t>(v.size()));
+    for (int64_t x : v) PutI64(x);
+  }
+  void PutRaw(const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    buf_.insert(buf_.end(), c, c + n);
+  }
+  const std::string& data() const { return buf_; }
+  std::string&& Take() { return std::move(buf_); }
+
+ private:
+  std::string buf_;
+};
+
+class Reader {
+ public:
+  Reader(const char* data, size_t size) : data_(data), size_(size) {}
+  explicit Reader(const std::string& s) : data_(s.data()), size_(s.size()) {}
+  int32_t GetI32() { int32_t v; Get(&v, 4); return v; }
+  int64_t GetI64() { int64_t v; Get(&v, 8); return v; }
+  double GetF64() { double v; Get(&v, 8); return v; }
+  uint8_t GetU8() { uint8_t v; Get(&v, 1); return v; }
+  std::string GetString() {
+    int32_t n = GetI32();
+    std::string s(data_ + pos_, n);
+    pos_ += n;
+    return s;
+  }
+  std::vector<int64_t> GetI64Vec() {
+    int32_t n = GetI32();
+    std::vector<int64_t> v(n);
+    for (int32_t i = 0; i < n; ++i) v[i] = GetI64();
+    return v;
+  }
+  bool ok() const { return ok_; }
+  size_t remaining() const { return size_ - pos_; }
+
+ private:
+  void Get(void* out, size_t n) {
+    if (pos_ + n > size_) { ok_ = false; std::memset(out, 0, n); return; }
+    std::memcpy(out, data_ + pos_, n);
+    pos_ += n;
+  }
+  const char* data_;
+  size_t size_;
+  size_t pos_ = 0;
+  bool ok_ = true;
+};
+
+// ---- request/response serialization ---------------------------------------
+
+void SerializeRequest(const TensorRequest& r, Writer* w);
+TensorRequest DeserializeRequest(Reader* r);
+void SerializeResponse(const Response& r, Writer* w);
+Response DeserializeResponse(Reader* r);
+
+// ---- sockets --------------------------------------------------------------
+
+// Blocking TCP socket with u32-length-prefixed frames.  All methods return
+// false on peer close / error (callers treat that as ABORTED).
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  ~Socket();
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+
+  bool Connect(const std::string& addr, int port, double timeout_s);
+  bool SendFrame(const std::string& payload);
+  bool RecvFrame(std::string* payload);
+  // Raw (unframed) helpers for bulk data-plane payloads.
+  bool SendAll(const void* p, size_t n);
+  bool RecvAll(void* p, size_t n);
+  void Close();
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+
+ private:
+  int fd_ = -1;
+};
+
+// Listening socket; Accept returns connected Sockets.
+class Listener {
+ public:
+  // Binds to addr:port; if port==0 an ephemeral port is chosen and stored.
+  bool Listen(const std::string& addr, int port);
+  Socket Accept(double timeout_s);
+  int port() const { return port_; }
+  void Close();
+  ~Listener();
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+}  // namespace hvdtpu

@@ -1,0 +1,130 @@
+#include "timeline.h"
+
+#include <functional>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+namespace {
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if (static_cast<unsigned char>(c) < 0x20) {
+      out += ' ';
+    } else {
+      out.push_back(c);
+    }
+  }
+  return out;
+}
+}  // namespace
+
+Timeline::~Timeline() { Stop(); }
+
+void Timeline::Start(const std::string& path, bool mark_cycles) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (enabled_) return;
+  file_ = std::fopen(path.c_str(), "w");
+  if (!file_) return;
+  std::fputs("[\n", file_);
+  first_event_ = true;
+  t0_ = MonotonicSeconds();
+  mark_cycles_ = mark_cycles;
+  shutdown_ = false;
+  enabled_ = true;
+  writer_ = std::thread([this] { WriterLoop(); });
+}
+
+void Timeline::Stop() {
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    if (!enabled_) return;
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+  if (writer_.joinable()) writer_.join();
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    if (file_) {
+      std::fputs("\n]\n", file_);
+      std::fclose(file_);
+      file_ = nullptr;
+    }
+    enabled_ = false;
+  }
+}
+
+int64_t Timeline::NowUs() const {
+  return static_cast<int64_t>((MonotonicSeconds() - t0_) * 1e6);
+}
+
+void Timeline::Emit(std::string json_line) {
+  std::lock_guard<std::mutex> l(mu_);
+  if (!enabled_) return;
+  queue_.push_back(std::move(json_line));
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  for (;;) {
+    std::deque<std::string> batch;
+    {
+      std::unique_lock<std::mutex> l(mu_);
+      cv_.wait(l, [this] { return shutdown_ || !queue_.empty(); });
+      batch.swap(queue_);
+      if (batch.empty() && shutdown_) return;
+    }
+    std::lock_guard<std::mutex> l(mu_);
+    if (!file_) return;
+    for (auto& ev : batch) {
+      if (!first_event_) std::fputs(",\n", file_);
+      std::fputs(ev.c_str(), file_);
+      first_event_ = false;
+    }
+    std::fflush(file_);
+  }
+}
+
+void Timeline::Begin(const std::string& tensor, const std::string& phase) {
+  if (!enabled_) return;
+  int64_t tid = static_cast<int64_t>(std::hash<std::string>{}(tensor) & 0x7fffffff);
+  char buf[512];
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\":\"%s\",\"ph\":\"B\",\"ts\":%lld,\"pid\":0,"
+                "\"tid\":%lld,\"args\":{\"tensor\":\"%s\"}}",
+                JsonEscape(phase).c_str(), static_cast<long long>(NowUs()),
+                static_cast<long long>(tid), JsonEscape(tensor).c_str());
+  Emit(buf);
+}
+
+void Timeline::End(const std::string& tensor, const std::string& phase) {
+  if (!enabled_) return;
+  int64_t tid = static_cast<int64_t>(std::hash<std::string>{}(tensor) & 0x7fffffff);
+  char buf[256];
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\":\"%s\",\"ph\":\"E\",\"ts\":%lld,\"pid\":0,\"tid\":%lld}",
+                JsonEscape(phase).c_str(), static_cast<long long>(NowUs()),
+                static_cast<long long>(tid));
+  Emit(buf);
+}
+
+void Timeline::Instant(const std::string& name) {
+  if (!enabled_) return;
+  char buf[256];
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\":\"%s\",\"ph\":\"i\",\"ts\":%lld,\"pid\":0,\"tid\":0,"
+                "\"s\":\"p\"}",
+                JsonEscape(name).c_str(), static_cast<long long>(NowUs()));
+  Emit(buf);
+}
+
+void Timeline::MarkCycle() {
+  if (mark_cycles_) Instant("CYCLE");
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,44 @@
+// Chrome about:tracing timeline (reference: horovod/common/timeline.h —
+// Timeline + TimelineWriter with a dedicated writer thread; SURVEY.md §5).
+#pragma once
+
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  ~Timeline();
+  void Start(const std::string& path, bool mark_cycles);
+  void Stop();
+  bool enabled() const { return enabled_; }
+
+  // Phase events keyed by tensor name (B/E pairs on a per-tensor lane).
+  void Begin(const std::string& tensor, const std::string& phase);
+  void End(const std::string& tensor, const std::string& phase);
+  void Instant(const std::string& name);
+  void MarkCycle();
+
+ private:
+  void Emit(std::string json_line);
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  bool enabled_ = false;
+  bool mark_cycles_ = false;
+  double t0_ = 0.0;
+  FILE* file_ = nullptr;
+  bool first_event_ = true;
+  std::thread writer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  bool shutdown_ = false;
+};
+
+}  // namespace hvdtpu

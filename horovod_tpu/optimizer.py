@@ -167,7 +167,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                         return x
                     axes = (ax,) if isinstance(ax, str) else tuple(ax)
                     missing = tuple(a for a in axes if a not in vma)
-                    return jax.lax.pvary(x, missing) if missing else x
+                    return jax.lax.pcast(x, missing, to="varying") if missing else x
 
                 return jax.tree_util.tree_map(cast, tree)
 

@@ -1,0 +1,1 @@
+from .run_api import run  # noqa: F401

@@ -1,0 +1,38 @@
+"""Worker entry point for the programmatic run() API.
+
+Reference analog: horovod/runner/__init__.py's _run_func path (a pickled
+function shipped to each worker).  Invoked as:
+    python -m horovod_tpu.runner._exec_fn <payload.pkl> <out_dir>
+"""
+
+import os
+import sys
+import traceback
+
+
+def main() -> int:
+    payload_path, out_dir = sys.argv[1], sys.argv[2]
+    rank = os.environ.get("HOROVOD_RANK", "0")
+    try:
+        import cloudpickle
+
+        with open(payload_path, "rb") as f:
+            fn, args, kwargs = cloudpickle.load(f)
+        result = fn(*args, **kwargs)
+        status, value = "ok", result
+    except BaseException as exc:  # noqa: BLE001 - report to parent
+        traceback.print_exc()
+        status, value = "error", f"{type(exc).__name__}: {exc}"
+    try:
+        import cloudpickle
+
+        with open(os.path.join(out_dir, f"result_{rank}.pkl"), "wb") as f:
+            cloudpickle.dump((status, value), f)
+    except Exception:
+        traceback.print_exc()
+        return 3
+    return 0 if status == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,258 @@
+"""horovodrun-equivalent launcher.
+
+Reference: horovod/runner/launch.py (parse_args/_run/run_commandline) +
+gloo_run.py (launch_gloo: rendezvous env + one worker per slot);
+SURVEY.md §2.5, §3.4.  The TPU build launches one worker process per slot
+with the same env-var contract (HOROVOD_RANK/SIZE/LOCAL_RANK/...,
+HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT), a socket-controller rendezvous instead
+of Gloo's HTTP KV store, and ssh for remote hosts.
+
+Usage:
+    horovodrun -np 4 python train.py
+    python -m horovod_tpu.runner.launch -np 2 -H hostA:1,hostB:1 python t.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from .util import assign_ranks, find_free_port, local_hostnames, parse_hosts
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-np", "--num-proc", type=int, required=False,
+                   help="Total number of worker processes.")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="host1:slots,host2:slots (default: localhost:np)")
+    p.add_argument("-p", "--ssh-port", type=int, default=None)
+    p.add_argument("--network-interface", default=None,
+                   help="accepted for reference parity; unused")
+    p.add_argument("--start-timeout", type=int, default=60)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--disable-cache", action="store_true",
+                   help="disable the response cache")
+    # Elastic flags (reference parity; driver in horovod_tpu.runner.elastic).
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--slots-per-host", type=int, default=1,
+                   help="slots per discovered host (elastic mode)")
+    # Tuning flags mirroring the reference CLI -> env contract.
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--stall-check-disable", action="store_true")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=None)
+    p.add_argument("--log-level", default=None)
+    p.add_argument("--check-build", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command")
+    args = p.parse_args(argv)
+    return args
+
+
+def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.disable_cache:
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.stall_check_disable:
+        env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    if args.stall_check_warning_time_seconds is not None:
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_warning_time_seconds)
+    if args.log_level:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    return env
+
+
+def check_build(out=sys.stdout) -> None:
+    import horovod_tpu as hvd
+
+    print("Horovod-TPU v%s:" % hvd.__version__, file=out)
+    print("Available Frameworks:", file=out)
+    print("    [X] JAX", file=out)
+    print("Available Controllers:", file=out)
+    print("    [X] TPU socket controller (gloo-equivalent)", file=out)
+    print("    [%s] native C++ core" % ("X" if hvd.native_core_built() else " "),
+          file=out)
+    print("Available Data Planes:", file=out)
+    print("    [X] XLA collectives over ICI (jit)", file=out)
+    print("    [X] host TCP collectives (eager, multi-process)", file=out)
+
+
+class WorkerProcesses:
+    """Spawn and supervise one process per rank (reference: gloo_run's
+    exec + the launcher's output streaming/exit handling)."""
+
+    def __init__(self):
+        self.procs: List[subprocess.Popen] = []
+        self._lock = threading.Lock()
+        self._failed_rank: Optional[int] = None
+
+    def launch(self, assignments, command: List[str], base_env: Dict[str, str],
+               rendezvous_addr: str, rendezvous_port: int,
+               ssh_port: Optional[int] = None, verbose: bool = False,
+               stream_prefix: bool = True):
+        threads = []
+        for a in assignments:
+            env = dict(base_env)
+            env.update({
+                "HOROVOD_RANK": str(a["rank"]),
+                "HOROVOD_SIZE": str(len(assignments)),
+                "HOROVOD_LOCAL_RANK": str(a["local_rank"]),
+                "HOROVOD_LOCAL_SIZE": str(a["local_size"]),
+                "HOROVOD_CROSS_RANK": str(a["cross_rank"]),
+                "HOROVOD_CROSS_SIZE": str(a["cross_size"]),
+                "HOROVOD_CONTROLLER": "socket",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": rendezvous_addr,
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
+            })
+            if a["hostname"] in local_hostnames():
+                proc = subprocess.Popen(
+                    command, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True)
+            else:  # remote launch over ssh with env forwarding
+                env_str = " ".join(
+                    f"{k}={shlex.quote(v)}" for k, v in env.items()
+                    if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH",
+                                     "JAX_", "XLA_")))
+                ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+                if ssh_port:
+                    ssh_cmd += ["-p", str(ssh_port)]
+                remote = f"cd {shlex.quote(os.getcwd())} && env {env_str} " + \
+                    " ".join(shlex.quote(c) for c in command)
+                proc = subprocess.Popen(
+                    ssh_cmd + [a["hostname"], remote], stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True)
+            self.procs.append(proc)
+            t = threading.Thread(target=self._stream, daemon=True,
+                                 args=(a["rank"], proc, stream_prefix))
+            t.start()
+            threads.append(t)
+        return threads
+
+    def _stream(self, rank: int, proc: subprocess.Popen, prefix: bool):
+        for line in iter(proc.stdout.readline, ""):
+            if prefix:
+                sys.stdout.write(f"[{rank}]<stdout>: {line}")
+            else:
+                sys.stdout.write(line)
+            sys.stdout.flush()
+
+    def wait(self, kill_on_failure: bool = True) -> int:
+        """Wait for all workers; on the first failure, terminate the rest
+        (matching horovodrun's behavior)."""
+        exit_code = 0
+        pending = {i: p for i, p in enumerate(self.procs)}
+        while pending:
+            for rank, proc in list(pending.items()):
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                del pending[rank]
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    self._failed_rank = rank
+                    if kill_on_failure:
+                        for other in pending.values():
+                            try:
+                                other.send_signal(signal.SIGTERM)
+                            except OSError:
+                                pass
+            if pending:
+                import time
+
+                time.sleep(0.05)
+        return exit_code
+
+    def terminate(self):
+        for p in self.procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+
+
+def _run(args: argparse.Namespace) -> int:
+    if args.check_build:
+        check_build()
+        return 0
+    if not args.command:
+        print("error: no command given", file=sys.stderr)
+        return 2
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if args.host_discovery_script or args.min_np is not None:
+        from .elastic_driver import run_elastic
+
+        return run_elastic(args, command)
+    if args.num_proc is None:
+        print("error: -np is required", file=sys.stderr)
+        return 2
+
+    hosts = parse_hosts(args.hosts) if args.hosts else [
+        type("H", (), {"hostname": "localhost", "slots": args.num_proc})()]
+    assignments = assign_ranks(hosts, args.num_proc)
+
+    rendezvous_addr = "127.0.0.1"
+    if any(a["hostname"] not in local_hostnames() for a in assignments):
+        import socket as pysocket
+
+        rendezvous_addr = pysocket.gethostbyname(pysocket.gethostname())
+    rendezvous_port = find_free_port(
+        "0.0.0.0" if rendezvous_addr != "127.0.0.1" else "127.0.0.1")
+
+    base_env = dict(os.environ)
+    base_env.update(_tuning_env(args))
+
+    workers = WorkerProcesses()
+    workers.launch(assignments, command, base_env, rendezvous_addr,
+                   rendezvous_port, args.ssh_port, args.verbose)
+    try:
+        return workers.wait()
+    except KeyboardInterrupt:
+        workers.terminate()
+        return 130
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    return _run(parse_args(argv))
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

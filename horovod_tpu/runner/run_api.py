@@ -1,0 +1,82 @@
+"""Programmatic launcher: hvd.run(fn, np=2) -> per-rank results.
+
+Reference: horovod/runner/__init__.py run() (launches a pickled function on
+every worker and gathers return values); SURVEY.md §2.5.  Used heavily by
+tests/parallel to express multi-process collective tests as plain Python
+functions.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from .launch import WorkerProcesses
+from .util import assign_ranks, find_free_port, HostSlots
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 2, env: Optional[dict] = None, timeout: float = 300.0,
+        stream_prefix: bool = True, use_mpi: Optional[bool] = None,
+        use_gloo: Optional[bool] = None) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` local worker processes and
+    return the per-rank results ordered by rank.
+
+    Raises RuntimeError with the failing rank's traceback summary if any
+    worker fails.  ``use_mpi``/``use_gloo`` are accepted for reference
+    signature parity and ignored (there is one controller).
+    """
+    import cloudpickle
+
+    kwargs = kwargs or {}
+    # Pickle the function by value so workers don't need the caller's module
+    # on their import path (test functions, notebooks, __main__).
+    module = sys.modules.get(getattr(fn, "__module__", None))
+    if module is not None and module.__name__ not in ("builtins",):
+        try:
+            cloudpickle.register_pickle_by_value(module)
+        except Exception:
+            pass
+    with tempfile.TemporaryDirectory(prefix="hvd_run_") as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            cloudpickle.dump((fn, args, kwargs), f)
+
+        assignments = assign_ranks([HostSlots("localhost", np)], np)
+        port = find_free_port()
+        base_env = dict(os.environ)
+        if env:
+            base_env.update(env)
+        command = [sys.executable, "-m", "horovod_tpu.runner._exec_fn",
+                   payload, tmp]
+        workers = WorkerProcesses()
+        workers.launch(assignments, command, base_env, "127.0.0.1", port,
+                       stream_prefix=stream_prefix)
+        try:
+            exit_code = workers.wait()
+        except KeyboardInterrupt:
+            workers.terminate()
+            raise
+
+        results: List[Any] = []
+        errors: List[str] = []
+        for rank in range(np):
+            path = os.path.join(tmp, f"result_{rank}.pkl")
+            if not os.path.exists(path):
+                errors.append(f"rank {rank}: no result (exit={exit_code})")
+                results.append(None)
+                continue
+            with open(path, "rb") as f:
+                status, value = pickle.load(f)
+            if status == "ok":
+                results.append(value)
+            else:
+                errors.append(f"rank {rank}: {value}")
+                results.append(None)
+        if errors:
+            raise RuntimeError("horovod_tpu.run failed: " + "; ".join(errors))
+        return results

@@ -1,0 +1,69 @@
+"""Launcher utilities (reference: horovod/runner/common/util/{hosts,network}.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import List
+
+
+@dataclasses.dataclass
+class HostSlots:
+    hostname: str
+    slots: int
+
+
+def parse_hosts(hosts: str) -> List[HostSlots]:
+    """Parse '-H host1:2,host2:4' (reference: hosts.parse_hosts)."""
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append(HostSlots(name, int(slots)))
+        else:
+            out.append(HostSlots(part, 1))
+    return out
+
+
+def assign_ranks(hosts: List[HostSlots], np_: int):
+    """Round-robin-free block assignment of ranks to host slots, returning
+    a list of (rank, hostname, local_rank, local_size, cross_rank,
+    cross_size) like the reference's rank allocation."""
+    slots = []
+    for h in hosts:
+        for local_rank in range(h.slots):
+            slots.append((h.hostname, local_rank))
+    if np_ > len(slots):
+        raise ValueError(
+            f"requested -np {np_} exceeds available slots {len(slots)}")
+    slots = slots[:np_]
+    per_host: dict = {}
+    for hostname, _ in slots:
+        per_host[hostname] = per_host.get(hostname, 0) + 1
+    host_order = list(dict.fromkeys(h for h, _ in slots))
+    assignments = []
+    for rank, (hostname, local_rank) in enumerate(slots):
+        assignments.append({
+            "rank": rank,
+            "hostname": hostname,
+            "local_rank": local_rank,
+            "local_size": per_host[hostname],
+            "cross_rank": host_order.index(hostname),
+            "cross_size": len(host_order),
+        })
+    return assignments
+
+
+def find_free_port(addr: str = "127.0.0.1") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((addr, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def local_hostnames() -> List[str]:
+    return ["localhost", "127.0.0.1", socket.gethostname()]

@@ -13,6 +13,11 @@ import sys
 # override must go through jax.config because the environment's sitecustomize
 # imports jax at interpreter startup (env JAX_PLATFORMS is read then).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# This sandbox's sitecustomize dials a single-tenant TPU tunnel whenever
+# PALLAS_AXON_POOL_IPS is set; launcher-spawned worker subprocesses would
+# contend for it and hang.  Tests are CPU-only, so drop the trigger (the
+# change is inherited by every worker the launcher spawns).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,98 @@
+"""Benchmark: ResNet-50 training throughput through hvd.DistributedOptimizer.
+
+The reference's headline benchmark is ResNet-50 images/sec/GPU under
+``hvd.DistributedOptimizer`` (BASELINE.md: ~235 img/s on a P100 in the
+Horovod paper's setup, arXiv:1802.05799).  This measures the same workload
+on one TPU chip: full fwd+bwd+optimizer train step, bfloat16 activations,
+synthetic ImageNet-shaped data (the reference benchmarks use synthetic data
+too), with the gradient allreduce riding the framework's XLA data plane
+over a mesh axis — the code path multi-chip runs use.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is images/sec vs the reference's published per-device number.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+REFERENCE_IMG_PER_SEC_PER_DEVICE = 235.0  # Horovod paper, ResNet-50 on P100
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    import horovod_tpu as hvd
+    from horovod_tpu import models
+
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("hvd",))
+
+    batch_per_chip = 64
+    batch = batch_per_chip * n_dev
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    variables = jax.jit(lambda: model.init(rng, images[:8], train=False))()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                  axis_name="hvd")
+    opt_state = tx.init(params)
+
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            return models.xent_loss(logits, labels), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, hvd.allreduce(loss,
+                                                           axis_name="hvd")
+
+    step = jax.jit(
+        shard_map(train_step, mesh=mesh,
+                  in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
+                  out_specs=(P(), P(), P(), P())),
+        donate_argnums=(0, 1, 2))
+
+    # Warmup (compile + first steps).
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * n_steps / dt
+    img_per_sec_per_chip = img_per_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            img_per_sec_per_chip / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

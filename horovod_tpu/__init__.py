@@ -36,6 +36,7 @@ from .functions import (  # noqa: F401
     broadcast_object_fn, allgather_object,
 )
 from .compression import Compression  # noqa: F401
+from . import elastic  # noqa: F401
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,
 )

@@ -41,10 +41,16 @@ def _load_library() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            log.info("building native core in %s", _CPP_DIR)
+        # Always invoke make: it's incremental (no-op when up to date) and
+        # guarantees source edits are never shadowed by a stale .so.
+        try:
             subprocess.run(["make", "-s"], cwd=_CPP_DIR, check=True,
                            capture_output=True)
+        except (subprocess.CalledProcessError, OSError) as exc:
+            if not os.path.exists(_LIB_PATH):
+                raise
+            log.warning("native core rebuild failed (%s); using existing "
+                        "library", exc)
         lib = ctypes.CDLL(_LIB_PATH)
         _declare(lib)
         _lib = lib
@@ -118,6 +124,10 @@ class NativeCore(CoreBackend):
         self._lib = _load_library()
         self._cfg: Optional[Config] = None
         self._current_seq = -1
+        # Reused across pop_response calls (the executor polls every 50ms;
+        # a fresh 1MB allocation per poll would churn ~20MB/s at idle).
+        self._resp_cap = 1 << 16
+        self._resp_buf = ctypes.create_string_buffer(self._resp_cap)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self, cfg: Config) -> None:
@@ -179,12 +189,15 @@ class NativeCore(CoreBackend):
             raise NativeCoreError(f"enqueue failed rc={rc}")
 
     def pop_response(self, timeout: float) -> Optional[FusedResponse]:
-        cap = 1 << 20
-        buf = ctypes.create_string_buffer(cap)
-        n = self._lib.hvd_pop_response(buf, cap, int(timeout * 1000))
+        n = self._lib.hvd_pop_response(self._resp_buf, self._resp_cap,
+                                       int(timeout * 1000))
+        while n == -2:  # buffer too small: the response stays queued; grow
+            self._resp_cap *= 4
+            self._resp_buf = ctypes.create_string_buffer(self._resp_cap)
+            n = self._lib.hvd_pop_response(self._resp_buf, self._resp_cap, 0)
         if n <= 0:
             return None
-        obj = json.loads(buf.raw[:n].decode())
+        obj = json.loads(self._resp_buf.raw[:n].decode())
         self._current_seq = obj.get("seq", -1)
         return FusedResponse(
             op=OpType(obj["op"]),

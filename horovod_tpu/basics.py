@@ -31,6 +31,12 @@ def init(comm=None, process_sets: Optional[Sequence] = None,
     """
     if HorovodContext.initialized():
         return
+    # Elastic mode: the driver assigns rank/size per rendezvous round over
+    # the coordinator connection before the core can start (SURVEY.md §3.5).
+    if config is None and os.environ.get("HOROVOD_ELASTIC") == "1":
+        from .elastic import client as _elastic_client
+
+        _elastic_client.ensure_assignment()
     cfg = config or Config.from_env()
     if comm is not None and not isinstance(comm, (list, tuple)):
         raise ValueError(

@@ -307,6 +307,10 @@ class HorovodContext:
                     f"process set size ({d0} vs {n})"
                 )
             splits = np.full((n,), d0 // n, dtype=np.int64)
+        if len(splits) != n:
+            raise HorovodInternalError(
+                f"alltoall splits must have one entry per process-set rank "
+                f"({len(splits)} given, {n} ranks)")
         if int(splits.sum()) != e.array.shape[0]:
             raise HorovodInternalError("alltoall splits do not sum to first dim")
         buf = e.array.reshape(e.array.shape[0], -1)

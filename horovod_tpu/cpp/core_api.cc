@@ -12,6 +12,7 @@
 // Device (TPU) responses are executed in Python as jitted XLA collectives;
 // the core guarantees every rank pops byte-identical response lists.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstring>
@@ -55,9 +56,14 @@ struct GlobalState {
   CoreConfig cfg;
   std::unique_ptr<Controller> controller;
 
+  struct Outstanding {
+    int64_t handle;
+    double enqueued_at;  // for the stall-shutdown watchdog
+  };
+
   std::mutex queue_mu;
   std::vector<TensorRequest> queue;
-  std::unordered_map<std::string, int64_t> outstanding;  // name -> handle
+  std::unordered_map<std::string, Outstanding> outstanding;  // by name
 
   std::mutex out_mu;
   std::condition_variable out_cv;
@@ -110,7 +116,7 @@ void FailAllOutstanding(const std::string& reason) {
   err.error = reason;
   {
     std::lock_guard<std::mutex> l(g->queue_mu);
-    for (auto& kv : g->outstanding) err.handles.push_back(kv.second);
+    for (auto& kv : g->outstanding) err.handles.push_back(kv.second.handle);
     g->outstanding.clear();
     for (auto& r : g->queue) err.handles.push_back(r.handle);
     g->queue.clear();
@@ -159,7 +165,7 @@ void BackgroundLoop() {
       for (const auto& name : r.names) {
         auto it = g->outstanding.find(name);
         if (it != g->outstanding.end()) {
-          r.handles.push_back(it->second);
+          r.handles.push_back(it->second.handle);
           g->outstanding.erase(it);
           g->timeline.End(name, "NEGOTIATE");
         }
@@ -167,7 +173,17 @@ void BackgroundLoop() {
       for (const auto& m : r.metas) bytes += m.nbytes;
     }
     for (const auto& r : responses) {
-      if (!r.handles.empty()) DeliverResponse(r);
+      if (!r.error.empty() && r.handles.empty()) {
+        // Errors that name no local tensors (e.g. response-cache divergence
+        // detected by the coordinator) would otherwise vanish: fail the
+        // whole job so every blocked synchronize() wakes with the reason.
+        g->aborted.store(true);
+        SetLastError(r.error);
+        HVD_LOG(ERROR) << "negotiation error: " << r.error;
+        FailAllOutstanding("Horovod negotiation error: " + r.error);
+      } else if (!r.handles.empty()) {
+        DeliverResponse(r);
+      }
     }
     if (bytes > 0) g->params.RecordBytes(bytes);
 
@@ -190,16 +206,30 @@ void BackgroundLoop() {
                "others: "
             << report;
       }
-      std::lock_guard<std::mutex> l(g->queue_mu);
-      std::ostringstream local;
       int n = 0;
-      for (auto& kv : g->outstanding) {
-        (void)kv;
-        ++n;
+      double oldest_age = 0.0;
+      {
+        std::lock_guard<std::mutex> l(g->queue_mu);
+        for (auto& kv : g->outstanding) {
+          ++n;
+          oldest_age = std::max(oldest_age, now - kv.second.enqueued_at);
+        }
       }
       if (n > 0 && g->cfg.size == 1) {
         HVD_LOG(WARNING) << "Stall: " << n
                          << " tensor(s) pending negotiation locally";
+      }
+      // Stall-shutdown watchdog (reference: HOROVOD_STALL_SHUTDOWN_TIME_
+      // SECONDS aborts the job once a tensor has been stuck this long).
+      if (cfg.stall_shutdown_s > 0 && oldest_age > cfg.stall_shutdown_s) {
+        g->aborted.store(true);
+        std::string msg =
+            "stalled for more than " + std::to_string(cfg.stall_shutdown_s) +
+            "s waiting for negotiation (one or more ranks never submitted a "
+            "matching tensor); shutting down";
+        SetLastError(msg);
+        HVD_LOG(ERROR) << msg;
+        FailAllOutstanding("Horovod stall shutdown: " + msg);
       }
     }
   }
@@ -312,7 +342,7 @@ long long hvd_enqueue(long long handle, const char* name, int op, int dtype,
   {
     std::lock_guard<std::mutex> l(g->queue_mu);
     if (g->outstanding.count(r.name)) return -2;  // duplicate in flight
-    g->outstanding[r.name] = handle;
+    g->outstanding[r.name] = {handle, r.enqueued_at};
     g->queue.push_back(std::move(r));
   }
   g->timeline.Begin(name, "NEGOTIATE");

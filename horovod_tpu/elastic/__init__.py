@@ -1,0 +1,82 @@
+"""Elastic / fault-tolerant training (``hvd.elastic``).
+
+Reference analogs (SURVEY.md §3.5): horovod/common/elastic.py (run_fn),
+horovod/torch/elastic/ (state, sampler).  The retry loop: wrap the training
+function; on a failed collective (:class:`HorovodInternalError`) restore the
+last committed state, re-rendezvous, and re-run; on a driver-announced host
+change (:class:`HostsUpdatedInterrupt`) keep current state and
+re-rendezvous.  TPU pod preemptions surface as worker exits to the elastic
+driver, which re-forms the job from surviving hosts — the same recovery the
+reference does for failed GPU hosts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils.logging import get_logger
+from .state import State, ObjectState, JaxState, ElasticSampler  # noqa: F401
+from . import client as _client
+
+log = get_logger()
+
+
+def run(func):
+    """Decorator for the elastic training loop:
+
+        @hvd.elastic.run
+        def train(state, ...):
+            ...
+
+        state = hvd.elastic.JaxState(params=..., opt_state=..., epoch=0)
+        train(state)
+    """
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        import horovod_tpu as hvd
+
+        notification_manager = _client.notification_manager
+        reset_required = False
+        while True:
+            if reset_required:
+                _reset(state)
+                reset_required = False
+            state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as exc:
+                log.warning("elastic: collective failed (%s); restoring "
+                            "last committed state", exc)
+                if not _client.is_elastic_worker():
+                    raise
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt:
+                log.info("elastic: host set updated; re-rendezvousing")
+                if not _client.is_elastic_worker():
+                    raise
+                # Keep current (uncommitted) progress: the world changed but
+                # this worker's state is intact.
+                reset_required = True
+            finally:
+                # Swallow any update that raced with a failure we already
+                # handled, so the next round starts clean.
+                notification_manager.drain_updates()
+
+    return wrapper
+
+
+def _reset(state: State) -> None:
+    """Tear down collectives, wait for the next generation's assignment,
+    re-initialize, and notify user callbacks."""
+    import horovod_tpu as hvd
+
+    if hvd.is_initialized():
+        hvd.shutdown()
+    client = _client.get_client()
+    client.mark_ready()
+    client.wait_assignment()
+    hvd.init()
+    state.on_reset()

@@ -1,0 +1,168 @@
+"""Worker-side elastic coordination: registration, assignment, host-update
+notifications.
+
+Reference analogs (SURVEY.md §2.5, §3.5): horovod/runner/elastic/worker.py
+(WorkerNotificationService/Client/Manager) and the rendezvous re-round
+machinery in horovod/runner/elastic/rendezvous.py.  The wire protocol here
+is JSON lines over a persistent TCP connection to the elastic driver
+(``horovod_tpu.runner.elastic_driver``): the worker registers once at
+startup, receives a rank assignment per *generation* (rendezvous round),
+and the driver pushes ``hosts_updated`` events over the same connection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class NotificationManager:
+    """Collects driver-pushed host-update events; ``State.check_host_updates``
+    drains it (reference: WorkerNotificationManager)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._updates = 0
+
+    def notify(self) -> None:
+        with self._lock:
+            self._updates += 1
+
+    def drain_updates(self) -> int:
+        with self._lock:
+            n, self._updates = self._updates, 0
+            return n
+
+
+notification_manager = NotificationManager()
+
+
+class ElasticCoordinatorClient:
+    """Persistent connection to the elastic driver."""
+
+    def __init__(self):
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+        self._lock = threading.Lock()
+        self._assign_cv = threading.Condition(self._lock)
+        self._assignment: Optional[Dict[str, Any]] = None
+        self._assignment_gen = -1
+        self._consumed_gen = -1
+        self._reader: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- connection ---------------------------------------------------------
+    def connect(self) -> None:
+        if self._sock is not None:
+            return
+        addr = os.environ["HOROVOD_ELASTIC_COORD_ADDR"]
+        port = int(os.environ["HOROVOD_ELASTIC_COORD_PORT"])
+        worker_id = os.environ.get("HOROVOD_ELASTIC_WORKER_ID", "")
+        self._sock = socket.create_connection((addr, port), timeout=60)
+        self._sock.settimeout(None)
+        self._file = self._sock.makefile("rw", encoding="utf-8")
+        self._send({"type": "register", "worker_id": worker_id,
+                    "pid": os.getpid(),
+                    "host": socket.gethostname()})
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="hvd-elastic-client", daemon=True)
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            if self._sock:
+                self._sock.close()
+        except OSError:
+            pass
+
+    def _send(self, obj: Dict[str, Any]) -> None:
+        self._file.write(json.dumps(obj) + "\n")
+        self._file.flush()
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._file:
+                msg = json.loads(line)
+                t = msg.get("type")
+                if t == "assign":
+                    with self._assign_cv:
+                        self._assignment = msg
+                        self._assignment_gen = int(msg["generation"])
+                        self._assign_cv.notify_all()
+                elif t == "hosts_updated":
+                    log.info("elastic: driver announced host set change")
+                    notification_manager.notify()
+                elif t == "shutdown":
+                    log.info("elastic: driver requested shutdown")
+                    os._exit(143)
+        except (OSError, ValueError):
+            pass
+        if not self._closed:
+            # Connection to the driver died: local collectives will fail
+            # soon; surface as a host update so the loop re-rendezvouses
+            # (and fails cleanly if the driver is truly gone).
+            notification_manager.notify()
+
+    # -- rendezvous ---------------------------------------------------------
+    def wait_assignment(self, timeout: float = 600.0) -> Dict[str, Any]:
+        """Block until the driver sends an assignment for a generation newer
+        than the last one consumed; apply it to the environment."""
+        with self._assign_cv:
+            ok = self._assign_cv.wait_for(
+                lambda: self._assignment_gen > self._consumed_gen, timeout)
+            if not ok:
+                raise TimeoutError("elastic rendezvous timed out")
+            a = dict(self._assignment)
+            self._consumed_gen = self._assignment_gen
+        os.environ["HOROVOD_RANK"] = str(a["rank"])
+        os.environ["HOROVOD_SIZE"] = str(a["size"])
+        os.environ["HOROVOD_LOCAL_RANK"] = str(a.get("local_rank", 0))
+        os.environ["HOROVOD_LOCAL_SIZE"] = str(a.get("local_size", 1))
+        os.environ["HOROVOD_CROSS_RANK"] = str(a.get("cross_rank", a["rank"]))
+        os.environ["HOROVOD_CROSS_SIZE"] = str(a.get("cross_size", a["size"]))
+        os.environ["HOROVOD_CONTROLLER"] = "socket"
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = a["rendezvous_addr"]
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(a["rendezvous_port"])
+        return a
+
+    def mark_ready(self) -> None:
+        """Tell the driver this worker has torn down collectives and awaits
+        the next generation's assignment."""
+        self._send({"type": "ready"})
+
+
+_client: Optional[ElasticCoordinatorClient] = None
+_client_lock = threading.Lock()
+
+
+def is_elastic_worker() -> bool:
+    return os.environ.get("HOROVOD_ELASTIC") == "1"
+
+
+def get_client() -> ElasticCoordinatorClient:
+    global _client
+    with _client_lock:
+        if _client is None:
+            _client = ElasticCoordinatorClient()
+            _client.connect()
+        return _client
+
+
+def ensure_assignment() -> None:
+    """Called from hvd.init() in elastic mode: block for the initial rank
+    assignment on first init (registration doubles as readiness).  Re-inits
+    after a reset already consumed their assignment in
+    ``elastic._reset``, so this is a no-op then."""
+    client = get_client()
+    with client._lock:
+        has_assignment = client._consumed_gen >= 0
+    if not has_assignment:
+        client.wait_assignment()

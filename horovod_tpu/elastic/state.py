@@ -1,0 +1,193 @@
+"""Elastic training state objects.
+
+Reference analogs (SURVEY.md §2.4, §3.5): horovod/common/elastic.py (State,
+ObjectState), horovod/torch/elastic/state.py (TorchState) and
+horovod/torch/elastic/sampler.py (ElasticSampler).  The JAX-native variant
+holds pytrees: ``commit()`` snapshots to host memory, ``restore()`` rolls
+back to the last snapshot after a failed collective, ``sync()`` broadcasts
+rank 0's state to all ranks after a rendezvous round.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class State:
+    """Base class: commit/restore/sync + host-update checks + reset hooks."""
+
+    def __init__(self):
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(self, callbacks: Sequence[Callable]) -> None:
+        """Callbacks invoked after a reset (new rendezvous round), e.g. to
+        rebuild data shards for the new world size."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def check_host_updates(self) -> None:
+        """Raise :class:`HostsUpdatedInterrupt` if the driver announced a
+        host-set change (reference: State.check_host_updates polling the
+        WorkerNotificationManager)."""
+        from .client import notification_manager
+
+        if notification_manager.drain_updates():
+            from ..exceptions import HostsUpdatedInterrupt
+
+            raise HostsUpdatedInterrupt()
+
+    # subclass interface ----------------------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def commit(self) -> None:
+        """Snapshot the state and surface pending host updates — call at
+        batch/epoch boundaries you are willing to roll back to."""
+        self.save()
+        self.check_host_updates()
+
+
+class ObjectState(State):
+    """State over arbitrary picklable attributes.
+
+    ``JaxState`` below extends this to pytrees of jax Arrays; plain Python
+    values (epoch counters, RNG seeds) work here directly.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._known_attrs = list(kwargs)
+        self.save()
+
+    def _public_attrs(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._known_attrs}
+
+    def save(self) -> None:
+        self._saved = copy.deepcopy(
+            {k: _to_host(v) for k, v in self._public_attrs().items()})
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..functions import broadcast_object
+
+        synced = broadcast_object(self._public_attrs(), root_rank=0,
+                                  name="elastic.state")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Elastic state for JAX training loops: pass pytrees (params, opt_state)
+    and scalars (epoch, batch) as keyword args.
+
+    Snapshots are host-side copies (``jax.device_get``), so a revoked or
+    rebuilt device mesh never invalidates them; ``sync()`` broadcasts rank
+    0's snapshot through the eager collective path, which works immediately
+    after re-initialization.
+    """
+
+    pass  # behavior is ObjectState's; _to_host handles device arrays
+
+
+class ElasticSampler:
+    """Shards sample indices over ranks and tracks epoch progress so a reset
+    resumes mid-epoch without repeating processed samples (reference:
+    horovod/torch/elastic/sampler.py)."""
+
+    def __init__(self, dataset_size: int, shuffle: bool = True, seed: int = 0):
+        self.dataset_size = int(dataset_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        self._reshard()
+
+    # -- epoch control ------------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+        self.processed_indices = set()
+        self._reshard()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        start = batch_idx * batch_size
+        chunk = self.local_indices[start:start + batch_size]
+        self.processed_indices.update(int(i) for i in chunk)
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.epoch = state["epoch"]
+        self.processed_indices = set(state["processed_indices"])
+        self._reshard()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"epoch": self.epoch,
+                "processed_indices": sorted(self.processed_indices)}
+
+    def reset(self) -> None:
+        """Re-shard the *remaining* indices over the (possibly new) world."""
+        self._reshard()
+
+    def __iter__(self):
+        return iter(self.local_indices)
+
+    def __len__(self) -> int:
+        return len(self.local_indices)
+
+    # -- internals ----------------------------------------------------------
+    def _world(self):
+        import horovod_tpu as hvd
+
+        if hvd.is_initialized():
+            return hvd.rank(), hvd.size()
+        return 0, 1
+
+    def _reshard(self) -> None:
+        rank, size = self._world()
+        rng = np.random.RandomState(self.seed + self.epoch)
+        indices = np.arange(self.dataset_size)
+        if self.shuffle:
+            rng.shuffle(indices)
+        if self.processed_indices:
+            mask = ~np.isin(indices, list(self.processed_indices))
+            indices = indices[mask]
+        # Truncate so every rank has the same number of batches.
+        per_rank = len(indices) // size if size else len(indices)
+        self.local_indices = indices[rank * per_rank:(rank + 1) * per_rank]
+
+
+def _to_host(v):
+    """Device arrays → host numpy (so snapshots survive mesh teardown)."""
+    try:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(v)
+        if any(isinstance(l, jax.Array) for l in leaves):
+            return jax.tree_util.tree_unflatten(
+                treedef,
+                [np.asarray(l) if isinstance(l, jax.Array) else l
+                 for l in leaves])
+    except ImportError:  # pragma: no cover
+        pass
+    return v

@@ -1,0 +1,158 @@
+"""BERT encoder, TPU-first flax implementation.
+
+The reference's headline large-model benchmark is BERT-Large pretraining
+with fp16 fused allreduce (BASELINE.json config 3; Horovod `examples/` has
+the TF/torch BERT scripts).  This is the equivalent model for this
+framework, shaped for the MXU:
+
+- all projections are single fused matmuls over [hidden, 3*hidden]-style
+  shapes (multiples of 128);
+- bfloat16 activations, fp32 params, fp32 softmax accumulation;
+- attention can run sequence-parallel over a mesh axis via
+  ``horovod_tpu.parallel.ring_attention`` (pass ``sp_axis_name``) — the
+  long-context path the reference lacks (SURVEY.md §5 "long-context").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024          # BERT-Large
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    sp_axis_name: Optional[str] = None  # sequence-parallel mesh axis
+
+
+BERT_BASE = BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                       intermediate_size=3072)
+BERT_LARGE = BertConfig()
+BERT_TINY = BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                       num_heads=2, intermediate_size=512,
+                       max_position_embeddings=128)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # One fused QKV projection: [B, S, H] @ [H, 3H] keeps the MXU at a
+        # single large matmul instead of three small ones.
+        qkv = nn.DenseGeneral((3, cfg.num_heads, head_dim), dtype=cfg.dtype,
+                              name="qkv")(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        if cfg.sp_axis_name is not None:
+            from ..parallel.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis_name,
+                                 causal=False)
+        else:
+            scale = head_dim ** -0.5
+            # fp32 logits/softmax regardless of activation dtype.
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            if mask is not None:
+                big_neg = jnp.finfo(jnp.float32).min
+                logits = jnp.where(mask[:, None, None, :], logits, big_neg)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+                              name="out")(ctx)
+        return out
+
+
+class TransformerLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        cfg = self.config
+        attn = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
+        # Post-LN like original BERT; LN in fp32 for stability.
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(
+            (x + attn).astype(jnp.float32)).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(
+            (x + h).astype(jnp.float32)).astype(cfg.dtype)
+        return x
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq_len = input_ids.shape[-1]
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                     dtype=cfg.dtype, name="word_embeddings")(input_ids)
+        if cfg.sp_axis_name is not None:
+            # Sequence-parallel: this shard holds a contiguous chunk of the
+            # global sequence; position ids are global.
+            offset = jax.lax.axis_index(cfg.sp_axis_name) * seq_len
+        else:
+            offset = 0
+        pos = (offset + jnp.arange(seq_len))[None, :]
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                         dtype=cfg.dtype, name="position_embeddings")(pos)
+        if token_type_ids is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype, name="token_type_embeddings")(
+                token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(
+            x.astype(jnp.float32)).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = TransformerLayer(cfg, name=f"layer_{i}")(
+                x, attention_mask, deterministic)
+        return x
+
+
+class BertForPreTraining(nn.Module):
+    """Encoder + MLM head (the pretraining benchmark objective)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        hidden = BertEncoder(cfg, name="encoder")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(
+            hidden)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(
+            h.astype(jnp.float32))
+        # Logits in fp32: [B, S, V] matmul feeds a stable softmax-xent.
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                          name="mlm_head")(h)
+        return logits
+
+
+def mlm_loss(logits, labels, label_weights):
+    """Masked-LM cross-entropy: mean over positions where weight == 1."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    w = label_weights.astype(jnp.float32)
+    return -(ll * w).sum() / jnp.maximum(w.sum(), 1.0)

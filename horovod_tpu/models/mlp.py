@@ -1,0 +1,28 @@
+"""MNIST-scale MLP — the minimum end-to-end slice (BASELINE.json config 1;
+reference analog: horovod `examples/*mnist*` scripts)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.relu(nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x))
+        return nn.Dense(self.features[-1], dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+
+
+def xent_loss(logits, labels):
+    logp = jnp.take_along_axis(
+        nn.log_softmax(logits, axis=-1), labels[:, None], axis=-1)
+    return -logp.mean()

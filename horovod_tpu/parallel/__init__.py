@@ -1,7 +1,9 @@
 from .mesh import (  # noqa: F401
     build_global_mesh,
+    build_mesh,
     global_mesh,
     set_global_mesh,
     mesh_axis_name,
     sub_mesh,
 )
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

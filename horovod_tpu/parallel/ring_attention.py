@@ -1,0 +1,154 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+The reference has no sequence/context parallelism (SURVEY.md §2.7/§5 — its
+only primitives are allreduce-family collectives), but long-context is a
+first-class requirement of this framework.  This is the TPU-native design:
+shard the sequence across a mesh axis, keep Q resident, and rotate K/V
+shards around the ICI ring with ``lax.ppermute`` while accumulating the
+softmax online (flash-attention style running max/sum), so the full
+[S, S] score matrix never materialises and each hop's compute overlaps the
+next hop's transfer.  Communication volume per device is O(S/n * H * D * n)
+= one pass of K and V around the ring — exactly what ICI's torus is for.
+
+All accumulation is fp32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_attention(q, k, v, scale, mask):
+    """Attention stats for one (q-chunk, kv-chunk) pair.
+
+    Returns (unnormalised context [B,Sq,H,D] fp32, running max m [B,H,Sq],
+    sum l [B,H,Sq]) for online-softmax combination.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    m = jnp.max(logits, axis=-1)                      # [B,H,Sq]
+    p = jnp.exp(logits - m[..., None])                # [B,H,Sq,Sk]
+    l = jnp.sum(p, axis=-1)                           # [B,H,Sq]
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return ctx, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact attention with the sequence dimension sharded over ``axis_name``.
+
+    Args:
+      q, k, v: [batch, seq_local, heads, head_dim] — this rank's sequence
+        chunk (global sequence = axis_size * seq_local, chunk i holds
+        positions [i*seq_local, (i+1)*seq_local)).
+      axis_name: mesh axis carrying the sequence shards (the SP axis).
+      causal: apply a causal mask over *global* positions.
+      scale: logit scale; defaults to head_dim ** -0.5.
+
+    Returns [batch, seq_local, heads, head_dim] in q.dtype.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    seq_local = q.shape[1]
+    head_dim = q.shape[-1]
+    scale = head_dim ** -0.5 if scale is None else scale
+    # Rotate K/V "upstream" so that at step i we hold chunk (my_idx - i) % n.
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    b, _, h, d = q.shape
+    acc0 = jnp.zeros((b, seq_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, seq_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, seq_local), jnp.float32)
+
+    q_pos = my_idx * seq_local + jnp.arange(seq_local)  # global q positions
+
+    def body(i, carry):
+        acc, m, l, kc, vc = carry
+        src = (my_idx - i) % n  # whose chunk we currently hold
+        if causal:
+            k_pos = src * seq_local + jnp.arange(seq_local)
+            mask = q_pos[:, None] >= k_pos[None, :]        # [Sq, Sk]
+            mask = mask[None, None, :, :]
+        else:
+            mask = None
+        ctx, m_c, l_c = _chunk_attention(q, kc, vc, scale, mask)
+        # Online-softmax merge of (acc, m, l) with the new chunk's stats.
+        m_new = jnp.maximum(m, m_c)
+        # With a fully-masked chunk m_c = -inf; guard exp(-inf - -inf).
+        alpha = jnp.exp(jnp.where(m == -jnp.inf, -jnp.inf, m - m_new))
+        beta = jnp.exp(jnp.where(m_c == -jnp.inf, -jnp.inf, m_c - m_new))
+        alpha = jnp.nan_to_num(alpha)
+        beta = jnp.nan_to_num(beta)
+        l_new = l * alpha + l_c * beta
+        # [B,H,S] -> [B,S,H,1] to scale the [B,S,H,D] accumulators.
+        def bh(x):
+            return jnp.transpose(x, (0, 2, 1))[..., None]
+        acc_new = acc * bh(alpha) + ctx * bh(beta)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return acc_new, m_new, l_new, kc, vc
+
+    # The zero-init accumulators are axis-invariant while the loop body
+    # produces values varying over every mesh axis the inputs vary over;
+    # align the carry's varying-manual-axes type up front (shard_map vma
+    # rules for scan/fori carries).
+    try:
+        target_vma = tuple(jax.typeof(q).vma)
+    except Exception:
+        target_vma = (axis_name,)
+
+    def _vary(x):
+        try:
+            vma = jax.typeof(x).vma
+        except Exception:
+            return x
+        missing = tuple(a for a in target_vma if a not in vma)
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    carry0 = tuple(_vary(c) for c in (acc0, m0, l0, k, v))
+    acc, m, l, _, _ = lax.fori_loop(0, n, body, carry0)
+    denom = jnp.transpose(jnp.maximum(l, 1e-30), (0, 2, 1))[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses-style sequence parallelism: all_to_all swaps the
+    sharded dimension from sequence to heads, attention runs with the full
+    sequence on heads/n heads, and a second all_to_all swaps back.
+
+    Requires heads % axis_size == 0.  Two all_to_alls instead of a ring —
+    cheaper when heads are plentiful and the axis is small.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(f"ulysses needs heads ({q.shape[2]}) divisible by "
+                         f"axis size ({n})")
+    head_dim = q.shape[-1]
+    scale = head_dim ** -0.5 if scale is None else scale
+
+    def to_full_seq(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_sharded_seq(x):  # [B, S, H/n, D] -> [B, S/n, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qf, kf, vf = to_full_seq(q), to_full_seq(k), to_full_seq(v)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = qf.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(vf.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return to_sharded_seq(ctx)

@@ -1,0 +1,436 @@
+"""Elastic driver: host discovery, worker supervision, rendezvous rounds.
+
+Reference analogs (SURVEY.md §2.5, §3.5): horovod/runner/elastic/driver.py
+(ElasticDriver), discovery.py (HostDiscovery/HostDiscoveryScript),
+registration.py (host blacklisting), worker.py (notification push).
+
+Design: the driver runs a TCP coordinator server.  Each worker process
+holds a persistent JSON-lines connection (horovod_tpu.elastic.client).
+The driver forms *generations*: a generation is a set of live workers with
+assigned ranks and a fresh rendezvous port for the socket controller.  On a
+worker death or a discovery change, the driver pushes ``hosts_updated`` to
+the surviving workers, waits for them to tear down and report ``ready``,
+spawns replacements on available hosts (failed hosts are blacklisted), and
+broadcasts the next generation's assignments.  On TPU pods the discovery
+script is typically a queued-resources / metadata poll, so VM preemptions
+walk the same path as the reference's GPU host failures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from .util import find_free_port, local_hostnames
+
+BLACKLIST_FAILURES = 2          # consecutive fast failures before blacklisting
+DISCOVERY_INTERVAL_S = 1.0
+FAST_FAILURE_S = 15.0
+
+
+class HostDiscovery:
+    """Interface: return the current host set as an ordered {host: slots}."""
+
+    def find_available_hosts(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script that prints one ``host`` or ``host:slots`` per
+    line (reference: discovery.py HostDiscoveryScript)."""
+
+    def __init__(self, script: str, default_slots: int = 1):
+        self.script = script
+        self.default_slots = default_slots
+
+    def find_available_hosts(self) -> Dict[str, int]:
+        try:
+            out = subprocess.run(
+                ["/bin/sh", "-c", self.script], capture_output=True,
+                text=True, timeout=30)
+        except subprocess.TimeoutExpired as exc:
+            raise RuntimeError(
+                f"host discovery script timed out after 30s: {exc}") from exc
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"host discovery script failed rc={out.returncode}: "
+                f"{out.stderr.strip()}")
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                h, s = line.rsplit(":", 1)
+                hosts[h] = int(s)
+            else:
+                hosts[line] = self.default_slots
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    def __init__(self, hosts: Dict[str, int]):
+        self.hosts = dict(hosts)
+
+    def find_available_hosts(self) -> Dict[str, int]:
+        return dict(self.hosts)
+
+
+class _Worker:
+    def __init__(self, host: str, slot: int, worker_id: str,
+                 proc: subprocess.Popen, spawn_gen: int):
+        self.host = host
+        self.slot = slot
+        self.worker_id = worker_id
+        self.proc = proc
+        self.spawn_gen = spawn_gen
+        self.spawned_at = time.monotonic()
+        self.conn = None                  # type: Optional[socket.socket]
+        self.wfile = None
+        self.registered = threading.Event()
+        self.ready = threading.Event()    # ready for next generation
+        self.rank: Optional[int] = None
+        self.dead = False
+
+    def send(self, obj: dict) -> bool:
+        if self.wfile is None:
+            return False
+        try:
+            self.wfile.write(json.dumps(obj) + "\n")
+            self.wfile.flush()
+            return True
+        except OSError:
+            return False
+
+
+class ElasticDriver:
+    """Supervises an elastic job (reference: ElasticDriver)."""
+
+    def __init__(self, discovery: HostDiscovery, command: List[str],
+                 min_np: int, max_np: Optional[int],
+                 base_env: Optional[Dict[str, str]] = None,
+                 start_timeout: float = 120.0, verbose: bool = False):
+        self.discovery = discovery
+        self.command = command
+        self.min_np = min_np
+        self.max_np = max_np
+        self.base_env = dict(base_env or os.environ)
+        self.start_timeout = start_timeout
+        self.verbose = verbose
+
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _Worker] = {}      # worker_id -> worker
+        self._blacklist: set = set()
+        self._failures: Dict[str, List[float]] = {}  # host -> failure times
+        self._generation = -1
+        self._reset_required = threading.Event()
+        self._stop = threading.Event()
+        self._exit_code: Optional[int] = None
+        self._result_ready = threading.Event()
+        self._coord_port = None
+        self._server = None
+
+    # -- coordinator server --------------------------------------------------
+    def _start_server(self) -> None:
+        driver = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                worker: Optional[_Worker] = None
+                try:
+                    for raw in self.rfile:
+                        msg = json.loads(raw.decode())
+                        t = msg.get("type")
+                        if t == "register":
+                            worker = driver._on_register(
+                                msg, self.connection,
+                                self.connection.makefile("w",
+                                                         encoding="utf-8"))
+                        elif t == "ready" and worker is not None:
+                            worker.ready.set()
+                            driver._poke()
+                except (OSError, ValueError):
+                    pass
+                # connection lost: worker death is detected by the process
+                # monitor; nothing to do here.
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("0.0.0.0", 0), Handler)
+        self._coord_port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         name="hvd-elastic-coord", daemon=True).start()
+
+    def _on_register(self, msg: dict, conn, wfile) -> Optional[_Worker]:
+        wid = msg.get("worker_id", "")
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return None
+            w.conn, w.wfile = conn, wfile
+            w.registered.set()
+            w.ready.set()   # registration == ready for first assignment
+        self._poke()
+        return w
+
+    def _poke(self) -> None:
+        self._reset_required.set()
+
+    # -- worker spawning -----------------------------------------------------
+    def _spawn(self, host: str, slot: int, gen: int) -> _Worker:
+        wid = f"{host}:{slot}:{uuid.uuid4().hex[:8]}"
+        env = dict(self.base_env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_WORKER_ID": wid,
+            "HOROVOD_ELASTIC_COORD_ADDR": self._coord_addr(host),
+            "HOROVOD_ELASTIC_COORD_PORT": str(self._coord_port),
+            "HOROVOD_HOSTNAME": host,
+        })
+        if host in local_hostnames():
+            proc = subprocess.Popen(
+                self.command, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+        else:
+            env_str = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in env.items()
+                if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "JAX_",
+                                 "XLA_")))
+            remote = f"cd {shlex.quote(os.getcwd())} && env {env_str} " + \
+                " ".join(shlex.quote(c) for c in self.command)
+            proc = subprocess.Popen(
+                ["ssh", "-o", "StrictHostKeyChecking=no", host, remote],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        w = _Worker(host, slot, wid, proc, gen)
+        # Table insert must precede the monitor/stream threads and any
+        # chance of the worker registering, so _on_register finds it.
+        with self._lock:
+            self._workers[wid] = w
+        threading.Thread(target=self._stream, args=(w,), daemon=True).start()
+        threading.Thread(target=self._monitor, args=(w,), daemon=True).start()
+        return w
+
+    def _coord_addr(self, for_host: str) -> str:
+        if for_host in local_hostnames():
+            return "127.0.0.1"
+        return socket.gethostbyname(socket.gethostname())
+
+    def _stream(self, w: _Worker) -> None:
+        for line in iter(w.proc.stdout.readline, ""):
+            tag = f"[{w.rank if w.rank is not None else '?'}]"
+            sys.stdout.write(f"{tag}<stdout>: {line}")
+            sys.stdout.flush()
+
+    def _monitor(self, w: _Worker) -> None:
+        rc = w.proc.wait()
+        now = time.monotonic()
+        with self._lock:
+            w.dead = True
+            if rc == 0:
+                # Normal completion: first clean exit ends the job.
+                if self._exit_code is None:
+                    self._exit_code = 0
+                self._result_ready.set()
+                return
+            # Blacklist a host only on a crash *loop*: repeated workers that
+            # die shortly after spawn (reference: registration.py blacklist).
+            if now - w.spawned_at < FAST_FAILURE_S:
+                self._failures.setdefault(w.host, []).append(now)
+                recent = [t for t in self._failures[w.host]
+                          if now - t < 4 * FAST_FAILURE_S]
+                self._failures[w.host] = recent
+                if len(recent) >= BLACKLIST_FAILURES:
+                    self._blacklist.add(w.host)
+        if self.verbose:
+            print(f"elastic driver: worker {w.worker_id} exited rc={rc}",
+                  file=sys.stderr)
+        self._poke()
+
+    # -- generations ---------------------------------------------------------
+    def _target_hosts(self) -> Dict[str, int]:
+        hosts = self.discovery.find_available_hosts()
+        return {h: s for h, s in hosts.items() if h not in self._blacklist}
+
+    def _form_generation(self) -> bool:
+        """One rendezvous round.  Returns False if the job must abort."""
+        gen = self._generation + 1
+        try:
+            target = self._target_hosts()
+        except RuntimeError as exc:
+            print(f"elastic driver: discovery failed: {exc}", file=sys.stderr)
+            target = {}
+
+        # Notify survivors of the upcoming round.
+        with self._lock:
+            live = [w for w in self._workers.values() if not w.dead]
+        for w in live:
+            if not w.ready.is_set():
+                w.send({"type": "hosts_updated"})
+
+        # Kill workers on hosts that left the set.
+        for w in live:
+            if w.host not in target or w.slot >= target.get(w.host, 0):
+                w.send({"type": "shutdown"})
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+
+        # Spawn missing slots up to max_np.
+        cap = self.max_np if self.max_np else sum(target.values())
+        slots = []
+        for h, s in target.items():
+            for i in range(s):
+                slots.append((h, i))
+        slots = slots[:cap]
+        with self._lock:
+            occupied = {(w.host, w.slot) for w in self._workers.values()
+                        if not w.dead and w.host in target}
+        for (h, i) in slots:
+            if (h, i) not in occupied:
+                self._spawn(h, i, gen)
+
+        # Wait for every expected worker to be ready (registered + torn
+        # down), with a deadline.
+        deadline = time.monotonic() + self.start_timeout
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self._lock:
+                expected = [w for w in self._workers.values()
+                            if not w.dead and (w.host, w.slot) in slots]
+            if (len(expected) >= max(self.min_np, 1)
+                    and all(w.ready.is_set() for w in expected)
+                    and len(expected) == len(
+                        {(w.host, w.slot) for w in expected})):
+                break
+            if self._result_ready.is_set():
+                return False
+            time.sleep(0.05)
+        else:
+            with self._lock:
+                expected = [w for w in self._workers.values()
+                            if not w.dead and w.ready.is_set()]
+            if len(expected) < self.min_np:
+                print("elastic driver: could not reach min_np="
+                      f"{self.min_np} within {self.start_timeout}s",
+                      file=sys.stderr)
+                return False
+
+        # Rank assignment: survivors first (stable low ranks so rank 0's
+        # state persists across rounds), then new spawns; ties by host/slot.
+        expected.sort(key=lambda w: (w.spawn_gen, w.host, w.slot))
+        size = len(expected)
+        if size < self.min_np:
+            return False
+        rdv_host = expected[0].host
+        rdv_addr = "127.0.0.1" if rdv_host in local_hostnames() \
+            else rdv_host
+        rdv_port = find_free_port("0.0.0.0" if rdv_addr != "127.0.0.1"
+                                  else "127.0.0.1")
+        local_sizes: Dict[str, int] = {}
+        for w in expected:
+            local_sizes[w.host] = local_sizes.get(w.host, 0) + 1
+        local_seen: Dict[str, int] = {}
+        hosts_order = list(dict.fromkeys(w.host for w in expected))
+        for rank, w in enumerate(expected):
+            w.rank = rank
+            w.ready.clear()
+            lr = local_seen.get(w.host, 0)
+            local_seen[w.host] = lr + 1
+            w.send({
+                "type": "assign", "generation": gen, "rank": rank,
+                "size": size, "local_rank": lr,
+                "local_size": local_sizes[w.host],
+                "cross_rank": hosts_order.index(w.host),
+                "cross_size": len(hosts_order),
+                "rendezvous_addr": rdv_addr,
+                "rendezvous_port": rdv_port,
+            })
+        self._generation = gen
+        if self.verbose:
+            print(f"elastic driver: generation {gen} formed with {size} "
+                  f"worker(s)", file=sys.stderr)
+        return True
+
+    # -- main loop -----------------------------------------------------------
+    def run(self) -> int:
+        self._start_server()
+        discovery_thread = threading.Thread(
+            target=self._discovery_loop, daemon=True)
+        discovery_thread.start()
+        self._reset_required.set()
+        while not self._stop.is_set():
+            if self._result_ready.is_set():
+                break
+            if self._reset_required.wait(timeout=0.2):
+                self._reset_required.clear()
+                # Debounce: let closely-spaced failures coalesce.
+                time.sleep(0.1)
+                if self._result_ready.is_set():
+                    break
+                if not self._form_generation():
+                    if self._exit_code is None:
+                        self._exit_code = 1
+                    break
+        self._shutdown_workers()
+        if self._server:
+            self._server.shutdown()
+        return self._exit_code if self._exit_code is not None else 1
+
+    def _discovery_loop(self) -> None:
+        prev: Optional[Dict[str, int]] = None
+        while not self._stop.is_set() and not self._result_ready.is_set():
+            try:
+                cur = self._target_hosts()
+            except RuntimeError:
+                cur = prev
+            if prev is not None and cur != prev:
+                self._poke()
+            prev = cur
+            time.sleep(DISCOVERY_INTERVAL_S)
+
+    def _shutdown_workers(self) -> None:
+        self._stop.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if not w.dead:
+                try:
+                    w.proc.terminate()
+                except OSError:
+                    pass
+
+
+def run_elastic(args, command: List[str]) -> int:
+    """Entry from the launcher CLI (reference: launch.py _run_elastic)."""
+    if args.host_discovery_script:
+        discovery: HostDiscovery = HostDiscoveryScript(
+            args.host_discovery_script, args.slots_per_host)
+    elif args.hosts:
+        from .util import parse_hosts
+
+        discovery = FixedHosts(
+            {h.hostname: h.slots for h in parse_hosts(args.hosts)})
+    else:
+        discovery = FixedHosts({"localhost": args.num_proc or 1})
+    min_np = args.min_np if args.min_np is not None else (args.num_proc or 1)
+    max_np = args.max_np
+
+    from .launch import _tuning_env
+
+    base_env = dict(os.environ)
+    base_env.update(_tuning_env(args))
+    driver = ElasticDriver(discovery, command, min_np, max_np, base_env,
+                           start_timeout=args.start_timeout,
+                           verbose=args.verbose)
+    return driver.run()

@@ -1,0 +1,105 @@
+"""Elastic integration tests in the reference's shape (SURVEY.md §4):
+multi-process on localhost via the launcher, scripted discovery, and
+worker death by self-SIGKILL mid-training (elastic_common.py patterns)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKER_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    state = hvd.elastic.ObjectState(epoch=0, total=0.0)
+
+    KILL_EPOCH = int(os.environ.get("TEST_KILL_EPOCH", "-1"))
+    KILL_FLAG = os.environ.get("TEST_KILL_FLAG", "")
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < 6:
+            if (KILL_EPOCH >= 0 and state.epoch == KILL_EPOCH
+                    and hvd.rank() == hvd.size() - 1 and hvd.size() > 1
+                    and KILL_FLAG and not os.path.exists(KILL_FLAG)):
+                open(KILL_FLAG, "w").write("died")
+                os.kill(os.getpid(), 9)
+            val = hvd.allreduce(np.ones(4, np.float32),
+                                name=f"step.{state.epoch}")
+            state.total += float(val.sum())
+            state.epoch += 1
+            state.commit()
+        return state.total
+
+    total = train(state)
+    print(f"RESULT rank={hvd.rank()} size={hvd.size()} "
+          f"epoch={state.epoch} total={total}")
+    hvd.shutdown()
+""")
+
+
+def _run_launcher(extra_args, env_extra=None, timeout=180):
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER_SCRIPT)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(env_extra or {})
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+               *extra_args, sys.executable, script]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=td)
+        return proc
+
+
+def test_elastic_basic_completion():
+    """Two workers, fixed hosts, no failures: trains to epoch 6."""
+    proc = _run_launcher(["--min-np", "2", "-np", "2", "-H", "localhost:2",
+                          "--verbose"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RESULT" in proc.stdout
+    assert "epoch=6" in proc.stdout
+
+
+def test_elastic_worker_failure_recovers():
+    """The highest rank SIGKILLs itself at epoch 2; the driver re-forms the
+    job (respawn on the same host) and training completes."""
+    with tempfile.NamedTemporaryFile(suffix=".flag", delete=True) as tf:
+        flag = tf.name
+    proc = _run_launcher(
+        ["--min-np", "1", "-np", "2", "-H", "localhost:2", "--verbose"],
+        env_extra={"TEST_KILL_EPOCH": "2", "TEST_KILL_FLAG": flag})
+    try:
+        os.unlink(flag)
+    except OSError:
+        pass
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "epoch=6" in proc.stdout
+
+
+def test_elastic_discovery_script():
+    """Hosts come from a discovery script (reference: HostDiscoveryScript)."""
+    with tempfile.TemporaryDirectory() as td:
+        hosts_file = os.path.join(td, "hosts.txt")
+        with open(hosts_file, "w") as f:
+            f.write("localhost:2\n")
+        proc = _run_launcher(
+            ["--min-np", "2", "--host-discovery-script",
+             f"cat {hosts_file}", "--verbose"])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "epoch=6" in proc.stdout

@@ -1,0 +1,73 @@
+"""Model zoo sanity: shapes, loss finiteness, one train step per family
+(tiny variants on CPU; reference analog: horovod examples/ smoke scripts)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu import models
+
+
+def test_mlp_forward_and_loss():
+    m = models.MLP()
+    x = jnp.ones((4, 28, 28, 1))
+    params = m.init(jax.random.PRNGKey(0), x)
+    logits = m.apply(params, x)
+    assert logits.shape == (4, 10)
+    loss = models.xent_loss(logits, jnp.zeros((4,), jnp.int32))
+    assert np.isfinite(float(loss))
+
+
+def test_resnet_tiny_train_step():
+    m = models.ResNetTiny(num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    @jax.jit
+    def step(params, batch_stats, x, y):
+        def loss_fn(p):
+            logits, updates = m.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            return models.xent_loss(logits, y), updates["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        return loss, new_stats, grads
+
+    loss, new_stats, grads = step(params, batch_stats,
+                                  x, jnp.zeros((2,), jnp.int32))
+    assert np.isfinite(float(loss))
+    gnorm = optax.global_norm(grads)
+    assert float(gnorm) > 0
+
+
+def test_resnet50_builds_lazily():
+    # Structure check only (no init — too heavy for CPU tests): the model
+    # object constructs and reports the expected stage layout.
+    m = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    assert list(m.stage_sizes) == [3, 4, 6, 3]
+
+
+def test_bert_tiny_mlm_step():
+    cfg = models.BERT_TINY
+    m = models.BertForPreTraining(cfg)
+    B, S = 2, 16
+    ids = jnp.ones((B, S), jnp.int32)
+    variables = m.init(jax.random.PRNGKey(0), ids)
+    logits = m.apply(variables, ids)
+    assert logits.shape == (B, S, cfg.vocab_size)
+    labels = jnp.zeros((B, S), jnp.int32)
+    weights = jnp.ones((B, S))
+    loss = models.mlm_loss(logits, labels, weights)
+    assert np.isfinite(float(loss))
+
+    def loss_fn(v):
+        return models.mlm_loss(m.apply(v, ids), labels, weights)
+
+    grads = jax.grad(loss_fn)(variables)
+    assert float(optax.global_norm(grads)) > 0

@@ -36,7 +36,10 @@ def main() -> None:
 
     batch_per_chip = 64
     batch = batch_per_chip * n_dev
-    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    # bn_axis_name: cross-replica BN stats (and replica-invariant
+    # batch_stats, required by the P() out_spec under shard_map).
+    model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                            bn_axis_name="hvd")
 
     rng = jax.random.PRNGKey(0)
     images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.bfloat16)

@@ -1,0 +1,65 @@
+"""Elastic training example (config 5): survives worker loss / host change.
+
+Reference analog: horovod examples/elastic/tensorflow2_mnist_elastic.py.
+
+Run under the elastic launcher:
+  horovodrun -np 2 --min-np 1 -H localhost:2 python examples/jax_elastic_mnist.py
+  horovodrun --min-np 1 --host-discovery-script ./discover.sh python ...
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP, xent_loss
+
+
+def main():
+    hvd.init()
+    model = MLP(features=(64, 10))
+    x0 = jnp.zeros((1, 28, 28, 1))
+    params = model.init(jax.random.PRNGKey(0), x0)
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+    state = hvd.elastic.JaxState(
+        params=params, opt_state=tx.init(params), epoch=0)
+    sampler = hvd.elastic.ElasticSampler(dataset_size=2048, shuffle=True)
+    state.register_reset_callbacks([sampler.reset])
+
+    rng = np.random.RandomState(0)
+    data_x = rng.rand(2048, 28, 28, 1).astype(np.float32)
+    data_y = rng.randint(0, 10, 2048).astype(np.int32)
+
+    @jax.jit
+    def grad_fn(p, bx, by):
+        return jax.value_and_grad(
+            lambda q: xent_loss(model.apply(q, bx), by))(p)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < 4:
+            sampler.set_epoch(state.epoch)
+            batch = 32
+            idx = list(sampler)
+            for i in range(0, len(idx) - batch + 1, batch):
+                sel = idx[i:i + batch]
+                loss, grads = grad_fn(state.params,
+                                      jnp.asarray(data_x[sel]),
+                                      jnp.asarray(data_y[sel]))
+                updates, state.opt_state = tx.update(
+                    grads, state.opt_state, state.params)
+                state.params = optax.apply_updates(state.params, updates)
+                sampler.record_batch(i // batch, batch)
+            state.epoch += 1
+            state.commit()   # snapshot + surface host updates
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"(world size {hvd.size()})")
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

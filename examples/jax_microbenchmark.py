@@ -1,0 +1,92 @@
+"""Collective microbenchmarks: allreduce/allgather/alltoall (config 4).
+
+Reference analog: the timeline/benchmark harness Horovod ships for measuring
+fused-allreduce throughput (docs/benchmarks.rst synthetic benchmarks).
+
+Two planes are measured:
+  --plane jit    in-jit XLA collectives over the mesh (the ICI data plane)
+  --plane eager  the enqueue->negotiate->fuse->execute core (host plane)
+
+Run:  python examples/jax_microbenchmark.py --plane jit --mb 64
+      horovodrun -np 2 python examples/jax_microbenchmark.py --plane eager
+"""
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+
+
+def bench_jit(mb: float, iters: int):
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("hvd",))
+    n = int(mb * (1 << 20) / 4)
+    x = jnp.ones((n_dev, n // n_dev), jnp.float32)
+
+    results = {}
+    for name, fn in [
+        ("allreduce", lambda s: hvd.allreduce(s, axis_name="hvd")),
+        ("allgather", lambda s: hvd.allgather(s, axis_name="hvd")),
+        # alltoall needs its per-shard dim 0 divisible by the axis size.
+        ("alltoall", lambda s: hvd.alltoall(
+            s.reshape(n_dev, -1), axis_name="hvd")),
+        ("reducescatter", lambda s: hvd.reducescatter(
+            s.reshape(n_dev, -1), axis_name="hvd")),
+    ]:
+        f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("hvd"),
+                              out_specs=P("hvd")))
+        jax.block_until_ready(f(x))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(x)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        results[name] = (mb * iters) / dt
+    return results
+
+
+def bench_eager(mb: float, iters: int):
+    n = int(mb * (1 << 20) / 4)
+    x = np.ones(n, np.float32)
+    results = {}
+    for name, fn in [
+        ("allreduce", lambda i: hvd.allreduce(x, name=f"b.ar.{i}")),
+        ("allgather", lambda i: hvd.allgather(x, name=f"b.ag.{i}")),
+        ("alltoall", lambda i: hvd.alltoall(
+            np.ones((hvd.size() * 128, 64), np.float32), name=f"b.a2a.{i}")),
+    ]:
+        fn(0)  # warmup
+        t0 = time.perf_counter()
+        for i in range(1, iters + 1):
+            fn(i)
+        dt = time.perf_counter() - t0
+        results[name] = (mb * iters) / dt
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--plane", choices=["jit", "eager"], default="jit")
+    ap.add_argument("--mb", type=float, default=16.0,
+                    help="payload size in MiB")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    hvd.init()
+    results = (bench_jit if args.plane == "jit" else bench_eager)(
+        args.mb, args.iters)
+    if hvd.rank() == 0:
+        for op, mbps in results.items():
+            print(f"{op:14s} {mbps:10.1f} MiB/s ({args.plane} plane, "
+                  f"size={hvd.size()})")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""MNIST-scale MLP with hvd.DistributedOptimizer (BASELINE.json config 1).
+
+Reference analog: horovod examples/tensorflow2/tensorflow2_mnist.py /
+examples/pytorch/pytorch_mnist.py — the canonical "first Horovod script":
+init, shard data by rank, wrap the optimizer, broadcast initial state.
+
+Run:  horovodrun -np 2 python examples/jax_mnist_mlp.py
+      (or plain `python examples/jax_mnist_mlp.py` single-process)
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP, xent_loss
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    x, y = synthetic_mnist()
+    shard = len(x) // size
+    x, y = x[rank * shard:(rank + 1) * shard], y[rank * shard:(rank + 1) * shard]
+
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+    # Sync initial params from rank 0 (reference: broadcast_parameters).
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    tx = hvd.DistributedOptimizer(optax.sgd(
+        hvd.callbacks.warmup_schedule(0.01, warmup_steps=50), momentum=0.9))
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def grad_fn(p, bx, by):
+        return jax.value_and_grad(lambda q: xent_loss(model.apply(q, bx), by))(p)
+
+    batch = 32
+    for epoch in range(2):
+        for i in range(0, len(x), batch):
+            bx, by = jnp.asarray(x[i:i + batch]), jnp.asarray(y[i:i + batch])
+            loss, grads = grad_fn(params, bx, by)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        metrics = hvd.callbacks.MetricAverageCallback().on_epoch_end(
+            {"loss": float(loss)})
+        if rank == 0:
+            print(f"epoch {epoch}: loss={metrics['loss']:.4f}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,12 @@ from .functions import (  # noqa: F401
 )
 from .compression import Compression  # noqa: F401
 from . import elastic  # noqa: F401
+
+try:  # callbacks/sync-BN need optax+flax; keep the core importable without
+    from . import callbacks  # noqa: F401
+    from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
 from .exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt,
 )

@@ -1,0 +1,146 @@
+"""Training-loop callbacks and LR schedule helpers.
+
+Reference analogs (SURVEY.md §2.4): horovod/_keras/callbacks.py —
+BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateWarmupCallback, LearningRateScheduleCallback.
+
+TPU-native split: anything *schedule-shaped* becomes an optax schedule (it
+compiles into the training step — no per-epoch Python callbacks mutating an
+optimizer), while the cross-rank actions (broadcast at start, metric
+averaging) stay imperative callbacks over the eager collective path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+import optax
+
+from . import basics
+from .functions import broadcast_parameters
+from .mpi_ops import allreduce
+from .wire import ReduceOp
+
+
+# ---------------------------------------------------------------------------
+# Schedules (compiled into the step — the TPU-idiomatic form)
+# ---------------------------------------------------------------------------
+
+def warmup_schedule(base_lr: float, warmup_steps: int,
+                    initial_factor: float = 1.0 / 3.0,
+                    after: Optional[optax.Schedule] = None) -> optax.Schedule:
+    """The Horovod-paper LR warmup (reference: LearningRateWarmupCallback):
+    ramp from ``base_lr * initial_factor`` to ``size() * base_lr`` over
+    ``warmup_steps``, then hand off to ``after`` (default: constant scaled
+    LR).  Scaling by world size implements the linear-scaling rule the
+    reference's docs prescribe for large-batch DP training.
+
+    World size is read when the schedule is *evaluated/traced*, not when it
+    is constructed, so building the schedule before ``hvd.init()`` still
+    applies the scaling rule.
+    """
+    import jax.numpy as jnp
+
+    steps = max(warmup_steps, 1)
+
+    def schedule(step):
+        size = basics.size() if basics.is_initialized() else 1
+        scaled = base_lr * max(size, 1)
+        start = base_lr * initial_factor
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / steps, 0.0, 1.0)
+        warm = start + (scaled - start) * frac
+        if after is not None:
+            tail = after(jnp.maximum(jnp.asarray(step) - steps, 0))
+        else:
+            tail = scaled
+        return jnp.where(jnp.asarray(step) < steps, warm, tail)
+
+    return schedule
+
+
+def piecewise_schedule(base_lr: float,
+                       multipliers: Dict[int, float]) -> optax.Schedule:
+    """Epoch/step-indexed multiplier schedule (reference:
+    LearningRateScheduleCallback with staircase=True): ``{step: mult}``
+    applies ``base_lr * mult`` from that step on."""
+    boundaries = sorted(multipliers)
+    scales = {}
+    prev = 1.0
+    for b in boundaries:
+        scales[b] = multipliers[b] / prev
+        prev = multipliers[b]
+    return optax.piecewise_constant_schedule(base_lr, scales)
+
+
+# ---------------------------------------------------------------------------
+# Imperative callbacks (eager collective path)
+# ---------------------------------------------------------------------------
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast initial parameters/optimizer state from ``root_rank`` at the
+    start of training (reference: BroadcastGlobalVariablesCallback /
+    BroadcastGlobalVariablesHook)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, state: Any) -> Any:
+        """``state`` is a pytree (params, opt state, ...); returns the
+        synchronized pytree."""
+        if self._done:
+            return state
+        self._done = True
+        return broadcast_parameters(state, root_rank=self.root_rank,
+                                    prefix="callback.broadcast")
+
+
+class MetricAverageCallback:
+    """Average logged metrics over ranks at epoch end (reference:
+    MetricAverageCallback)."""
+
+    def on_epoch_end(self, logs: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for k, v in logs.items():
+            arr = np.asarray(v, dtype=np.float64)
+            out[k] = np.asarray(
+                allreduce(arr, name=f"metric.{k}", op=ReduceOp.AVERAGE))
+            if out[k].ndim == 0:
+                out[k] = float(out[k])
+        return out
+
+
+class LearningRateWarmupCallback:
+    """Object-form warmup for loops that read ``callback.lr(step)`` — thin
+    wrapper over :func:`warmup_schedule` kept for reference-name parity."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: int = 1, verbose: bool = False):
+        self.schedule = warmup_schedule(
+            initial_lr, warmup_epochs * steps_per_epoch)
+        self.verbose = verbose
+
+    def lr(self, step: int) -> float:
+        return float(self.schedule(step))
+
+
+class LearningRateScheduleCallback:
+    """Object-form piecewise schedule (reference-name parity)."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 steps_per_epoch: int = 1):
+        if callable(multiplier):
+            self._fn: Callable[[int], float] = \
+                lambda step: initial_lr * multiplier(step // steps_per_epoch)
+        else:
+            self._fn = lambda step: initial_lr * multiplier
+        self.start = start_epoch * steps_per_epoch
+        self.end = end_epoch * steps_per_epoch if end_epoch else None
+        self.initial_lr = initial_lr
+
+    def lr(self, step: int) -> float:
+        if step < self.start or (self.end is not None and step >= self.end):
+            return self.initial_lr
+        return float(self._fn(step))

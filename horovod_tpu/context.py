@@ -345,6 +345,11 @@ class HorovodContext:
         e.result = full[start:start + length]
 
 
+def _contig(a: np.ndarray) -> np.ndarray:
+    # np.ascontiguousarray promotes 0-d to 1-d; preserve scalar shape.
+    return a.copy() if a.ndim == 0 else np.ascontiguousarray(a)
+
+
 def _to_host(array):
     """Convert a framework array to a contiguous host numpy buffer."""
     was_jax = False
@@ -356,11 +361,11 @@ def _to_host(array):
             if isinstance(array, jax.Array):
                 was_jax = True
                 orig_dtype = array.dtype  # bfloat16 survives via ml_dtypes
-                return np.ascontiguousarray(np.asarray(array)), was_jax, orig_dtype
+                return _contig(np.asarray(array)), was_jax, orig_dtype
         except ImportError:  # pragma: no cover
             pass
         array = np.asarray(array)
-    return np.ascontiguousarray(array), was_jax, orig_dtype
+    return _contig(array), was_jax, orig_dtype
 
 
 def _from_host(result: np.ndarray, entry: TensorEntry):

@@ -1,0 +1,141 @@
+"""Estimator API: ``fit(data) -> model`` over Horovod workers.
+
+Reference analogs (SURVEY.md §2.6): horovod/spark/keras/estimator.py
+(KerasEstimator), horovod/spark/torch/estimator.py (TorchEstimator) and the
+shared params/backend machinery in horovod/spark/common/.
+
+TPU-native reshaping: the model is a flax module + optax transformation and
+the training step is a jitted SPMD function; the estimator's job is only to
+(1) ship data shards to workers, (2) run the distributed loop under
+``hvd.DistributedOptimizer``, (3) persist params via the Store.  When a
+Spark session is available the shards ride ``horovod_tpu.spark.run``;
+otherwise ``backend="local"`` trains in-process (the pattern the reference's
+test suite uses with local-mode Spark).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from .store import Store, LocalStore
+
+
+class JaxEstimator:
+    """Spark-ML-shaped estimator for flax models.
+
+    Args:
+      model: a flax ``nn.Module``.
+      loss: ``loss(logits, labels) -> scalar``.
+      optimizer: an optax ``GradientTransformation``.
+      batch_size / epochs: training loop controls.
+      store: artifact Store (default: LocalStore under cwd).
+      backend: "local" (in-process) or "spark" (barrier-mode workers).
+      num_proc: worker count for the spark backend.
+    """
+
+    def __init__(self, model: Any, loss: Callable, optimizer: Any,
+                 batch_size: int = 32, epochs: int = 1,
+                 store: Optional[Store] = None, backend: str = "local",
+                 num_proc: Optional[int] = None, run_id: str = "run",
+                 seed: int = 0):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.store = store or LocalStore()
+        self.backend = backend
+        self.num_proc = num_proc
+        self.run_id = run_id
+        self.seed = seed
+
+    # -- training -----------------------------------------------------------
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "JaxModel":
+        if self.backend == "spark":
+            from . import run as spark_run
+
+            params = spark_run(
+                _train_worker,
+                args=(self.model, self.loss, self.optimizer, x, y,
+                      self.batch_size, self.epochs, self.seed),
+                num_proc=self.num_proc)[0]
+        else:
+            params = _train_worker(self.model, self.loss, self.optimizer,
+                                   x, y, self.batch_size, self.epochs,
+                                   self.seed)
+        ckpt = self.store.get_checkpoint_path(self.run_id)
+        self.store.write(ckpt, pickle.dumps(params))
+        return JaxModel(self.model, params)
+
+
+class JaxModel:
+    """Trained-model wrapper (reference: the estimators' *Model transformer
+    returned by fit())."""
+
+    def __init__(self, model: Any, params: Any):
+        self.model = model
+        self.params = params
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(self.model.apply(self.params, jnp.asarray(x)))
+
+    @classmethod
+    def load(cls, model: Any, store: Store, run_id: str = "run") -> "JaxModel":
+        params = pickle.loads(
+            store.read(store.get_checkpoint_path(run_id)))
+        return cls(model, params)
+
+
+def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
+                  seed) -> Any:
+    """Per-worker training loop: shard by rank, DistributedOptimizer
+    averaging, return rank-0's params."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+
+    owns_init = not hvd.is_initialized()
+    if owns_init:
+        hvd.init(build_mesh=False)
+    try:
+        rank, size = hvd.rank(), hvd.size()
+        per_rank = len(x) // max(size, 1)
+        if per_rank == 0:
+            raise ValueError(
+                f"dataset of {len(x)} samples cannot be sharded over "
+                f"{size} workers")
+        # Trim to whole batches when possible; otherwise train on the full
+        # (smaller-than-batch) shard rather than silently skipping training.
+        n = per_rank // batch_size * batch_size or per_rank
+        xs = x[rank * per_rank:rank * per_rank + n]
+        ys = y[rank * per_rank:rank * per_rank + n]
+
+        params = model.init(jax.random.PRNGKey(seed), jnp.asarray(xs[:1]))
+        params = hvd.broadcast_parameters(params, root_rank=0)
+        tx = hvd.DistributedOptimizer(optimizer)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def grads_fn(p, bx, by):
+            return jax.value_and_grad(
+                lambda q: loss_fn(model.apply(q, bx), by))(p)
+
+        for _ in range(epochs):
+            for i in range(0, len(xs), batch_size):
+                bx = jnp.asarray(xs[i:i + batch_size])
+                by = jnp.asarray(ys[i:i + batch_size])
+                _, grads = grads_fn(params, bx, by)
+                # Eager update: engages the core's fusion/negotiation path.
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+        return jax.device_get(params)
+    finally:
+        if owns_init:
+            hvd.shutdown()

@@ -1,0 +1,75 @@
+"""Checkpoint/artifact stores for estimators.
+
+Reference analog: horovod/spark/common/store.py (Store, LocalStore,
+HDFSStore, DBFSLocalStore).  The TPU build keeps the same contract —
+``get_checkpoint_path``/``get_logs_path`` + exists/read/write — over any
+fsspec-style path; only the local filesystem backend is bundled (HDFS/DBFS
+need their own client libraries, absent here).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    """Base paths for one training run's artifacts."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        return FilesystemStore(prefix_path)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def sync_fn(self, run_id: str):
+        """Returns fn(local_dir) uploading a local run dir into the store."""
+        raise NotImplementedError
+
+
+class FilesystemStore(Store):
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def sync_fn(self, run_id: str):
+        target_root = os.path.join(self.prefix_path, run_id)
+
+        def _sync(local_dir: str) -> None:
+            os.makedirs(target_root, exist_ok=True)
+            shutil.copytree(local_dir, target_root, dirs_exist_ok=True)
+
+        return _sync
+
+
+class LocalStore(FilesystemStore):
+    """Reference-name alias for a local filesystem store."""
+
+    def __init__(self, prefix_path: Optional[str] = None):
+        super().__init__(prefix_path or os.path.join(
+            os.getcwd(), ".horovod_tpu_store"))

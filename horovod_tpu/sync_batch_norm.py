@@ -1,0 +1,48 @@
+"""Cross-replica (synchronized) batch normalization.
+
+Reference analog: horovod/torch/sync_batch_norm.py (SyncBatchNorm — manual
+allgather of per-GPU mean/var + custom autograd) and
+horovod/tensorflow/sync_batch_norm.py; SURVEY.md §2.4.
+
+TPU-native design: no custom gradient machinery is needed — batch statistics
+become cross-replica by computing them with a ``psum``-backed mean over the
+data-parallel mesh axis *inside* the compiled step, and XLA differentiates
+through the collective.  flax's ``nn.BatchNorm`` already supports this via
+``axis_name``; this module pins the Horovod semantics (stats over the global
+batch across the hvd axis) and offers the same drop-in role the reference's
+wrapper has.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .parallel import mesh as _mesh
+
+
+class SyncBatchNorm(nn.Module):
+    """BatchNorm whose batch statistics are reduced across the mesh axis.
+
+    Use exactly like ``nn.BatchNorm`` inside shard_map/pjit-compiled training
+    steps; ``axis_name=None`` picks the global hvd axis at apply time.
+    """
+
+    use_running_average: Optional[bool] = None
+    axis_name: Optional[str] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        axis = self.axis_name or _mesh.mesh_axis_name()
+        return nn.BatchNorm(
+            use_running_average=nn.merge_param(
+                "use_running_average", self.use_running_average,
+                use_running_average),
+            momentum=self.momentum, epsilon=self.epsilon, dtype=self.dtype,
+            axis_name=axis, name="bn",
+        )(x)

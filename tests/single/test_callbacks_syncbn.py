@@ -1,0 +1,108 @@
+"""Callbacks, LR schedules, SyncBatchNorm, and the estimator
+(reference analogs: _keras/callbacks.py, torch/sync_batch_norm.py,
+spark estimators — SURVEY.md §2.4/§2.6)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+
+pytestmark = pytest.mark.usefixtures("hvd_single")
+
+
+def test_warmup_schedule_ramps_to_scaled_lr():
+    sched = hvd.callbacks.warmup_schedule(0.1, warmup_steps=10)
+    lr0 = float(sched(0))
+    lr_end = float(sched(10))
+    # size() == 1 in-process, so target = base_lr
+    assert lr0 == pytest.approx(0.1 / 3.0, rel=1e-3)
+    assert lr_end == pytest.approx(0.1, rel=1e-3)
+    assert float(sched(5)) > lr0
+
+
+def test_piecewise_schedule():
+    sched = hvd.callbacks.piecewise_schedule(1.0, {10: 0.1, 20: 0.01})
+    assert float(sched(0)) == pytest.approx(1.0)
+    assert float(sched(15)) == pytest.approx(0.1)
+    assert float(sched(25)) == pytest.approx(0.01)
+
+
+def test_metric_average_callback():
+    cb = hvd.callbacks.MetricAverageCallback()
+    out = cb.on_epoch_end({"loss": 2.0, "acc": np.float32(0.5)})
+    assert out["loss"] == pytest.approx(2.0)  # size()==1: identity
+    assert out["acc"] == pytest.approx(0.5)
+
+
+def test_broadcast_callback():
+    cb = hvd.callbacks.BroadcastGlobalVariablesCallback(0)
+    tree = {"w": jnp.ones((3,)), "b": jnp.zeros((2,))}
+    out = cb.on_train_begin(tree)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    # second call is a no-op (returns the same object)
+    assert cb.on_train_begin(out) is out
+
+
+def test_sync_batch_norm_cross_replica_stats():
+    """Stats over the global (cross-shard) batch: a sharded batch with
+    different per-shard means must normalize with the global mean."""
+    N_DEV = 8
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("hvd",))
+    x = jnp.arange(N_DEV * 2 * 4, dtype=jnp.float32).reshape(N_DEV * 2, 4)
+
+    bn = hvd.SyncBatchNorm(use_running_average=False, axis_name="hvd")
+    variables = bn.init(jax.random.PRNGKey(0), x[:2])
+
+    def fn(shard):
+        out, _ = bn.apply(variables, shard, mutable=["batch_stats"])
+        return out
+
+    out = shard_map(fn, mesh=mesh, in_specs=P("hvd"),
+                    out_specs=P("hvd"))(x)
+    # Global normalization: overall mean ~0, std ~1 across the full batch.
+    out = np.asarray(out)
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-2)
+
+
+def test_jax_estimator_local_backend(tmp_path):
+    from horovod_tpu.models import MLP, xent_loss
+    from horovod_tpu.spark.estimator import JaxEstimator, JaxModel
+    from horovod_tpu.spark.store import FilesystemStore
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+
+    store = FilesystemStore(str(tmp_path))
+    est = JaxEstimator(MLP(features=(16, 2)), xent_loss, optax.adam(1e-2),
+                       batch_size=16, epochs=3, store=store, run_id="t")
+    model = est.fit(x, y)
+    preds = model.predict(x)
+    assert preds.shape == (64, 2)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.6, acc
+
+    reloaded = JaxModel.load(MLP(features=(16, 2)), store, run_id="t")
+    np.testing.assert_allclose(reloaded.predict(x), preds, rtol=1e-6)
+
+
+def test_ray_module_importable_without_ray():
+    import horovod_tpu.ray as hray
+
+    with pytest.raises(ImportError):
+        hray.RayExecutor()
+
+
+def test_spark_module_importable_without_pyspark():
+    import horovod_tpu.spark as hspark
+
+    assert hspark.LocalStore is not None
+    with pytest.raises(ImportError):
+        hspark.run(lambda: None)

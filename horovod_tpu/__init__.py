@@ -37,6 +37,7 @@ from .functions import (  # noqa: F401
 )
 from .compression import Compression  # noqa: F401
 from . import elastic  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 try:  # callbacks/sync-BN need optax+flax; keep the core importable without
     from . import callbacks  # noqa: F401

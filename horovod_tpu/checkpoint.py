@@ -1,0 +1,123 @@
+"""Checkpoint / resume helpers.
+
+Reference behavior (SURVEY.md §5 "Checkpoint/resume"): Horovod itself ships
+no checkpoint writer — examples use the framework's checkpointing with the
+rank-0-writes idiom plus ``broadcast_parameters`` on restore, and the Spark
+estimators persist through the Store.  This module packages that idiom for
+JAX: Orbax for the serialization when available (async, sharding-aware),
+a plain pickle fallback otherwise; writes happen on rank 0 only, restores
+broadcast from rank 0 so every rank resumes bit-identically.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+from typing import Any, Optional
+
+from . import basics
+from .functions import broadcast_object
+from .mpi_ops import barrier
+
+
+def _has_orbax() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class Checkpointer:
+    """Rank-0-writes checkpointing with broadcast-on-restore.
+
+    Usage::
+
+        ckpt = hvd.checkpoint.Checkpointer("/tmp/run1")
+        ckpt.save(step, {"params": params, "opt_state": opt_state})
+        state = ckpt.restore()           # latest, broadcast to all ranks
+    """
+
+    def __init__(self, directory: str, use_orbax: Optional[bool] = None):
+        self.directory = os.path.abspath(directory)
+        self.use_orbax = _has_orbax() if use_orbax is None else use_orbax
+        if self._is_root():
+            os.makedirs(self.directory, exist_ok=True)
+
+    def _is_root(self) -> bool:
+        return not basics.is_initialized() or basics.rank() == 0
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step}")
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state: Any) -> None:
+        """Write ``state`` (a pytree) at ``step`` from rank 0; other ranks
+        wait at a barrier so training never races the write."""
+        err: Optional[str] = None
+        if self._is_root():
+            try:
+                import jax
+
+                host_state = jax.device_get(state)
+                if self.use_orbax:
+                    import orbax.checkpoint as ocp
+
+                    ckptr = ocp.PyTreeCheckpointer()
+                    ckptr.save(self._path(step), host_state, force=True)
+                else:
+                    with open(self._path(step) + ".pkl", "wb") as f:
+                        pickle.dump(host_state, f)
+            except Exception as exc:  # noqa: BLE001 - propagate to all ranks
+                err = f"{type(exc).__name__}: {exc}"
+        if basics.is_initialized() and basics.size() > 1:
+            # Share the write outcome so a root failure doesn't strand the
+            # other ranks at a barrier; every rank raises the same error.
+            err = broadcast_object(err, root_rank=0, name="ckpt.save_status")
+        if err is not None:
+            raise RuntimeError(f"checkpoint save failed on rank 0: {err}")
+
+    # -- restore ------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        if not os.path.isdir(self.directory):
+            return None
+        steps = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"ckpt_(\d+)(\.pkl)?", name)
+            if m:
+                steps.append(int(m.group(1)))
+        return max(steps) if steps else None
+
+    def restore(self, step: Optional[int] = None, target: Any = None) -> Any:
+        """Read a checkpoint on rank 0 and broadcast it to every rank
+        (the reference's broadcast_parameters-on-restart idiom).  Returns
+        None if no checkpoint exists."""
+        if step is None:
+            step = self.latest_step() if self._is_root() else None
+            if basics.is_initialized() and basics.size() > 1:
+                step = broadcast_object(step, root_rank=0,
+                                        name="ckpt.latest_step")
+            if step is None:
+                return None
+        state = None
+        err: Optional[str] = None
+        if self._is_root():
+            try:
+                if self.use_orbax and os.path.isdir(self._path(step)):
+                    import orbax.checkpoint as ocp
+
+                    ckptr = ocp.PyTreeCheckpointer()
+                    state = ckptr.restore(self._path(step), item=target)
+                else:
+                    with open(self._path(step) + ".pkl", "rb") as f:
+                        state = pickle.load(f)
+            except Exception as exc:  # noqa: BLE001 - propagate to all ranks
+                err = f"{type(exc).__name__}: {exc}"
+        if basics.is_initialized() and basics.size() > 1:
+            err, state = broadcast_object((err, state), root_rank=0,
+                                          name="ckpt.restore")
+        if err is not None:
+            raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
+        return state

@@ -265,15 +265,34 @@ class HorovodContext:
         pre = entries[0].prescale_factor
         if pre != 1.0:
             fused = (fused.astype(np.float64) * pre).astype(dtype)
-        wire_op = ReduceOp.SUM if reduce_op in (ReduceOp.AVERAGE, ReduceOp.ADASUM) \
-            else reduce_op
         if reduce_op == ReduceOp.ADASUM and self._ps_size(psid) > 1:
-            log.warning("Adasum host-path falls back to Average in this build")
-        fused = self.core.allreduce_buffer(fused, psid, wire_op)
-        if reduce_op in (ReduceOp.AVERAGE, ReduceOp.ADASUM):
-            n = self._ps_size(psid)
-            if n > 1:
-                fused = (fused.astype(np.float64) / n).astype(dtype)
+            # Host-path Adasum: allgather every rank's fused buffer, then a
+            # deterministic local pairwise-tree combine — every rank computes
+            # the identical result (reference: adasum_mpi.cc uses MPI
+            # point-to-point VHDD; the allgather form trades bandwidth for
+            # the simpler host plane, fine at CPU-negotiation scale).
+            # The combine runs PER TENSOR segment: adasum's dot/norm
+            # coefficients are per-tensor in the reference too —
+            # adasum(concat(a1,a2), ...) != concat(adasum(a1,...), ...).
+            stacked, _ = self.core.allgather_buffer(
+                fused.reshape(1, -1), psid)
+            vectors = np.asarray(stacked, dtype=np.float64)
+            segments = []
+            offset = 0
+            for e in entries:
+                seg = vectors[:, offset:offset + e.array.size]
+                segments.append(_adasum_tree(seg))
+                offset += e.array.size
+            fused = np.concatenate(segments).astype(dtype)
+        else:
+            wire_op = ReduceOp.SUM \
+                if reduce_op in (ReduceOp.AVERAGE, ReduceOp.ADASUM) \
+                else reduce_op
+            fused = self.core.allreduce_buffer(fused, psid, wire_op)
+            if reduce_op == ReduceOp.AVERAGE:
+                n = self._ps_size(psid)
+                if n > 1:
+                    fused = (fused.astype(np.float64) / n).astype(dtype)
         post = entries[0].postscale_factor
         if post != 1.0:
             fused = (fused.astype(np.float64) * post).astype(dtype)
@@ -343,6 +362,28 @@ class HorovodContext:
         start = my_pos * base + min(my_pos, extra)
         length = base + (1 if my_pos < extra else 0)
         e.result = full[start:start + length]
+
+
+def _adasum_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Scale-invariant pairwise combine (reference: adasum/adasum.h):
+    adasum(a, b) = (1 - a.b/(2|a|^2)) a + (1 - a.b/(2|b|^2)) b."""
+    dot = float(np.dot(a, b))
+    na = max(float(np.dot(a, a)), 1e-300)
+    nb = max(float(np.dot(b, b)), 1e-300)
+    return (1.0 - dot / (2.0 * na)) * a + (1.0 - dot / (2.0 * nb)) * b
+
+
+def _adasum_tree(vectors: np.ndarray) -> np.ndarray:
+    """Pairwise-tree Adasum over rank-major rows; handles non-power-of-two
+    counts by passing the odd row through to the next level."""
+    rows = [vectors[i].ravel() for i in range(vectors.shape[0])]
+    while len(rows) > 1:
+        nxt = [_adasum_pair(rows[i], rows[i + 1])
+               for i in range(0, len(rows) - 1, 2)]
+        if len(rows) % 2:
+            nxt.append(rows[-1])
+        rows = nxt
+    return rows[0]
 
 
 def _contig(a: np.ndarray) -> np.ndarray:

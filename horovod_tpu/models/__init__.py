@@ -9,3 +9,6 @@ from .bert import (  # noqa: F401
     BertConfig, BertEncoder, BertForPreTraining, mlm_loss,
     BERT_BASE, BERT_LARGE, BERT_TINY,
 )
+from .gpt import (  # noqa: F401
+    GPT, GPTConfig, GPT_SMALL, GPT_TINY, lm_loss,
+)

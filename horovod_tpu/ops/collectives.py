@@ -30,8 +30,31 @@ def axis_size(axis_name: AxisName) -> int:
     return lax.axis_size(axis_name)
 
 
+def _axes_tuple(axis_name: AxisName):
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def ensure_varying(x, axis_name: AxisName):
+    """Cast ``x`` to 'varying' over every requested axis (shard_map vma).
+
+    Classic collective semantics treat the input as this shard's value;
+    psum of a replicated value multiplies by the axis size, pmean is the
+    identity.  JAX's vma typing instead *rejects* collectives over axes the
+    value is invariant on — this cast restores the classic behavior at the
+    public API boundary.  (Gradient reduction wants different semantics for
+    invariant leaves — see optimizer._tree_allreduce.)
+    """
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:
+        return x
+    missing = tuple(a for a in _axes_tuple(axis_name) if a not in vma)
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
 def allreduce(x, axis_name: AxisName, op: ReduceOp = ReduceOp.AVERAGE,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    x = ensure_varying(x, axis_name)
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     if op == ReduceOp.AVERAGE:
@@ -55,7 +78,8 @@ def allreduce(x, axis_name: AxisName, op: ReduceOp = ReduceOp.AVERAGE,
 
 def allgather(x, axis_name: AxisName):
     """Concatenate along dim 0 across the axis (Horovod allgather semantics)."""
-    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    return lax.all_gather(ensure_varying(x, axis_name), axis_name, axis=0,
+                          tiled=True)
 
 
 def broadcast(x, root_rank: int, axis_name: AxisName):
@@ -64,6 +88,7 @@ def broadcast(x, root_rank: int, axis_name: AxisName):
     Implemented as a masked psum — one collective, no gather of the full
     axis — which XLA lowers to an ICI broadcast-like pattern.
     """
+    x = ensure_varying(x, axis_name)
     idx = lax.axis_index(axis_name)
     # where() (not multiply-by-mask) so NaN/Inf in non-root shards are
     # discarded rather than propagated through the sum.
@@ -74,13 +99,15 @@ def broadcast(x, root_rank: int, axis_name: AxisName):
 def alltoall(x, axis_name: AxisName):
     """Equal-splits alltoall: first dim is split across the axis and the
     received chunks are concatenated along dim 0 (lax.all_to_all)."""
-    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    return lax.all_to_all(ensure_varying(x, axis_name), axis_name,
+                          split_axis=0, concat_axis=0, tiled=True)
 
 
 def reducescatter(x, axis_name: AxisName, op: ReduceOp = ReduceOp.SUM,
                   prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("in-jit reducescatter supports Sum and Average")
+    x = ensure_varying(x, axis_name)
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)

@@ -1,0 +1,135 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference's only custom kernels are CUDA memcpy/scale helpers
+(horovod/common/ops/cuda/cuda_kernels.cu; SURVEY.md §2.2) — its models come
+from torch/TF.  This framework owns its model zoo, so the hot op worth a
+hand kernel on TPU is attention: this kernel keeps the [S, S] score matrix
+out of HBM entirely (VMEM-blocked online softmax), the classic
+flash-attention trade.
+
+Layout: inputs [batch, seq, heads, head_dim]; the kernel runs on
+[batch*heads, seq, head_dim] with a (BH, seq/block_q) grid; K/V live in
+VMEM whole (fine to ~8k sequence at head_dim 64-128), Q is blocked.
+Causal mode requires block_q == block_k and skips blocks above the
+diagonal, so every processed row has at least one valid key (keeps the
+online-softmax max finite with a -1e30 mask value, no NaN guards needed).
+
+Off-TPU (CPU tests) the public wrapper falls back to an identical-math
+dense implementation; the kernel itself is unit-tested in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                causal: bool, block_q: int, block_k: int):
+    iq = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale          # [Bq, D]
+    seq_len = k_ref.shape[0]
+    d = q_ref.shape[-1]
+
+    if causal:
+        n_blocks = iq + 1                                # skip above-diagonal
+    else:
+        n_blocks = seq_len // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(                          # [Bq, Bk] on MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
+    """Kernel entry over [BH, S, D]."""
+    bh, s, d = qb.shape
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_mha_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+
+
+def dense_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Reference-math dense attention over [B, S, H, D] (fp32 softmax)."""
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Attention over [batch, seq, heads, head_dim].
+
+    On TPU this is the Pallas kernel; elsewhere it falls back to the dense
+    implementation (identical math) unless ``interpret=True`` forces the
+    kernel through the Pallas interpreter (tests).
+    """
+    b, s, h, d = q.shape
+    if interpret is None:
+        if jax.default_backend() not in ("tpu", "axon"):
+            return dense_attention(q, k, v, causal, scale)
+        interpret = False
+    sm_scale = d ** -0.5 if scale is None else scale
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if causal and block_q != block_k:
+        block_q = block_k = min(block_q, block_k)
+    if s % block_q or s % block_k:
+        return dense_attention(q, k, v, causal, scale)
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal,
+                      block_q, block_k, bool(interpret))
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

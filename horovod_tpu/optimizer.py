@@ -39,6 +39,52 @@ from .process_sets import ProcessSet, _resolve_psid
 from .wire import ReduceOp
 
 
+def _leaf_vma(leaf):
+    try:
+        return jax.typeof(leaf).vma
+    except Exception:
+        return None
+
+
+def _reduce_grad_leaf(leaf, axes, op: ReduceOp,
+                      prescale_factor: float, postscale_factor: float,
+                      vma_tracked: bool):
+    """Gradient-context allreduce of one leaf over ``axes``.
+
+    Unlike the classic collective (which casts invariant inputs to varying),
+    a gradient leaf that is *invariant* over some requested axis was already
+    reduced over it — the backward pass of sequence/tensor-parallel models
+    (e.g. ring attention's ppermute/pcast transposes) psums such grads.  So:
+    SUM psums only the still-varying axes; AVERAGE additionally divides by
+    the FULL axis-size product, which equals the mean over all shards for
+    both pre-reduced and varying leaves.
+
+    ``vma_tracked=False`` (shard_map check_vma=False, where every value
+    reports an empty vma) falls back to classic semantics.
+    """
+    from jax import lax
+
+    vma = _leaf_vma(leaf)
+    if vma is None or not vma_tracked:
+        varying = axes
+    else:
+        varying = tuple(a for a in axes if a in vma)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        if prescale_factor != 1.0:
+            leaf = leaf * jnp.asarray(prescale_factor, leaf.dtype)
+        out = lax.psum(leaf, varying) if varying else leaf
+        if op == ReduceOp.AVERAGE:
+            total = 1
+            for a in axes:
+                total *= lax.axis_size(a)
+            out = out / total
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        return out
+    return _jit_ops.allreduce(leaf, axes, op, prescale_factor,
+                              postscale_factor)
+
+
 def _tree_allreduce(grads, op: ReduceOp, compression,
                     prescale_factor: float, postscale_factor: float,
                     process_set: Optional[ProcessSet],
@@ -49,11 +95,17 @@ def _tree_allreduce(grads, op: ReduceOp, compression,
         return grads
     if _is_traced(leaves[0]):
         ax = axis_name if axis_name is not None else _mesh.mesh_axis_name()
+        axes = (ax,) if isinstance(ax, str) else tuple(ax)
+        # vma tracking is per-trace: with check_vma=False every leaf reports
+        # an empty vma, indistinguishable per-leaf from "fully pre-reduced".
+        # Gradients of any real model vary over the data axis, so if no leaf
+        # in the whole tree is marked varying, tracking must be off.
+        vma_tracked = any((_leaf_vma(l) or ()) for l in leaves)
         out = []
         for leaf in leaves:
             comp, ctx = compression.compress(leaf)
-            red = _jit_ops.allreduce(comp, ax, op, prescale_factor,
-                                     postscale_factor)
+            red = _reduce_grad_leaf(comp, axes, op, prescale_factor,
+                                    postscale_factor, vma_tracked)
             out.append(compression.decompress(red, ctx))
         return jax.tree_util.tree_unflatten(treedef, out)
     # Eager: enqueue everything first (negotiation fuses the bucket), then wait.
@@ -160,16 +212,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                 # lax.cond requires both branches to agree on varying-manual-
                 # axes types; psum outputs are axis-invariant while held
                 # accumulators are varying, so cast everything to varying.
-                def cast(x):
-                    try:
-                        vma = jax.typeof(x).vma
-                    except Exception:
-                        return x
-                    axes = (ax,) if isinstance(ax, str) else tuple(ax)
-                    missing = tuple(a for a in axes if a not in vma)
-                    return jax.lax.pcast(x, missing, to="varying") if missing else x
-
-                return jax.tree_util.tree_map(cast, tree)
+                return jax.tree_util.tree_map(
+                    lambda x: _jit_ops.ensure_varying(x, ax), tree)
 
             def communicate(acc_inner):
                 acc, inner_state = acc_inner

@@ -1,0 +1,70 @@
+"""Adasum host-path reduction and rank-0-writes checkpointing under np=2
+(reference analogs: test_adasum_pytorch.py patterns + the checkpoint idiom;
+SURVEY.md §2.2, §5)."""
+
+import numpy as np
+
+from horovod_tpu.runner import run
+
+
+def _adasum_worker():
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+
+    # Orthogonal vectors: dot = 0 -> adasum(a, b) = a + b (pure sum).
+    a = np.array([1.0, 0.0], np.float64) if r == 0 else \
+        np.array([0.0, 2.0], np.float64)
+    out = hvd.allreduce(a, op=hvd.Adasum, name="ad.orth")
+    np.testing.assert_allclose(out, [1.0, 2.0], atol=1e-12)
+
+    # Identical vectors: dot = |a|^2 = |b|^2 -> each coefficient 1/2 ->
+    # adasum(a, a) = a (scale invariance: duplicated gradient not doubled).
+    b = np.array([3.0, -1.0, 2.0], np.float64)
+    out = hvd.allreduce(b, op=hvd.Adasum, name="ad.same")
+    np.testing.assert_allclose(out, b, atol=1e-12)
+
+    # Every rank computes identical results for rank-dependent input.
+    c = np.arange(4, dtype=np.float64) + r
+    out = np.asarray(hvd.allreduce(c, op=hvd.Adasum, name="ad.mixed"))
+    gathered = hvd.allgather_object(out.tolist())
+    assert gathered[0] == gathered[1]
+
+    hvd.shutdown()
+    return r
+
+
+def test_adasum_np2():
+    assert run(_adasum_worker, np=2) == [0, 1]
+
+
+def _checkpoint_worker(tmpdir):
+    import numpy as np
+    import jax.numpy as jnp
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+
+    ckpt = hvd.checkpoint.Checkpointer(tmpdir)
+    state = {"w": jnp.full((4,), float(r + 1)), "step": 7}
+    # Only rank 0's state is written.
+    ckpt.save(7, state)
+    restored = ckpt.restore()
+    # Both ranks see rank 0's values.
+    np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
+    assert restored["step"] == 7
+    assert ckpt.latest_step() == 7 or r != 0
+
+    ckpt.save(9, {"w": jnp.zeros((2,)), "step": 9})
+    restored = ckpt.restore()
+    assert restored["step"] == 9
+
+    hvd.shutdown()
+    return r
+
+
+def test_checkpoint_np2(tmp_path):
+    assert run(_checkpoint_worker, args=(str(tmp_path),), np=2) == [0, 1]

@@ -18,7 +18,6 @@ from typing import Any, Optional
 
 from . import basics
 from .functions import broadcast_object
-from .mpi_ops import barrier
 
 
 def _has_orbax() -> bool:
@@ -54,8 +53,10 @@ class Checkpointer:
 
     # -- save ---------------------------------------------------------------
     def save(self, step: int, state: Any) -> None:
-        """Write ``state`` (a pytree) at ``step`` from rank 0; other ranks
-        wait at a barrier so training never races the write."""
+        """Write ``state`` (a pytree) at ``step`` from rank 0.  The
+        write-status broadcast below is also the synchronization point: no
+        rank proceeds (or silently diverges) until rank 0's write finished
+        or every rank raised the same error."""
         err: Optional[str] = None
         if self._is_root():
             try:
@@ -68,8 +69,15 @@ class Checkpointer:
                     ckptr = ocp.PyTreeCheckpointer()
                     ckptr.save(self._path(step), host_state, force=True)
                 else:
-                    with open(self._path(step) + ".pkl", "wb") as f:
+                    # Atomic: a crash mid-write must never leave a truncated
+                    # ckpt_N.pkl for latest_step() to pick over an older
+                    # intact one (orbax finalizes atomically already).
+                    tmp = self._path(step) + ".pkl.tmp"
+                    with open(tmp, "wb") as f:
                         pickle.dump(host_state, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, self._path(step) + ".pkl")
             except Exception as exc:  # noqa: BLE001 - propagate to all ranks
                 err = f"{type(exc).__name__}: {exc}"
         if basics.is_initialized() and basics.size() > 1:

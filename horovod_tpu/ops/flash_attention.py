@@ -31,7 +31,7 @@ NEG_INF = -1e30
 
 
 def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                causal: bool, block_q: int, block_k: int):
+                causal: bool, block_q: int, block_k: int, valid_len: int):
     iq = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * sm_scale          # [Bq, D]
     seq_len = k_ref.shape[0]
@@ -41,6 +41,7 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
         n_blocks = iq + 1                                # skip above-diagonal
     else:
         n_blocks = seq_len // block_k
+    padded = valid_len < seq_len
 
     def body(j, carry):
         acc, m, l = carry
@@ -49,12 +50,17 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
         s = jax.lax.dot_general(                          # [Bq, Bk] on MXU
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if causal:
+        if causal or padded:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            if causal:
+                # Padding lives at the tail, so kpos > any real qpos —
+                # the causal mask already excludes padded keys.
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            else:
+                s = jnp.where(kpos < valid_len, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -70,12 +76,14 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret):
-    """Kernel entry over [BH, S, D]."""
+def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
+                valid_len):
+    """Kernel entry over [BH, S, D] (S already padded to the block size)."""
     bh, s, d = qb.shape
     grid = (bh, s // block_q)
     kernel = functools.partial(_mha_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               valid_len=valid_len)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -124,12 +132,22 @@ def flash_attention(q, k, v, causal: bool = False,
     block_k = min(block_k, s)
     if causal and block_q != block_k:
         block_q = block_k = min(block_q, block_k)
-    if s % block_q or s % block_k:
-        return dense_attention(q, k, v, causal, scale)
+    # Pad the sequence up to a block multiple (tail keys masked in-kernel;
+    # a dense fallback here would materialize the [S, S] scores this kernel
+    # exists to avoid).
+    block = max(block_q, block_k)
+    s_pad = -(-s // block) * block
+    if s_pad != s:
+        pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        block_q = block_k = block
 
     def to_bhsd(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
     out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal,
-                      block_q, block_k, bool(interpret))
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+                      block_q, block_k, bool(interpret), valid_len=s)
+    out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
+    return out[:, :s]

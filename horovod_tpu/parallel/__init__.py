@@ -7,3 +7,9 @@ from .mesh import (  # noqa: F401
     sub_mesh,
 )
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    column_parallel_dense, row_parallel_dense, tp_mlp,
+    vocab_parallel_embedding, shard_kernel,
+)
+from .pipeline import gpipe, pipeline_stage_params, last_stage_value  # noqa: F401
+from .moe import switch_moe, moe_ffn, load_balancing_loss  # noqa: F401

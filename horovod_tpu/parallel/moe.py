@@ -1,0 +1,101 @@
+"""Expert parallelism: switch-routed mixture-of-experts over a mesh axis.
+
+Absent in the reference (SURVEY.md §2.7 — its alltoall is the primitive EP
+would need).  TPU-native design: one expert (or expert group) per ep rank;
+top-1 (switch) routing with a fixed capacity per expert so every shape is
+static; the token dispatch and return are each ONE ``lax.all_to_all`` on
+ICI — the canonical MoE communication pattern.
+
+Dropped tokens (over capacity) pass through with a zero expert output,
+scaled by their gate as usual — the standard switch-transformer behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.collectives import ensure_varying
+
+
+def switch_moe(x, router_kernel, expert_fn: Callable, axis_name: str = "ep",
+               capacity_factor: float = 1.25):
+    """Top-1 MoE layer with one expert per ep rank.
+
+    Args:
+      x: [tokens_local, d] — this shard's tokens.
+      router_kernel: [d, n_experts] router weights (replicated).
+      expert_fn: this rank's expert, [cap_total, d] -> [cap_total, d]
+        (applied to the tokens routed to THIS rank's expert).
+      axis_name: expert-parallel mesh axis; n_experts == axis size.
+      capacity_factor: per-expert capacity = ceil(T/E * factor).
+
+    Returns [tokens_local, d].
+    """
+    x = ensure_varying(x, axis_name)
+    tokens, d = x.shape
+    n_expert = lax.axis_size(axis_name)
+    capacity = int(-(-tokens * capacity_factor // n_expert))  # ceil
+
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_kernel)
+    gates = jax.nn.softmax(logits, axis=-1)                 # [T, E]
+    expert_idx = jnp.argmax(gates, axis=-1)                 # [T]
+    gate = jnp.max(gates, axis=-1)                          # [T]
+
+    # Position of each token within its expert's capacity bucket.
+    onehot = jax.nn.one_hot(expert_idx, n_expert, dtype=jnp.int32)  # [T, E]
+    pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1)        # [T, E]
+    pos = jnp.take_along_axis(pos_in_expert, expert_idx[:, None],
+                              axis=1)[:, 0]                 # [T]
+    keep = pos < capacity
+
+    # Scatter tokens into the dispatch buffer [E, C, d].
+    dispatch = jnp.zeros((n_expert, capacity, d), x.dtype)
+    safe_pos = jnp.where(keep, pos, 0)
+    dispatch = dispatch.at[expert_idx, safe_pos].add(
+        jnp.where(keep[:, None], x, 0))
+
+    # One all_to_all: shard e of every rank -> rank e. Received layout:
+    # [E_src, C, d] = each peer's tokens for THIS rank's expert.
+    received = lax.all_to_all(dispatch, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+
+    expert_out = expert_fn(received.reshape(n_expert * capacity, d))
+    expert_out = expert_out.reshape(n_expert, capacity, d).astype(x.dtype)
+
+    # Return trip: chunk s goes back to source rank s.
+    returned = lax.all_to_all(expert_out, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)   # [E, C, d]
+
+    # Gather each kept token's expert output back to token order.
+    out = returned[expert_idx, safe_pos]                    # [T, d]
+    out = jnp.where(keep[:, None], out, 0)
+    return (out * gate[:, None].astype(x.dtype))
+
+
+def moe_ffn(w_in_local, w_out_local, activation=jax.nn.gelu):
+    """Build an expert_fn for :func:`switch_moe` from this rank's FFN
+    weights ([d, hidden], [hidden, d])."""
+
+    def fn(tokens):
+        h = activation(jnp.einsum("td,dh->th", tokens, w_in_local))
+        return jnp.einsum("th,hd->td", h, w_out_local)
+
+    return fn
+
+
+def load_balancing_loss(x, router_kernel, axis_name: str = "ep"):
+    """Switch-transformer auxiliary load-balance loss: E * sum_e f_e * P_e
+    (fraction of tokens routed to e times mean router prob of e)."""
+    n_expert = lax.axis_size(axis_name)
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_kernel)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(expert_idx, n_expert, dtype=jnp.float32),
+                    axis=0)
+    prob = jnp.mean(gates, axis=0)
+    return n_expert * jnp.sum(frac * prob)

@@ -1,0 +1,93 @@
+"""Pipeline parallelism (GPipe-style) over a mesh axis.
+
+Absent in the reference (SURVEY.md §2.7).  TPU-native design: every pp rank
+holds one stage's weights; activations flow stage-to-stage with
+``lax.ppermute`` hops on ICI while microbatches stream through, all inside
+one compiled program (``lax.fori_loop`` over ticks — no host round trips).
+
+The stage function must be shape-preserving ([mb, ...] -> [mb, ...]), the
+standard shape for stacked transformer blocks.  Differentiable: ppermute
+has a transpose, so ``jax.grad`` through ``gpipe`` yields pipelined
+backward automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.collectives import ensure_varying
+
+
+def gpipe(stage_fn: Callable, stage_params, x_microbatches,
+          axis_name: str = "pp"):
+    """Run ``stage_fn(stage_params, act)`` as a pipeline over the pp axis.
+
+    Args:
+      stage_fn: one pipeline stage, [mb, ...] -> [mb, ...].
+      stage_params: THIS shard's stage weights (different per pp rank).
+      x_microbatches: [n_micro, mb, ...] — the full input, meaningful on
+        stage 0 (other ranks may pass the same array; it is ignored).
+      axis_name: the pipeline mesh axis.
+
+    Returns [n_micro, mb, ...]: the last stage's outputs, valid on the last
+    pp rank (zeros elsewhere) — combine with a psum/ppermute or compute the
+    loss on the last rank.
+    """
+    n_stages = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    total_ticks = n_micro + n_stages - 1
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    x_microbatches = ensure_varying(x_microbatches, axis_name)
+    buf0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+    out0 = jnp.zeros((n_micro,) + mb_shape, x_microbatches.dtype)
+    buf0 = ensure_varying(buf0, axis_name)
+    out0 = ensure_varying(out0, axis_name)
+
+    def tick(t, carry):
+        outputs, buf = carry
+        # Stage 0 injects microbatch t (clamped; extra ticks recompute the
+        # last microbatch and are discarded), later stages use the buffer
+        # received from upstream.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(idx == 0,
+                        lax.dynamic_index_in_dim(x_microbatches, mb_idx,
+                                                 keepdims=False),
+                        buf)
+        out = stage_fn(stage_params, inp)
+        # The last stage emits microbatch t-(n_stages-1) at tick t.
+        emit_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        emit = (idx == n_stages - 1) & (t >= n_stages - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(emit, out,
+                               lax.dynamic_index_in_dim(outputs, emit_idx,
+                                                        keepdims=False)),
+            emit_idx, axis=0)
+        buf_next = lax.ppermute(out, axis_name, fwd_perm)
+        return updated, buf_next
+
+    outputs, _ = lax.fori_loop(0, total_ticks, tick, (out0, buf0))
+    return outputs
+
+
+def pipeline_stage_params(params_by_stage, axis_name: str = "pp"):
+    """Select this rank's stage weights from a stacked pytree whose leaves
+    have a leading n_stages dim (convenience for tests/checkpoints)."""
+    idx = lax.axis_index(axis_name)
+    return jax.tree_util.tree_map(
+        lambda leaf: lax.dynamic_index_in_dim(leaf, idx, keepdims=False),
+        params_by_stage)
+
+
+def last_stage_value(x, axis_name: str = "pp"):
+    """Broadcast the last pp rank's value to all ranks (one psum)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    contribution = jnp.where(idx == n - 1, x, jnp.zeros_like(x))
+    return lax.psum(contribution, axis_name)

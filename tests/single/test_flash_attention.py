@@ -28,6 +28,25 @@ def test_flash_kernel_matches_dense(causal, shape):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kernel_padded_seq(causal):
+    """Non-block-multiple sequence lengths run through the kernel with tail
+    masking (no dense fallback)."""
+    b, s, h, d = 1, 23, 2, 8
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+
+    expected = dense_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                          interpret=True)
+    assert out.shape == (b, s, h, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_cpu_fallback_is_dense():
     # On CPU (interpret=None) the wrapper must route to the dense path.
     q = k = v = jnp.ones((1, 8, 2, 4))

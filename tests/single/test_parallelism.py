@@ -1,0 +1,161 @@
+"""TP / PP / EP primitives vs dense references on the 8-device mesh
+(capabilities beyond the reference — SURVEY.md §2.7 notes Horovod is
+DP-only; these are the TPU-native extensions its process sets hint at)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from horovod_tpu.parallel import (
+    column_parallel_dense, row_parallel_dense, tp_mlp,
+    vocab_parallel_embedding, shard_kernel,
+    gpipe, pipeline_stage_params, last_stage_value,
+    switch_moe, moe_ffn, load_balancing_loss,
+)
+
+N_DEV = 8
+
+
+def _mesh(name):
+    return Mesh(np.asarray(jax.devices()[:N_DEV]), (name,))
+
+
+def test_tp_mlp_matches_dense():
+    d, hidden = 16, 64
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, d).astype(np.float32))
+    w_in = jnp.asarray(rng.randn(d, hidden).astype(np.float32) * 0.1)
+    b_in = jnp.asarray(rng.randn(hidden).astype(np.float32) * 0.1)
+    w_out = jnp.asarray(rng.randn(hidden, d).astype(np.float32) * 0.1)
+    b_out = jnp.asarray(rng.randn(d).astype(np.float32) * 0.1)
+
+    expected = jax.nn.gelu(x @ w_in + b_in) @ w_out + b_out
+
+    def fn(x, w_in, b_in, w_out, b_out):
+        w_in_l = shard_kernel(w_in, "tp", 1)
+        b_in_l = shard_kernel(b_in, "tp", 0)
+        w_out_l = shard_kernel(w_out, "tp", 0)
+        return tp_mlp(x, w_in_l, w_out_l, b_in_l, b_out, axis_name="tp")
+
+    out = shard_map(fn, mesh=_mesh("tp"),
+                    in_specs=(P(), P(), P(), P(), P()), out_specs=P())(
+        x, w_in, b_in, w_out, b_out)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_column_row_roundtrip_gather():
+    d = 8
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(d, 32).astype(np.float32))
+
+    def fn(x, w):
+        w_l = shard_kernel(w, "tp", 1)
+        # gathered output is replicated in value but typed varying; stack
+        # per-shard copies on a leading axis to inspect them all
+        return column_parallel_dense(x, w_l, axis_name="tp",
+                                     gather_output=True)[None]
+
+    out = shard_map(fn, mesh=_mesh("tp"), in_specs=(P(), P()),
+                    out_specs=P("tp"))(x, w)
+    for shard in np.asarray(out):
+        np.testing.assert_allclose(shard, np.asarray(x @ w),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_vocab_parallel_embedding():
+    vocab, d = 64, 4
+    rng = np.random.RandomState(2)
+    table = jnp.asarray(rng.randn(vocab, d).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, vocab, size=(2, 10)))
+
+    def fn(ids, table):
+        return vocab_parallel_embedding(ids, shard_kernel(table, "tp", 0),
+                                        axis_name="tp")
+
+    out = shard_map(fn, mesh=_mesh("tp"), in_specs=(P(), P()),
+                    out_specs=P())(ids, table)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.take(table, ids, axis=0)),
+                               rtol=1e-5)
+
+
+def test_gpipe_matches_sequential():
+    """8 pipeline stages, each y = gelu(x @ W_s); compare with running all
+    stages sequentially."""
+    d, mb, n_micro = 8, 4, 5
+    rng = np.random.RandomState(3)
+    stage_ws = jnp.asarray(
+        rng.randn(N_DEV, d, d).astype(np.float32) * 0.5)
+    x = jnp.asarray(rng.randn(n_micro, mb, d).astype(np.float32))
+
+    def stage(w, act):
+        return jax.nn.gelu(act @ w)
+
+    expected = x
+    for s in range(N_DEV):
+        expected = stage(stage_ws[s], expected)
+
+    def fn(x, stage_ws):
+        w_local = pipeline_stage_params(stage_ws, "pp")
+        out = gpipe(stage, w_local, x, axis_name="pp")
+        return last_stage_value(out, "pp")
+
+    out = shard_map(fn, mesh=_mesh("pp"), in_specs=(P(), P()),
+                    out_specs=P())(x, stage_ws)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_switch_moe_matches_per_token_expert():
+    """With generous capacity nothing drops: each token's output must equal
+    gate * expert_{argmax}(token)."""
+    d, hidden, tokens = 8, 16, 16
+    rng = np.random.RandomState(4)
+    x_all = jnp.asarray(rng.randn(N_DEV * tokens, d).astype(np.float32))
+    router = jnp.asarray(rng.randn(d, N_DEV).astype(np.float32))
+    w_in_all = jnp.asarray(rng.randn(N_DEV, d, hidden).astype(np.float32) * 0.3)
+    w_out_all = jnp.asarray(rng.randn(N_DEV, hidden, d).astype(np.float32) * 0.3)
+
+    # Dense reference: route each token through its argmax expert.
+    logits = x_all @ router
+    gates = jax.nn.softmax(logits, axis=-1)
+    eidx = np.asarray(jnp.argmax(gates, axis=-1))
+    gate = np.asarray(jnp.max(gates, axis=-1))
+    expected = np.zeros_like(np.asarray(x_all))
+    for t in range(x_all.shape[0]):
+        e = int(eidx[t])
+        h = jax.nn.gelu(x_all[t] @ w_in_all[e])
+        expected[t] = gate[t] * np.asarray(h @ w_out_all[e])
+
+    def fn(x, router, w_in_all, w_out_all):
+        w_in_l = pipeline_stage_params(w_in_all, "ep")
+        w_out_l = pipeline_stage_params(w_out_all, "ep")
+        return switch_moe(x, router, moe_ffn(w_in_l, w_out_l),
+                          axis_name="ep", capacity_factor=8.0)
+
+    out = shard_map(fn, mesh=_mesh("ep"),
+                    in_specs=(P("ep"), P(), P(), P()),
+                    out_specs=P("ep"))(x_all, router, w_in_all, w_out_all)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_load_balancing_loss_finite():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    router = jnp.asarray(rng.randn(8, N_DEV).astype(np.float32))
+
+    def fn(x, router):
+        return load_balancing_loss(x, router, "ep")[None]
+
+    out = shard_map(fn, mesh=_mesh("ep"), in_specs=(P("ep"), P()),
+                    out_specs=P("ep"))(
+        jnp.tile(x, (N_DEV, 1)), router)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.all(np.asarray(out) >= 1.0 - 1e-5)  # >= 1 by Cauchy-Schwarz

@@ -132,17 +132,19 @@ def flash_attention(q, k, v, causal: bool = False,
     block_k = min(block_k, s)
     if causal and block_q != block_k:
         block_q = block_k = min(block_q, block_k)
-    # Pad the sequence up to a block multiple (tail keys masked in-kernel;
-    # a dense fallback here would materialize the [S, S] scores this kernel
-    # exists to avoid).
-    block = max(block_q, block_k)
+    # Pad the sequence up to a multiple of BOTH block sizes (the q grid and
+    # the kv loop must each tile s_pad exactly), masking tail keys
+    # in-kernel; a dense fallback here would materialize the [S, S] scores
+    # this kernel exists to avoid.
+    import math
+
+    block = math.lcm(block_q, block_k)
     s_pad = -(-s // block) * block
     if s_pad != s:
         pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
         q = jnp.pad(q, pad)
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
-        block_q = block_k = block
 
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)

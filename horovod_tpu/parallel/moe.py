@@ -12,7 +12,6 @@ scaled by their gate as usual — the standard switch-transformer behavior.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax

@@ -93,5 +93,9 @@ def shard_kernel(kernel, axis_name: str, dim: int):
     convenience for loading non-TP checkpoints into TP layers."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    if kernel.shape[dim] % n != 0:
+        raise ValueError(
+            f"shard_kernel: dim {dim} of shape {kernel.shape} is not "
+            f"divisible by axis {axis_name!r} size {n}")
     size = kernel.shape[dim] // n
     return lax.dynamic_slice_in_dim(kernel, idx * size, size, axis=dim)

@@ -250,3 +250,39 @@ def _timeline_autotune_worker(tmpdir):
 
 def test_timeline_np2(tmp_path):
     assert run(_timeline_autotune_worker, args=(str(tmp_path),), np=2) == [0, 1]
+
+
+def _autotune_worker(tmpdir):
+    import os
+    import numpy as np
+    import horovod_tpu as hvd
+
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_LOG"] = os.path.join(
+        tmpdir, f"autotune_{os.environ['HOROVOD_RANK']}.csv")
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    # Push traffic for > 2 autotune windows (window_s = 2.0) so the
+    # hill-climber records at least one score line and proposes a move.
+    import time
+    t0 = time.monotonic()
+    i = 0
+    while time.monotonic() - t0 < 5.0:
+        hvd.allreduce(np.ones(4096, np.float32), op=hvd.Sum,
+                      name=f"at.{i}")
+        i += 1
+    hvd.shutdown()
+    log = os.environ["HOROVOD_AUTOTUNE_LOG"]
+    with open(log) as f:
+        lines = f.read().strip().splitlines()
+    assert lines[0].startswith("time_s,fusion_bytes,cycle_ms")
+    assert len(lines) >= 2, lines  # header + >=1 scored window
+    score = float(lines[1].rsplit(",", 1)[1])
+    assert score > 0
+    return r
+
+
+def test_autotune_np2(tmp_path):
+    from horovod_tpu.runner import run
+
+    assert run(_autotune_worker, args=(str(tmp_path),), np=2) == [0, 1]

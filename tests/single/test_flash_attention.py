@@ -47,6 +47,23 @@ def test_flash_kernel_padded_seq(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_kernel_mismatched_blocks():
+    """s a multiple of one block size but not the other: padding must go to
+    the lcm so both the q grid and the kv loop tile the sequence."""
+    b, s, h, d = 1, 32, 2, 8
+    key = jax.random.PRNGKey(9)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+    expected = dense_attention(q, k, v)
+    for bq, bk in [(24, 32), (32, 24)]:
+        out = flash_attention(q, k, v, block_q=bq, block_k=bk,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"{bq},{bk}")
+
+
 def test_flash_cpu_fallback_is_dense():
     # On CPU (interpret=None) the wrapper must route to the dense path.
     q = k = v = jnp.ones((1, 8, 2, 4))

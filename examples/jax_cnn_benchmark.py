@@ -1,0 +1,109 @@
+"""Unified CNN benchmark: the reference's tf_cnn_benchmarks workload.
+
+Horovod's published numbers (BASELINE.md) come from synthetic-data training
+of ResNet-50/101, Inception V3, and VGG-16 under DistributedOptimizer —
+this is that harness for TPU: pick a model, measure images/sec/chip with
+the gradient averaging riding the in-jit ICI plane.
+
+Run:  python examples/jax_cnn_benchmark.py --model resnet50 --steps 20
+      python examples/jax_cnn_benchmark.py --model vgg16 --batch-per-chip 32
+"""
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+from horovod_tpu import models
+
+MODELS = {
+    "resnet50": (lambda dt: models.ResNet50(dtype=dt, bn_axis_name="hvd"),
+                 224),
+    "resnet101": (lambda dt: models.ResNet101(dtype=dt, bn_axis_name="hvd"),
+                  224),
+    "inception3": (lambda dt: models.InceptionV3(dtype=dt,
+                                                 bn_axis_name="hvd"), 299),
+    "vgg16": (lambda dt: models.VGG16(dtype=dt), 224),
+    "resnet_tiny": (lambda dt: models.ResNetTiny(num_classes=100,
+                                                 bn_axis_name="hvd"), 32),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(MODELS), default="resnet50")
+    ap.add_argument("--batch-per-chip", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--fp32", action="store_true")
+    args = ap.parse_args()
+
+    hvd.init()
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.asarray(jax.devices()), ("hvd",))
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    build, hw = MODELS[args.model]
+    model = build(dtype)
+    batch = args.batch_per_chip * n_dev
+
+    images = jnp.ones((batch, hw, hw, 3), dtype)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    variables = jax.jit(
+        lambda: model.init(jax.random.PRNGKey(0), images[:2], train=False))()
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    has_bn = bool(batch_stats)
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  axis_name="hvd")
+    opt_state = tx.init(params)
+
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            vs = {"params": p}
+            if has_bn:
+                vs["batch_stats"] = batch_stats
+                logits, upd = model.apply(vs, images, train=True,
+                                          mutable=["batch_stats"])
+                return models.xent_loss(logits, labels), upd["batch_stats"]
+            # Non-BN models (VGG): still a *training* forward — dropout on,
+            # matching the reference's tf_cnn_benchmarks workload.
+            logits = model.apply(
+                vs, images, train=True,
+                rngs={"dropout": jax.random.PRNGKey(0)})
+            return models.xent_loss(logits, labels), batch_stats
+
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), stats, opt_state,
+                hvd.allreduce(loss, axis_name="hvd"))
+
+    step = jax.jit(shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P(), P())), donate_argnums=(0, 1, 2))
+
+    params, batch_stats, opt_state, loss = step(
+        params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        ips = batch * args.steps / dt
+        print(f"{args.model}: {ips:.1f} images/sec "
+              f"({ips / n_dev:.1f}/chip), loss={float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

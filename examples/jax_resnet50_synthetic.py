@@ -1,95 +1,28 @@
 """ResNet-50 data-parallel training on synthetic ImageNet (config 2).
 
-Reference analog: the tf_cnn_benchmarks-style scripts Horovod's published
-benchmarks use (docs/benchmarks.rst) — synthetic data, DistributedOptimizer,
-images/sec reporting.
-
-TPU-first shape: one jitted SPMD train step over the global mesh
-(shard_map over the "hvd" axis); gradient averaging is the in-jit psum
-data plane, bfloat16 activations on the MXU.
+Thin wrapper over the unified CNN benchmark harness — see
+examples/jax_cnn_benchmark.py for the full MODELS table
+(resnet50/101, inception3, vgg16, resnet_tiny).
 
 Run:  python examples/jax_resnet50_synthetic.py [--tiny]
 """
 
-import argparse
-import time
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-import optax
-from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
-
-import horovod_tpu as hvd
-from horovod_tpu import models
+import sys
 
 
 def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tiny", action="store_true",
-                    help="ResNetTiny/32x32 (CPU-friendly)")
-    ap.add_argument("--batch-per-chip", type=int, default=64)
-    ap.add_argument("--steps", type=int, default=20)
-    args = ap.parse_args()
-
-    hvd.init()
-    n_dev = len(jax.devices())
-    mesh = Mesh(np.asarray(jax.devices()), ("hvd",))
-
-    # Cross-replica (sync) BatchNorm: stats psum over the hvd axis, which
-    # also makes the updated batch_stats replica-invariant for out_specs P().
-    if args.tiny:
-        model = models.ResNetTiny(num_classes=100, bn_axis_name="hvd")
-        hw, batch = 32, 8 * n_dev
+    argv = sys.argv[1:]
+    if "--tiny" in argv:
+        argv.remove("--tiny")
+        argv += ["--model", "resnet_tiny", "--batch-per-chip", "8"]
     else:
-        model = models.ResNet50(num_classes=1000, dtype=jnp.bfloat16,
-                                bn_axis_name="hvd")
-        hw, batch = 224, args.batch_per_chip * n_dev
+        argv += ["--model", "resnet50"]
+    sys.argv = [sys.argv[0]] + argv
+    from jax_cnn_benchmark import main as bench_main
 
-    images = jnp.ones((batch, hw, hw, 3),
-                      jnp.bfloat16 if not args.tiny else jnp.float32)
-    labels = jnp.zeros((batch,), jnp.int32)
-
-    variables = jax.jit(
-        lambda: model.init(jax.random.PRNGKey(0), images[:2], train=False))()
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    tx = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
-                                  axis_name="hvd")
-    opt_state = tx.init(params)
-
-    def train_step(params, batch_stats, opt_state, images, labels):
-        def loss_fn(p):
-            logits, upd = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images,
-                train=True, mutable=["batch_stats"])
-            return models.xent_loss(logits, labels), upd["batch_stats"]
-
-        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return (optax.apply_updates(params, updates), stats, opt_state,
-                hvd.allreduce(loss, axis_name="hvd"))
-
-    step = jax.jit(shard_map(
-        train_step, mesh=mesh,
-        in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
-        out_specs=(P(), P(), P(), P())), donate_argnums=(0, 1, 2))
-
-    params, batch_stats, opt_state, loss = step(
-        params, batch_stats, opt_state, images, labels)  # compile
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    if hvd.rank() == 0:
-        print(f"images/sec: {batch * args.steps / dt:.1f} "
-              f"({batch * args.steps / dt / n_dev:.1f}/chip), "
-              f"loss={float(loss):.4f}")
-    hvd.shutdown()
+    bench_main()
 
 
 if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
     main()

@@ -1,0 +1,142 @@
+"""Sharded data loading utilities.
+
+The reference delegates input pipelines to each framework (tf.data, torch
+DataLoader + its DistributedSampler idiom in examples/); the TPU build's
+equivalent is a rank-sharded iterator that keeps the device fed:
+
+- shard by ``hvd.rank()``/``size()`` (same contract as DistributedSampler),
+- batches sized per-replica, dropping the ragged tail so shapes stay
+  static for XLA,
+- optional async host->device prefetch (double buffering) so input copies
+  overlap the previous step's compute — the host-side analog of what the
+  reference's fusion cycle overlaps on the wire.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class ShardedDataset:
+    """Deterministically shards index space over ranks, reshuffling per
+    epoch (reference idiom: torch DistributedSampler(set_epoch) in the
+    Horovod examples)."""
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 rank: Optional[int] = None, size: Optional[int] = None):
+        if not arrays:
+            raise ValueError("need at least one array")
+        n = len(arrays[0])
+        for a in arrays:
+            if len(a) != n:
+                raise ValueError("arrays must share their first dimension")
+        self.arrays = arrays
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._rank = rank
+        self._size = size
+        self.epoch = 0
+
+    def _world(self):
+        if self._rank is not None:
+            return self._rank, self._size or 1
+        import horovod_tpu as hvd
+
+        if hvd.is_initialized():
+            return hvd.rank(), hvd.size()
+        return 0, 1
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __iter__(self) -> Iterator[tuple]:
+        rank, size = self._world()
+        n = len(self.arrays[0])
+        idx = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(idx)
+        per_rank = n // size
+        idx = idx[rank * per_rank:(rank + 1) * per_rank]
+        stop = (len(idx) // self.batch_size * self.batch_size
+                if self.drop_last else len(idx))
+        for i in range(0, stop, self.batch_size):
+            sel = idx[i:i + self.batch_size]
+            yield tuple(a[sel] for a in self.arrays)
+
+    def __len__(self) -> int:
+        _, size = self._world()
+        per_rank = len(self.arrays[0]) // size
+        if self.drop_last:
+            return per_rank // self.batch_size
+        return -(-per_rank // self.batch_size)
+
+
+def prefetch_to_device(iterator: Iterable, depth: int = 2,
+                       sharding: Optional[Any] = None) -> Iterator:
+    """Move batches to device ``depth`` steps ahead of consumption on a
+    background thread, so H2D copies overlap compute.
+
+    ``sharding`` (a jax.sharding.Sharding) places each batch directly in
+    its SPMD layout — use the data-parallel spec of the training step.
+    """
+    import jax
+
+    def place(batch):
+        if sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), batch)
+        return jax.tree_util.tree_map(jax.device_put, batch)
+
+    queue: collections.deque = collections.deque()
+    sem = threading.Semaphore(depth)
+    done = object()
+    lock = threading.Lock()
+    cv = threading.Condition(lock)
+    stop = threading.Event()
+
+    def producer():
+        try:
+            for batch in iterator:
+                # Bounded wait so an abandoned consumer (stop set) releases
+                # this thread instead of parking it on the semaphore with
+                # device batches pinned.
+                while not sem.acquire(timeout=0.5):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                placed = place(batch)
+                with cv:
+                    queue.append(placed)
+                    cv.notify()
+            with cv:
+                queue.append(done)
+                cv.notify()
+        except BaseException as exc:  # noqa: BLE001 - re-raised by consumer
+            with cv:
+                queue.append(("__prefetch_error__", exc))
+                cv.notify()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            with cv:
+                cv.wait_for(lambda: queue)
+                item = queue.popleft()
+            if item is done:
+                return
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == "__prefetch_error__":
+                raise item[1]
+            sem.release()
+            yield item
+    finally:
+        stop.set()

@@ -12,3 +12,5 @@ from .bert import (  # noqa: F401
 from .gpt import (  # noqa: F401
     GPT, GPTConfig, GPT_SMALL, GPT_TINY, lm_loss,
 )
+from .vgg import VGG, VGG16, VGG19, VGGTiny  # noqa: F401
+from .inception import InceptionV3  # noqa: F401

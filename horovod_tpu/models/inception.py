@@ -1,0 +1,157 @@
+"""Inception V3, TPU-first flax implementation.
+
+The reference's headline scaling number is Inception V3 at ≈90% efficiency
+on 128 GPUs (BASELINE.md, Horovod paper arXiv:1802.05799); this reproduces
+the model family so the same benchmark runs on TPU.  NHWC, bf16-capable,
+BN with optional cross-replica stats (``bn_axis_name``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         axis_name=self.bn_axis_name)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(64, (1, 1))(x, train)
+        b2 = c(48, (1, 1))(x, train)
+        b2 = c(64, (5, 5))(b2, train)
+        b3 = c(64, (1, 1))(x, train)
+        b3 = c(96, (3, 3))(b3, train)
+        b3 = c(96, (3, 3))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(self.pool_features, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        b2 = c(64, (1, 1))(x, train)
+        b2 = c(96, (3, 3))(b2, train)
+        b2 = c(96, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c, c7 = self.conv, self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b2 = c(c7, (1, 1))(x, train)
+        b2 = c(c7, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b3 = c(c7, (1, 1))(x, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(c7, (1, 7))(b3, train)
+        b3 = c(c7, (7, 1))(b3, train)
+        b3 = c(192, (1, 7))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(192, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(192, (1, 1))(x, train)
+        b1 = c(320, (3, 3), strides=(2, 2), padding="VALID")(b1, train)
+        b2 = c(192, (1, 1))(x, train)
+        b2 = c(192, (1, 7))(b2, train)
+        b2 = c(192, (7, 1))(b2, train)
+        b2 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        c = self.conv
+        b1 = c(320, (1, 1))(x, train)
+        b2 = c(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([c(384, (1, 3))(b2, train),
+                              c(384, (3, 1))(b2, train)], axis=-1)
+        b3 = c(448, (1, 1))(x, train)
+        b3 = c(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([c(384, (1, 3))(b3, train),
+                              c(384, (3, 1))(b3, train)], axis=-1)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = c(192, (1, 1))(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Input [B, 299, 299, 3] (any H/W >= 75 works); logits fp32."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
+        x = jnp.asarray(x, self.dtype)
+        x = conv(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, conv)(x, train)
+        x = InceptionA(64, conv)(x, train)
+        x = InceptionA(64, conv)(x, train)
+        x = InceptionB(conv)(x, train)
+        x = InceptionC(128, conv)(x, train)
+        x = InceptionC(160, conv)(x, train)
+        x = InceptionC(160, conv)(x, train)
+        x = InceptionC(192, conv)(x, train)
+        x = InceptionD(conv)(x, train)
+        x = InceptionE(conv)(x, train)
+        x = InceptionE(conv)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))

@@ -1,0 +1,56 @@
+"""VGG-16/19, TPU-first flax implementation.
+
+The reference's benchmark suite measures VGG-16 alongside ResNet/Inception
+(BASELINE.md: ~68% scaling efficiency — communication-bound because of the
+~138M-parameter classifier) — reproducing the model family lets the same
+comm-bound regime be measured on ICI.  bf16 activations, fp32 params,
+NHWC convs on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence = _VGG16_CFG
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    classifier_width: int = 4096
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = jnp.asarray(x, self.dtype)
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3),
+                                 dtype=self.dtype, padding="SAME")
+        i = 0
+        for c in self.cfg:
+            if c == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(conv(int(c), name=f"conv_{i}")(x))
+                i += 1
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype,
+                             name="fc1")(x))
+        x = nn.Dropout(0.5)(x, deterministic=not train)
+        x = nn.relu(nn.Dense(self.classifier_width, dtype=self.dtype,
+                             name="fc2")(x))
+        x = nn.Dropout(0.5)(x, deterministic=not train)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+
+
+VGG16 = functools.partial(VGG, cfg=_VGG16_CFG)
+VGG19 = functools.partial(VGG, cfg=_VGG19_CFG)
+VGGTiny = functools.partial(
+    VGG, cfg=(8, "M", 16, "M", 32, "M"), classifier_width=64)

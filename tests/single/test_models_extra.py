@@ -1,0 +1,60 @@
+"""VGG / Inception V3 model families + data utilities (the reference's
+remaining benchmark models — BASELINE.md; data idiom from its examples)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import models
+from horovod_tpu.data import ShardedDataset, prefetch_to_device
+
+
+def test_vgg_tiny_forward():
+    m = models.VGGTiny(num_classes=10)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    logits = m.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_vgg16_structure():
+    m = models.VGG16(num_classes=1000, dtype=jnp.bfloat16)
+    assert m.cfg.count("M") == 5 and len([c for c in m.cfg if c != "M"]) == 13
+
+
+def test_inception_v3_forward_small():
+    # 75x75 is the minimum valid input; keeps CPU time sane.
+    m = models.InceptionV3(num_classes=12)
+    x = jnp.ones((1, 75, 75, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    logits = m.apply(variables, x, train=False)
+    assert logits.shape == (1, 12)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_sharded_dataset_partitions_and_reshuffles():
+    x = np.arange(100)
+    y = np.arange(100) * 2
+    d0 = ShardedDataset([x, y], batch_size=8, rank=0, size=2, seed=1)
+    d1 = ShardedDataset([x, y], batch_size=8, rank=1, size=2, seed=1)
+    seen0 = np.concatenate([b[0] for b in d0])
+    seen1 = np.concatenate([b[0] for b in d1])
+    assert len(set(seen0) & set(seen1)) == 0          # disjoint shards
+    assert len(d0) == 6                               # 50//8 batches
+    for bx, by in d0:
+        np.testing.assert_array_equal(by, bx * 2)     # rows stay aligned
+    first_epoch = np.concatenate([b[0] for b in d0])
+    d0.set_epoch(1)
+    second_epoch = np.concatenate([b[0] for b in d0])
+    assert not np.array_equal(first_epoch, second_epoch)  # reshuffled
+
+
+def test_prefetch_to_device_preserves_order():
+    data = [(np.full((2,), i),) for i in range(10)]
+    out = list(prefetch_to_device(iter(data), depth=3))
+    assert len(out) == 10
+    for i, (b,) in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), i)

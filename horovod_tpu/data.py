@@ -40,6 +40,9 @@ class ShardedDataset:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
+        if (rank is None) != (size is None):
+            raise ValueError("provide both rank and size, or neither "
+                             "(neither = read from hvd at iteration time)")
         self._rank = rank
         self._size = size
         self.epoch = 0

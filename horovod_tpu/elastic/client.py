@@ -12,7 +12,7 @@ and the driver pushes ``hosts_updated`` events over the same connection.
 
 from __future__ import annotations
 
-import json
+
 import os
 import socket
 import threading
@@ -21,6 +21,11 @@ from typing import Any, Dict, Optional
 from ..utils.logging import get_logger
 
 log = get_logger()
+
+
+# Wire signing lives with the other launcher security utilities; re-exported
+# here because the worker-side protocol uses it too.
+from ..runner.util import signed_dumps, verified_loads  # noqa: F401,E402
 
 
 class NotificationManager:
@@ -50,6 +55,7 @@ class ElasticCoordinatorClient:
     def __init__(self):
         self._sock: Optional[socket.socket] = None
         self._file = None
+        self._secret: Optional[str] = None
         self._lock = threading.Lock()
         self._assign_cv = threading.Condition(self._lock)
         self._assignment: Optional[Dict[str, Any]] = None
@@ -65,6 +71,7 @@ class ElasticCoordinatorClient:
         addr = os.environ["HOROVOD_ELASTIC_COORD_ADDR"]
         port = int(os.environ["HOROVOD_ELASTIC_COORD_PORT"])
         worker_id = os.environ.get("HOROVOD_ELASTIC_WORKER_ID", "")
+        self._secret = os.environ.get("HOROVOD_ELASTIC_SECRET") or None
         self._sock = socket.create_connection((addr, port), timeout=60)
         self._sock.settimeout(None)
         self._file = self._sock.makefile("rw", encoding="utf-8")
@@ -84,13 +91,16 @@ class ElasticCoordinatorClient:
             pass
 
     def _send(self, obj: Dict[str, Any]) -> None:
-        self._file.write(json.dumps(obj) + "\n")
+        self._file.write(signed_dumps(obj, self._secret) + "\n")
         self._file.flush()
 
     def _read_loop(self) -> None:
         try:
             for line in self._file:
-                msg = json.loads(line)
+                msg = verified_loads(line, self._secret)
+                if msg is None:
+                    log.warning("elastic: dropping unverified message")
+                    continue
                 t = msg.get("type")
                 if t == "assign":
                     with self._assign_cv:

@@ -30,7 +30,8 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-from .util import find_free_port, local_hostnames
+from .util import (find_free_port, local_hostnames, make_secret,
+                   signed_dumps, verified_loads)
 
 BLACKLIST_FAILURES = 2          # consecutive fast failures before blacklisting
 DISCOVERY_INTERVAL_S = 1.0
@@ -87,7 +88,8 @@ class FixedHosts(HostDiscovery):
 
 class _Worker:
     def __init__(self, host: str, slot: int, worker_id: str,
-                 proc: subprocess.Popen, spawn_gen: int):
+                 proc: subprocess.Popen, spawn_gen: int, secret: str):
+        self.secret = secret
         self.host = host
         self.slot = slot
         self.worker_id = worker_id
@@ -105,7 +107,7 @@ class _Worker:
         if self.wfile is None:
             return False
         try:
-            self.wfile.write(json.dumps(obj) + "\n")
+            self.wfile.write(signed_dumps(obj, self.secret) + "\n")
             self.wfile.flush()
             return True
         except OSError:
@@ -132,6 +134,10 @@ class ElasticDriver:
         self._blacklist: set = set()
         self._failures: Dict[str, List[float]] = {}  # host -> failure times
         self._generation = -1
+        # Shared secret signing every coordinator RPC (reference:
+        # common/util/secret.py): a stray/malicious connection cannot
+        # register as a worker or push host updates.
+        self._secret = make_secret()
         self._reset_required = threading.Event()
         self._stop = threading.Event()
         self._exit_code: Optional[int] = None
@@ -148,7 +154,9 @@ class ElasticDriver:
                 worker: Optional[_Worker] = None
                 try:
                     for raw in self.rfile:
-                        msg = json.loads(raw.decode())
+                        msg = verified_loads(raw.decode(), driver._secret)
+                        if msg is None:
+                            return  # unauthenticated peer: drop connection
                         t = msg.get("type")
                         if t == "register":
                             worker = driver._on_register(
@@ -196,6 +204,7 @@ class ElasticDriver:
             "HOROVOD_ELASTIC_WORKER_ID": wid,
             "HOROVOD_ELASTIC_COORD_ADDR": self._coord_addr(host),
             "HOROVOD_ELASTIC_COORD_PORT": str(self._coord_port),
+            "HOROVOD_ELASTIC_SECRET": self._secret,
             "HOROVOD_HOSTNAME": host,
         })
         if host in local_hostnames():
@@ -203,16 +212,27 @@ class ElasticDriver:
                 self.command, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True)
         else:
+            # The HMAC secret must NOT ride the ssh argv (visible in `ps`
+            # on both ends); ship it over ssh stdin instead.
             env_str = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in env.items()
-                if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "JAX_",
-                                 "XLA_")))
-            remote = f"cd {shlex.quote(os.getcwd())} && env {env_str} " + \
-                " ".join(shlex.quote(c) for c in self.command)
+                if k != "HOROVOD_ELASTIC_SECRET"
+                and k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "JAX_",
+                                  "XLA_")))
+            remote = ("read -r HOROVOD_ELASTIC_SECRET; "
+                      "export HOROVOD_ELASTIC_SECRET; "
+                      f"cd {shlex.quote(os.getcwd())} && env {env_str} " +
+                      " ".join(shlex.quote(c) for c in self.command))
             proc = subprocess.Popen(
                 ["ssh", "-o", "StrictHostKeyChecking=no", host, remote],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        w = _Worker(host, slot, wid, proc, gen)
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            try:
+                proc.stdin.write(self._secret + "\n")
+                proc.stdin.flush()
+            except OSError:
+                pass
+        w = _Worker(host, slot, wid, proc, gen, self._secret)
         # Table insert must precede the monitor/stream threads and any
         # chance of the worker registering, so _on_register finds it.
         with self._lock:

@@ -67,3 +67,63 @@ def find_free_port(addr: str = "127.0.0.1") -> int:
 
 def local_hostnames() -> List[str]:
     return ["localhost", "127.0.0.1", socket.gethostname()]
+
+
+def host_hash() -> str:
+    """Stable per-host identifier (reference: util/host_hash.py) — used to
+    group ranks by physical host."""
+    import hashlib
+
+    return hashlib.md5(socket.gethostname().encode()).hexdigest()[:16]
+
+
+def make_secret() -> str:
+    """Random shared secret for signing coordinator RPCs (reference:
+    common/util/secret.py)."""
+    import secrets
+
+    return secrets.token_hex(16)
+
+
+def sign_message(secret: str, payload: str) -> str:
+    """HMAC-SHA256 signature over a wire payload."""
+    import hashlib
+    import hmac
+
+    return hmac.new(secret.encode(), payload.encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def verify_message(secret: str, payload: str, signature: str) -> bool:
+    import hmac
+
+    return hmac.compare_digest(sign_message(secret, payload), signature)
+
+
+def signed_dumps(obj, secret) -> str:
+    """Serialize a coordinator message, HMAC-signing it when a shared
+    secret is configured (reference: runner/common/util/secret.py — the
+    driver/worker RPCs are signed so a stray connection can't join or
+    reshape the job)."""
+    import json
+
+    payload = json.dumps(obj, sort_keys=True, separators=(",", ":"))
+    if not secret:
+        return payload
+    return json.dumps({"p": payload, "sig": sign_message(secret, payload)},
+                      separators=(",", ":"))
+
+
+def verified_loads(line: str, secret):
+    """Parse (and verify, when a secret is configured) a wire message;
+    returns None for unverifiable messages."""
+    import json
+
+    msg = json.loads(line)
+    if not secret:
+        return msg
+    if not (isinstance(msg, dict) and "p" in msg and "sig" in msg):
+        return None
+    if not verify_message(secret, msg["p"], msg["sig"]):
+        return None
+    return json.loads(msg["p"])

@@ -1,0 +1,77 @@
+"""Launcher unit tests (reference shape: test/single/test_run.py — arg
+parsing, host-slot math, rank assignment, secret HMAC)."""
+
+import pytest
+
+from horovod_tpu.runner.launch import parse_args, _tuning_env
+from horovod_tpu.runner.util import (
+    parse_hosts, assign_ranks, host_hash, make_secret, sign_message,
+    verify_message,
+)
+
+
+def test_parse_hosts():
+    hs = parse_hosts("a:2, b:4,c")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 2), ("b", 4),
+                                                   ("c", 1)]
+
+
+def test_assign_ranks_block_layout():
+    hs = parse_hosts("a:2,b:2")
+    a = assign_ranks(hs, 3)
+    assert [x["rank"] for x in a] == [0, 1, 2]
+    assert [x["hostname"] for x in a] == ["a", "a", "b"]
+    assert [x["local_rank"] for x in a] == [0, 1, 0]
+    assert a[0]["local_size"] == 2 and a[2]["local_size"] == 1
+    assert a[0]["cross_rank"] == 0 and a[2]["cross_rank"] == 1
+    assert a[0]["cross_size"] == 2
+
+
+def test_assign_ranks_overflow():
+    with pytest.raises(ValueError):
+        assign_ranks(parse_hosts("a:1"), 2)
+
+
+def test_parse_args_and_tuning_env():
+    args = parse_args([
+        "-np", "4", "-H", "x:4", "--fusion-threshold-mb", "32",
+        "--cycle-time-ms", "2.5", "--cache-capacity", "512", "--autotune",
+        "--timeline-filename", "/tmp/tl.json", "--timeline-mark-cycles",
+        "--stall-check-warning-time-seconds", "30",
+        "--log-level", "debug", "python", "train.py"])
+    env = _tuning_env(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_CACHE_CAPACITY"] == "512"
+    assert env["HOROVOD_AUTOTUNE"] == "1"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HOROVOD_STALL_CHECK_TIME_SECONDS"] == "30.0"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert args.command == ["python", "train.py"]
+
+
+def test_secret_hmac_roundtrip():
+    s = make_secret()
+    assert len(s) == 32
+    sig = sign_message(s, "payload")
+    assert verify_message(s, "payload", sig)
+    assert not verify_message(s, "payload2", sig)
+    assert not verify_message(make_secret(), "payload", sig)
+
+
+def test_signed_wire_messages():
+    from horovod_tpu.elastic.client import signed_dumps, verified_loads
+
+    s = make_secret()
+    line = signed_dumps({"type": "ready", "n": 1}, s)
+    assert verified_loads(line, s) == {"type": "ready", "n": 1}
+    assert verified_loads(line, make_secret()) is None   # wrong key
+    assert verified_loads('{"type":"ready"}', s) is None  # unsigned
+    # no secret configured -> plain JSON passes through
+    assert verified_loads('{"type":"ready"}', None) == {"type": "ready"}
+
+
+def test_host_hash_stable():
+    assert host_hash() == host_hash()
+    assert len(host_hash()) == 16

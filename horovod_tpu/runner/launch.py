@@ -39,6 +39,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="accepted for reference parity; unused")
     p.add_argument("--start-timeout", type=int, default=60)
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("--jax-distributed", action="store_true",
+                   help="initialize jax.distributed in every worker so all "
+                        "hosts' devices form one global mesh (multi-host "
+                        "SPMD over DCN; TPU pods)")
     p.add_argument("--disable-cache", action="store_true",
                    help="disable the response cache")
     # Elastic flags (reference parity; driver in horovod_tpu.runner.elastic).
@@ -235,6 +239,12 @@ def _run(args: argparse.Namespace) -> int:
 
     base_env = dict(os.environ)
     base_env.update(_tuning_env(args))
+    if args.jax_distributed:
+        coord_port = find_free_port(
+            "0.0.0.0" if rendezvous_addr != "127.0.0.1" else "127.0.0.1")
+        base_env["HOROVOD_JAX_DISTRIBUTED"] = "1"
+        base_env["HOROVOD_JAX_COORDINATOR"] = \
+            f"{rendezvous_addr}:{coord_port}"
 
     workers = WorkerProcesses()
     workers.launch(assignments, command, base_env, rendezvous_addr,

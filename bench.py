@@ -8,19 +8,95 @@ synthetic ImageNet-shaped data (the reference benchmarks use synthetic data
 too), with the gradient allreduce riding the framework's XLA data plane
 over a mesh axis — the code path multi-chip runs use.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is images/sec vs the reference's published per-device number.
+Robustness: TPU backend initialization over the sandbox tunnel is flaky, so
+the measurement runs in a child subprocess (fresh backend init per attempt)
+with retry + backoff; the parent always prints exactly ONE JSON line —
+{"metric", "value", "unit", "vs_baseline", ...} on success (plus "mfu" from
+XLA's compiled-step flop count and a flash-attention-vs-dense timing), or a
+value-0 line with an "error" field after all attempts fail.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 235.0  # Horovod paper, ResNet-50 on P100
+_CHILD_FLAG = "_HVD_TPU_BENCH_CHILD"
+_ATTEMPTS = 3
+_ATTEMPT_TIMEOUT_S = 1500
+_BACKOFFS_S = (10, 30)
+
+# Published per-chip peak bf16 matmul throughput, by device_kind prefix.
+_PEAK_BF16_FLOPS = (
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12),
+    ("TPU v5e", 197e12),
+    ("TPU v5", 459e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+    ("TPU v2", 45e12),
+)
 
 
-def main() -> None:
+def _chip_peak_flops(device_kind: str) -> float:
+    for prefix, peak in _PEAK_BF16_FLOPS:
+        if device_kind.startswith(prefix):
+            return peak
+    return 197e12  # conservative default: v5e-class
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _flash_attention_entry() -> dict:
+    """Single-chip flash-vs-dense attention timing + correctness (VERDICT #8:
+    the Pallas kernel must execute on real TPU hardware with a recorded
+    speedup)."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops.flash_attention import dense_attention, flash_attention
+
+    b, s, h, d = 4, 2048, 8, 128
+    rng = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
+
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+
+    out_f = jax.block_until_ready(flash(q, k, v))
+    out_d = jax.block_until_ready(dense(q, k, v))
+    err = float(jnp.max(jnp.abs(out_f.astype(jnp.float32)
+                                - out_d.astype(jnp.float32))))
+
+    def timeit(fn, iters=20):
+        jax.block_until_ready(fn(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    flash_ms = timeit(flash)
+    dense_ms = timeit(dense)
+    return {
+        "flash_attn_ms": round(flash_ms, 3),
+        "dense_attn_ms": round(dense_ms, 3),
+        "flash_attn_speedup_vs_dense": round(dense_ms / flash_ms, 3),
+        "flash_attn_max_abs_err": round(err, 4),
+    }
+
+
+def _measure() -> None:
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -31,8 +107,11 @@ def main() -> None:
     import horovod_tpu as hvd
     from horovod_tpu import models
 
-    n_dev = len(jax.devices())
-    mesh = Mesh(np.asarray(jax.devices()), ("hvd",))
+    devices = jax.devices()
+    n_dev = len(devices)
+    _log(f"backend={jax.default_backend()} devices={n_dev} "
+         f"kind={devices[0].device_kind}")
+    mesh = Mesh(np.asarray(devices), ("hvd",))
 
     batch_per_chip = 64
     batch = batch_per_chip * n_dev
@@ -47,6 +126,7 @@ def main() -> None:
 
     variables = jax.jit(lambda: model.init(rng, images[:8], train=False))()
     params, batch_stats = variables["params"], variables["batch_stats"]
+    _log("model initialized")
 
     tx = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
                                   axis_name="hvd")
@@ -72,11 +152,23 @@ def main() -> None:
                   out_specs=(P(), P(), P(), P())),
         donate_argnums=(0, 1, 2))
 
-    # Warmup (compile + first steps).
+    # Per-step flop count from XLA itself — the honest numerator for MFU.
+    flops_per_step = None
+    try:
+        cost = step.lower(params, batch_stats, opt_state, images,
+                          labels).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops_per_step = float(cost["flops"])
+    except Exception as exc:
+        _log(f"cost_analysis unavailable: {exc}")
+
+    _log("compiling + warmup")
     for _ in range(3):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
     jax.block_until_ready(loss)
+    _log("warmup done; measuring")
 
     n_steps = 20
     t0 = time.perf_counter()
@@ -88,13 +180,79 @@ def main() -> None:
 
     img_per_sec = batch * n_steps / dt
     img_per_sec_per_chip = img_per_sec / n_dev
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec_per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(
             img_per_sec_per_chip / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
-    }))
+        "step_ms": round(dt / n_steps * 1e3, 2),
+        "device_kind": devices[0].device_kind,
+        "n_devices": n_dev,
+    }
+    if flops_per_step is not None:
+        peak = _chip_peak_flops(devices[0].device_kind)
+        mfu = flops_per_step / (dt / n_steps) / (n_dev * peak)
+        result["mfu"] = round(mfu, 4)
+        result["tflops_per_sec_per_chip"] = round(
+            flops_per_step / (dt / n_steps) / n_dev / 1e12, 2)
+
+    try:
+        _log("flash attention micro-bench")
+        result.update(_flash_attention_entry())
+    except Exception as exc:  # never let the extra entry kill the headline
+        result["flash_attn_error"] = str(exc)[:200]
+
+    print(json.dumps(result), flush=True)
+
+
+def main() -> None:
+    if os.environ.get(_CHILD_FLAG) == "1":
+        _measure()
+        return
+
+    last_err = ""
+    for attempt in range(_ATTEMPTS):
+        if attempt:
+            backoff = _BACKOFFS_S[min(attempt - 1, len(_BACKOFFS_S) - 1)]
+            _log(f"retrying in {backoff}s (attempt {attempt + 1}/{_ATTEMPTS})")
+            time.sleep(backoff)
+        env = dict(os.environ)
+        env[_CHILD_FLAG] = "1"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True,
+                timeout=_ATTEMPT_TIMEOUT_S)
+        except subprocess.TimeoutExpired as exc:
+            last_err = f"attempt timed out after {_ATTEMPT_TIMEOUT_S}s"
+            _log(last_err + "; stderr tail: "
+                 + (exc.stderr or "")[-500:].__str__())
+            continue
+        sys.stderr.write(proc.stderr or "")
+        lines = [ln for ln in (proc.stdout or "").strip().splitlines() if ln]
+        if proc.returncode == 0 and lines:
+            try:
+                json.loads(lines[-1])
+            except ValueError:
+                last_err = f"child stdout not JSON: {lines[-1][:200]}"
+                continue
+            print(lines[-1], flush=True)
+            return
+        tail = ((proc.stderr or "") + (proc.stdout or ""))[-600:]
+        last_err = f"child rc={proc.returncode}: {tail}"
+        _log(f"attempt {attempt + 1} failed: {last_err[:300]}")
+
+    # All attempts failed: still emit one parseable JSON line (VERDICT #1b —
+    # a transient TPU-init failure must not erase the round's evidence).
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": last_err[-800:],
+    }), flush=True)
+    sys.exit(1)
 
 
 if __name__ == "__main__":

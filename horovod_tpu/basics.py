@@ -51,6 +51,14 @@ def init(comm=None, process_sets: Optional[Sequence] = None,
     if get_bool("HOROVOD_JAX_DISTRIBUTED", False):  # pragma: no cover - pod only
         import jax
 
+        # Cross-process collectives on the CPU platform (the no-TPU test
+        # harness, SURVEY.md §4) need the gloo transport; TPU pods use ICI
+        # and must keep the default.
+        if "cpu" in str(getattr(jax.config, "jax_platforms", "") or ""):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
         jax.distributed.initialize(
             coordinator_address=os.environ.get("HOROVOD_JAX_COORDINATOR"),
             num_processes=cfg.size,
@@ -60,8 +68,14 @@ def init(comm=None, process_sets: Optional[Sequence] = None,
     if build_mesh:
         try:
             _mesh.build_global_mesh()
-        except Exception as exc:  # jax may be unusable in exotic setups
-            log.debug("global mesh not built: %s", exc)
+        except Exception as exc:
+            # Under a multi-host runtime the mesh IS the data plane; hiding a
+            # build failure would desync the pod silently, so fail hard.
+            if get_bool("HOROVOD_JAX_DISTRIBUTED", False):
+                raise RuntimeError(
+                    f"global mesh build failed under jax.distributed: {exc}"
+                ) from exc
+            log.warning("global mesh not built: %s", exc)
 
     if process_sets:
         from .process_sets import add_process_set

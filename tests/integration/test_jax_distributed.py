@@ -54,6 +54,10 @@ def test_multihost_mesh_np2():
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
+        # conftest forces 8 virtual devices per process for single-process
+        # tests; here each worker must own exactly one device so the global
+        # mesh is 2 processes x 1 device.
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         proc = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",

@@ -191,11 +191,13 @@ def _measure() -> None:
         "n_devices": n_dev,
     }
     if flops_per_step is not None:
+        # cost_analysis() reports the per-partition SPMD module, i.e.
+        # per-device flops already — don't divide by n_dev again.
         peak = _chip_peak_flops(devices[0].device_kind)
-        mfu = flops_per_step / (dt / n_steps) / (n_dev * peak)
+        mfu = flops_per_step / (dt / n_steps) / peak
         result["mfu"] = round(mfu, 4)
         result["tflops_per_sec_per_chip"] = round(
-            flops_per_step / (dt / n_steps) / n_dev / 1e12, 2)
+            flops_per_step / (dt / n_steps) / 1e12, 2)
 
     try:
         _log("flash attention micro-bench")
@@ -219,17 +221,27 @@ def main() -> None:
             time.sleep(backoff)
         env = dict(os.environ)
         env[_CHILD_FLAG] = "1"
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True,
-                timeout=_ATTEMPT_TIMEOUT_S)
-        except subprocess.TimeoutExpired as exc:
-            last_err = f"attempt timed out after {_ATTEMPT_TIMEOUT_S}s"
-            _log(last_err + "; stderr tail: "
-                 + (exc.stderr or "")[-500:].__str__())
-            continue
-        sys.stderr.write(proc.stderr or "")
+        # Child stderr goes to a file, not a pipe: on POSIX TimeoutExpired
+        # carries no captured output, and the progress log is exactly what
+        # localizes a hang.
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w+", suffix=".benchlog") as errf:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, stdout=subprocess.PIPE, stderr=errf, text=True,
+                    timeout=_ATTEMPT_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                errf.seek(0)
+                tail = errf.read()[-500:]
+                last_err = (f"attempt timed out after {_ATTEMPT_TIMEOUT_S}s; "
+                            f"child log tail: {tail}")
+                _log(last_err)
+                continue
+            errf.seek(0)
+            child_err = errf.read()
+        sys.stderr.write(child_err)
         lines = [ln for ln in (proc.stdout or "").strip().splitlines() if ln]
         if proc.returncode == 0 and lines:
             try:
@@ -239,7 +251,7 @@ def main() -> None:
                 continue
             print(lines[-1], flush=True)
             return
-        tail = ((proc.stderr or "") + (proc.stdout or ""))[-600:]
+        tail = (child_err + (proc.stdout or ""))[-600:]
         last_err = f"child rc={proc.returncode}: {tail}"
         _log(f"attempt {attempt + 1} failed: {last_err[:300]}")
 

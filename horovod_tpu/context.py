@@ -40,6 +40,22 @@ _INT_TYPES = (
 )
 
 
+def _scale(arr: np.ndarray, factor: float) -> np.ndarray:
+    """Scale a buffer by a scalar without extra copies.
+
+    Integer tensors are rejected at enqueue (reference parity), so normally
+    only float dtypes reach here.  f32/f64 scale in place; 16-bit floats
+    widen to f32 for the multiply (the reference's CPU scale path also
+    computes in higher precision); anything else defensively goes through
+    f64 (exact for int64 magnitudes up to 2**53)."""
+    if arr.dtype in (np.float32, np.float64):
+        np.multiply(arr, arr.dtype.type(factor), out=arr)
+        return arr
+    if arr.itemsize == 2:
+        return (arr.astype(np.float32) * np.float32(factor)).astype(arr.dtype)
+    return (arr.astype(np.float64) * factor).astype(arr.dtype)
+
+
 def _select_backend(cfg: Config) -> CoreBackend:
     """Pick the native C++ core when available, pure-Python otherwise.
 
@@ -146,7 +162,8 @@ class HorovodContext:
         if name is None:
             name = f"{op.name.lower()}.noname.{next(self._noname_counter)}"
         if dtype in _INT_TYPES:
-            if reduce_op == ReduceOp.AVERAGE and op == OpType.ALLREDUCE:
+            if reduce_op == ReduceOp.AVERAGE and op in (
+                    OpType.ALLREDUCE, OpType.REDUCESCATTER):
                 raise ValueError(
                     "hvd.Average is not supported for integer tensors; use hvd.Sum"
                 )
@@ -264,7 +281,7 @@ class HorovodContext:
             fused = np.concatenate([e.array.ravel() for e in entries])
         pre = entries[0].prescale_factor
         if pre != 1.0:
-            fused = (fused.astype(np.float64) * pre).astype(dtype)
+            fused = _scale(fused, pre)
         if reduce_op == ReduceOp.ADASUM and self._ps_size(psid) > 1:
             # Host-path Adasum: allgather every rank's fused buffer, then a
             # deterministic local pairwise-tree combine — every rank computes
@@ -292,10 +309,10 @@ class HorovodContext:
             if reduce_op == ReduceOp.AVERAGE:
                 n = self._ps_size(psid)
                 if n > 1:
-                    fused = (fused.astype(np.float64) / n).astype(dtype)
+                    fused = _scale(fused, 1.0 / n)
         post = entries[0].postscale_factor
         if post != 1.0:
-            fused = (fused.astype(np.float64) * post).astype(dtype)
+            fused = _scale(fused, post)
         # MemcpyOutFusionBuffer analog.
         offset = 0
         for e in entries:
@@ -347,13 +364,13 @@ class HorovodContext:
         fused = e.array.ravel().copy()
         pre = e.prescale_factor
         if pre != 1.0:
-            fused = (fused.astype(np.float64) * pre).astype(dtype)
+            fused = _scale(fused, pre)
         wire_op = ReduceOp.SUM if e.reduce_op == ReduceOp.AVERAGE else e.reduce_op
         fused = self.core.allreduce_buffer(fused, psid, wire_op)
         if e.reduce_op == ReduceOp.AVERAGE:
-            fused = (fused.astype(np.float64) / max(n, 1)).astype(dtype)
+            fused = _scale(fused, 1.0 / max(n, 1))
         if e.postscale_factor != 1.0:
-            fused = (fused.astype(np.float64) * e.postscale_factor).astype(dtype)
+            fused = _scale(fused, e.postscale_factor)
         full = fused.reshape(e.array.shape)
         d0 = e.array.shape[0]
         ranks = self.core.process_set_ranks(psid)

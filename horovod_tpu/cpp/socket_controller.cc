@@ -14,23 +14,16 @@ namespace {
 
 constexpr double kConnectTimeoutS = 60.0;
 
-}  // namespace
+// Frame tags: catch mesh desync (a rank consuming a frame meant for another
+// op/step) immediately instead of corrupting buffers.
+constexpr int32_t kTagReduceScatter = 0x1000;
+constexpr int32_t kTagAllgatherPhase = 0x2000;
+constexpr int32_t kTagAllgather = 0x4000;
+constexpr int32_t kTagBroadcast = 0x5000;
+constexpr int32_t kTagAlltoall = 0x6000;
+constexpr int32_t kTagBarrier = 0x7000;
 
-// Serialization of the data-plane frame header.
-static void WriteDataHeader(Writer* w, int rank, int64_t seq, OpType op,
-                            DataType dtype, ReduceOp rop, int psid, int root,
-                            int64_t row_bytes,
-                            const std::vector<int64_t>& splits) {
-  w->PutI32(rank);
-  w->PutI64(seq);
-  w->PutI32(static_cast<int32_t>(op));
-  w->PutI32(static_cast<int32_t>(dtype));
-  w->PutI32(static_cast<int32_t>(rop));
-  w->PutI32(psid);
-  w->PutI32(root);
-  w->PutI64(row_bytes);
-  w->PutI64Vec(splits);
-}
+}  // namespace
 
 SocketController::SocketController(const CoreConfig& cfg)
     : Controller(cfg), cache_(cfg.cache_capacity) {}
@@ -39,6 +32,17 @@ SocketController::~SocketController() { Shutdown(); }
 
 Status SocketController::Initialize() {
   process_sets_.InitGlobal(cfg_.size);
+  // Every rank owns a mesh listener on an ephemeral port; the coordinator
+  // brokers the address book (the Gloo rendezvous-store analog).
+  if (!data_listener_.Listen("0.0.0.0", 0)) {
+    return Status::Error(StatusCode::PRECONDITION_ERROR,
+                         "failed to open mesh data listener");
+  }
+  peer_socks_.resize(cfg_.size);
+  std::vector<std::string> addrs(cfg_.size);
+  std::vector<int> ports(cfg_.size, 0);
+  ports[cfg_.rank] = data_listener_.port();
+
   if (is_coordinator()) {
     if (!listener_.Listen("0.0.0.0", cfg_.rendezvous_port)) {
       return Status::Error(StatusCode::PRECONDITION_ERROR,
@@ -46,8 +50,7 @@ Status SocketController::Initialize() {
                                std::to_string(cfg_.rendezvous_port));
     }
     ctrl_socks_.resize(cfg_.size);
-    data_socks_.resize(cfg_.size);
-    int needed = 2 * (cfg_.size - 1);
+    int needed = cfg_.size - 1;
     double deadline = MonotonicSeconds() + kConnectTimeoutS;
     while (needed > 0) {
       if (MonotonicSeconds() > deadline) {
@@ -60,42 +63,107 @@ Status SocketController::Initialize() {
       if (!s.RecvFrame(&hello)) continue;
       Reader r(hello);
       int rank = r.GetI32();
-      int channel = r.GetI32();
-      if (rank <= 0 || rank >= cfg_.size || (channel != 0 && channel != 1)) {
+      int data_port = r.GetI32();
+      if (rank <= 0 || rank >= cfg_.size || ctrl_socks_[rank].valid()) {
         return Status::Error(StatusCode::INVALID_ARGUMENT,
                              "bad HELLO from worker");
       }
-      if (channel == 0) {
-        ctrl_socks_[rank] = std::move(s);
-      } else {
-        data_socks_[rank] = std::move(s);
-      }
+      addrs[rank] = s.PeerAddr();
+      ports[rank] = data_port;
+      ctrl_socks_[rank] = std::move(s);
       --needed;
     }
-    data_shutdown_ = false;
-    data_thread_ = std::thread([this] { DataServiceLoop(); });
+    // Broadcast the address book over the ctrl channel.
+    Writer book;
+    for (int rank = 0; rank < cfg_.size; ++rank) {
+      book.PutString(addrs[rank]);
+      book.PutI32(ports[rank]);
+    }
+    for (int rank = 1; rank < cfg_.size; ++rank) {
+      if (!ctrl_socks_[rank].SendFrame(book.data())) {
+        return Status::Error(StatusCode::PRECONDITION_ERROR,
+                             "failed to send address book to rank " +
+                                 std::to_string(rank));
+      }
+    }
   } else {
     if (!coord_ctrl_.Connect(cfg_.rendezvous_addr, cfg_.rendezvous_port,
-                             kConnectTimeoutS) ||
-        !coord_data_.Connect(cfg_.rendezvous_addr, cfg_.rendezvous_port,
                              kConnectTimeoutS)) {
       return Status::Error(StatusCode::PRECONDITION_ERROR,
                            "worker failed to reach coordinator at " +
                                cfg_.rendezvous_addr + ":" +
                                std::to_string(cfg_.rendezvous_port));
     }
-    Writer hello_ctrl;
-    hello_ctrl.PutI32(cfg_.rank);
-    hello_ctrl.PutI32(0);
-    Writer hello_data;
-    hello_data.PutI32(cfg_.rank);
-    hello_data.PutI32(1);
-    if (!coord_ctrl_.SendFrame(hello_ctrl.data()) ||
-        !coord_data_.SendFrame(hello_data.data())) {
+    Writer hello;
+    hello.PutI32(cfg_.rank);
+    hello.PutI32(data_listener_.port());
+    if (!coord_ctrl_.SendFrame(hello.data())) {
       return Status::Error(StatusCode::PRECONDITION_ERROR, "HELLO failed");
     }
+    std::string book;
+    if (!coord_ctrl_.RecvFrame(&book)) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "failed to receive mesh address book");
+    }
+    Reader r(book);
+    for (int rank = 0; rank < cfg_.size; ++rank) {
+      addrs[rank] = r.GetString();
+      ports[rank] = r.GetI32();
+    }
+    // Workers reach rank 0 by the address they rendezvoused through.
+    addrs[0] = cfg_.rendezvous_addr;
   }
+
+  Status s = ConnectMesh(addrs, ports);
+  if (!s.ok()) return s;
   initialized_ = true;
+  return Status::OK();
+}
+
+Status SocketController::ConnectMesh(const std::vector<std::string>& addrs,
+                                     const std::vector<int>& ports) {
+  // Deterministic pairing: every rank dials all lower ranks, then accepts
+  // one connection from each higher rank (their dials queue in the
+  // listener backlog meanwhile, so the two phases cannot deadlock).
+  for (int rank = 0; rank < cfg_.rank; ++rank) {
+    Socket s;
+    if (!s.Connect(addrs[rank], ports[rank], kConnectTimeoutS)) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "mesh connect to rank " + std::to_string(rank) +
+                               " at " + addrs[rank] + ":" +
+                               std::to_string(ports[rank]) + " failed");
+    }
+    Writer hello;
+    hello.PutI32(cfg_.rank);
+    if (!s.SendFrame(hello.data())) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "mesh HELLO to rank " + std::to_string(rank) +
+                               " failed");
+    }
+    peer_socks_[rank] = std::move(s);
+  }
+  int needed = cfg_.size - cfg_.rank - 1;
+  double deadline = MonotonicSeconds() + kConnectTimeoutS;
+  while (needed > 0) {
+    if (MonotonicSeconds() > deadline) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "mesh accept timeout on rank " +
+                               std::to_string(cfg_.rank));
+    }
+    Socket s = data_listener_.Accept(1.0);
+    if (!s.valid()) continue;
+    std::string hello;
+    if (!s.RecvFrame(&hello)) continue;
+    Reader r(hello);
+    int rank = r.GetI32();
+    if (rank <= cfg_.rank || rank >= cfg_.size || peer_socks_[rank].valid()) {
+      return Status::Error(StatusCode::INVALID_ARGUMENT,
+                           "bad mesh HELLO (claimed rank " +
+                               std::to_string(rank) + ")");
+    }
+    peer_socks_[rank] = std::move(s);
+    --needed;
+  }
   return Status::OK();
 }
 
@@ -103,17 +171,11 @@ void SocketController::Shutdown() {
   if (!initialized_) return;
   initialized_ = false;
   aborted_ = true;
-  {
-    std::lock_guard<std::mutex> l(data_mu_);
-    data_shutdown_ = true;
-    data_cv_.notify_all();
-  }
   coord_ctrl_.Close();
-  coord_data_.Close();
   for (auto& s : ctrl_socks_) s.Close();
-  for (auto& s : data_socks_) s.Close();
+  for (auto& s : peer_socks_) s.Close();
   listener_.Close();
-  if (data_thread_.joinable()) data_thread_.join();
+  data_listener_.Close();
 }
 
 // ---------------------------------------------------------------------------
@@ -349,312 +411,232 @@ std::string SocketController::StallReport(double older_than_s) {
 }
 
 // ---------------------------------------------------------------------------
-// Data plane
+// Data plane: full-mesh ring/tree/pairwise algorithms on the caller thread
 // ---------------------------------------------------------------------------
 
-Status SocketController::MemberDataOp(const DataOpHeader& h,
-                                      const std::string& payload,
-                                      std::string* reply) {
-  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
-  if (is_coordinator()) {
-    {
-      std::lock_guard<std::mutex> l(data_mu_);
-      local_contrib_.emplace_back(h, payload);
-      data_cv_.notify_all();
-    }
-    std::unique_lock<std::mutex> l(data_mu_);
-    data_cv_.wait(l, [&] {
-      return data_shutdown_ || local_reply_.count(h.seq) > 0;
-    });
-    if (data_shutdown_ && !local_reply_.count(h.seq)) {
-      return Status::Error(StatusCode::ABORTED, "shutdown during data op");
-    }
-    *reply = std::move(local_reply_[h.seq]);
-    local_reply_.erase(h.seq);
-    return Status::OK();
+Status SocketController::Members(int psid, std::vector<int>* members,
+                                 int* my_idx) const {
+  if (!process_sets_.Ranks(psid, members)) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "unknown process set " + std::to_string(psid));
   }
-  Writer w;
-  WriteDataHeader(&w, cfg_.rank, h.seq, h.op, h.dtype, h.reduce_op,
-                  h.process_set_id, h.root_rank, h.row_bytes, h.splits);
-  w.PutString(payload);
-  if (!coord_data_.SendFrame(w.data())) {
-    aborted_ = true;
-    return Status::Error(StatusCode::ABORTED, "data plane send failed");
+  auto it = std::find(members->begin(), members->end(), cfg_.rank);
+  if (it == members->end()) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "rank " + std::to_string(cfg_.rank) +
+                             " not in process set " + std::to_string(psid));
   }
-  if (!coord_data_.RecvFrame(reply)) {
+  *my_idx = static_cast<int>(it - members->begin());
+  return Status::OK();
+}
+
+void SocketController::PutFrameHeader(Writer* w, int64_t seq, int32_t tag) {
+  w->PutI64(seq);
+  w->PutI32(tag);
+}
+
+Status SocketController::CheckFrameHeader(Reader* rd, int32_t tag,
+                                          const char* what) {
+  int64_t seq = rd->GetI64();
+  int32_t got = rd->GetI32();
+  if (!rd->ok() || seq != current_seq_ || got != tag) {
     aborted_ = true;
-    return Status::Error(StatusCode::ABORTED, "data plane recv failed");
+    return Status::Error(StatusCode::ABORTED,
+                         std::string("data plane desync in ") + what +
+                             ": expected seq " +
+                             std::to_string(current_seq_) + " tag " +
+                             std::to_string(tag) + ", got seq " +
+                             std::to_string(seq) + " tag " +
+                             std::to_string(got));
   }
   return Status::OK();
 }
 
-void SocketController::DataServiceLoop() {
-  std::vector<pollfd> pfds;
-  std::vector<int> pfd_ranks;
-  for (int rank = 1; rank < cfg_.size; ++rank) {
-    pfds.push_back(pollfd{data_socks_[rank].fd(), POLLIN, 0});
-    pfd_ranks.push_back(rank);
+Status SocketController::ExchangeStep(int send_to, const std::string& frame,
+                                      int recv_from, std::string* in) {
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  if (!DuplexExchange(peer_socks_[send_to], frame, peer_socks_[recv_from], in,
+                      [this] { return aborted_.load(); })) {
+    aborted_ = true;
+    return Status::Error(StatusCode::ABORTED,
+                         "data plane exchange failed (send->" +
+                             std::to_string(send_to) + ", recv<-" +
+                             std::to_string(recv_from) + ")");
   }
-  while (true) {
-    // Drain local (rank 0) contributions.
-    {
-      std::lock_guard<std::mutex> l(data_mu_);
-      if (data_shutdown_) return;
-      while (!local_contrib_.empty()) {
-        auto [h, payload] = std::move(local_contrib_.front());
-        local_contrib_.pop_front();
-        DataOpState& st = data_ops_[h.seq];
-        st.header = h;
-        st.header_set = true;
-        st.contributions[0] = std::move(payload);
-      }
-    }
-    // Poll worker sockets.
-    if (!pfds.empty()) {
-      int rc = ::poll(pfds.data(), pfds.size(), 20);
-      if (rc > 0) {
-        for (size_t i = 0; i < pfds.size(); ++i) {
-          if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
-          std::string frame;
-          if (!data_socks_[pfd_ranks[i]].RecvFrame(&frame)) {
-            // Worker gone: fail all outstanding ops it belonged to.
-            std::lock_guard<std::mutex> l(data_mu_);
-            if (data_shutdown_) return;
-            aborted_ = true;
-            data_shutdown_ = true;
-            data_cv_.notify_all();
-            return;
-          }
-          Reader rd(frame);
-          DataOpHeader h;
-          int rank = rd.GetI32();
-          h.seq = rd.GetI64();
-          h.op = static_cast<OpType>(rd.GetI32());
-          h.dtype = static_cast<DataType>(rd.GetI32());
-          h.reduce_op = static_cast<ReduceOp>(rd.GetI32());
-          h.process_set_id = rd.GetI32();
-          h.root_rank = rd.GetI32();
-          h.row_bytes = rd.GetI64();
-          h.splits = rd.GetI64Vec();
-          std::string payload = rd.GetString();
-          std::lock_guard<std::mutex> l(data_mu_);
-          DataOpState& st = data_ops_[h.seq];
-          st.header = h;
-          st.header_set = true;
-          st.contributions[rank] = std::move(payload);
-        }
-      }
-    } else {
-      // Single-process-set-of-one corner: nothing to poll, just pace.
-      std::unique_lock<std::mutex> l(data_mu_);
-      data_cv_.wait_for(l, std::chrono::milliseconds(5), [this] {
-        return data_shutdown_ || !local_contrib_.empty();
-      });
-      if (data_shutdown_) return;
-      continue;
-    }
-    // Complete any ops whose member set is fully present.
-    std::vector<int64_t> done;
-    {
-      std::lock_guard<std::mutex> l(data_mu_);
-      for (auto& kv : data_ops_) {
-        DataOpState& st = kv.second;
-        if (!st.header_set) continue;
-        std::vector<int> members;
-        if (!process_sets_.Ranks(st.header.process_set_id, &members)) continue;
-        bool complete = true;
-        for (int m : members) {
-          if (!st.contributions.count(m)) {
-            complete = false;
-            break;
-          }
-        }
-        if (complete) done.push_back(kv.first);
-      }
-    }
-    for (int64_t seq : done) {
-      DataOpState st;
-      {
-        std::lock_guard<std::mutex> l(data_mu_);
-        st = std::move(data_ops_[seq]);
-        data_ops_.erase(seq);
-      }
-      CompleteDataOp(st);
-    }
-  }
+  return Status::OK();
 }
 
-void SocketController::ExecuteDataOp(
-    const DataOpHeader& h, const std::map<int, std::string>& contribs,
-    const std::vector<int>& members, std::map<int, std::string>* replies) {
-  // Uniform reply frame: [i64 meta vec][payload string].
-  auto make_reply = [](const std::vector<int64_t>& meta,
-                       const std::string& payload) {
+Status SocketController::RingAllreduce(void* buf, int64_t count,
+                                       DataType dtype, ReduceOp op,
+                                       const std::vector<int>& members,
+                                       int idx) {
+  const int m = static_cast<int>(members.size());
+  if (m == 1) return Status::OK();
+  char* base = static_cast<char*>(buf);
+  const int item = ItemSize(dtype);
+  const int64_t chunk = count / m, rem = count % m;
+  auto start = [&](int c) { return c * chunk + std::min<int64_t>(c, rem); };
+  auto len = [&](int c) { return start(c + 1) - start(c); };
+  const int next = members[(idx + 1) % m];
+  const int prev = members[(idx - 1 + m) % m];
+
+  // Phase 1: ring reduce-scatter.  After m-1 steps this rank holds the
+  // fully reduced chunk (idx+1)%m.
+  for (int s = 0; s < m - 1; ++s) {
+    const int send_c = ((idx - s) % m + m) % m;
+    const int recv_c = ((idx - s - 1) % m + m) % m;
     Writer w;
-    w.PutI64Vec(meta);
-    w.PutString(payload);
-    return w.Take();
-  };
-  switch (h.op) {
-    case OpType::ALLREDUCE:
-    case OpType::REDUCESCATTER: {
-      std::string acc = contribs.at(members.front());
-      int item = ItemSize(h.dtype);
-      int64_t count = static_cast<int64_t>(acc.size()) / item;
-      for (size_t i = 1; i < members.size(); ++i) {
-        const std::string& c = contribs.at(members[i]);
-        ReduceInto(&acc[0], c.data(), count, h.dtype, h.reduce_op);
-      }
-      std::string reply = make_reply({}, acc);
-      for (int m : members) (*replies)[m] = reply;
-      break;
+    PutFrameHeader(&w, current_seq_, kTagReduceScatter + s);
+    w.PutRaw(base + start(send_c) * item, len(send_c) * item);
+    std::string in;
+    Status st = ExchangeStep(next, w.data(), prev, &in);
+    if (!st.ok()) return st;
+    Reader rd(in);
+    st = CheckFrameHeader(&rd, kTagReduceScatter + s, "ring reduce-scatter");
+    if (!st.ok()) return st;
+    if (static_cast<int64_t>(rd.remaining()) != len(recv_c) * item) {
+      aborted_ = true;
+      return Status::Error(StatusCode::ABORTED,
+                           "ring reduce-scatter chunk size mismatch");
     }
-    case OpType::ALLGATHER: {
-      std::string all;
-      std::vector<int64_t> counts;
-      for (int m : members) {
-        const std::string& c = contribs.at(m);
-        counts.push_back(static_cast<int64_t>(c.size()));
-        all += c;
-      }
-      std::string reply = make_reply(counts, all);
-      for (int m : members) (*replies)[m] = reply;
-      break;
-    }
-    case OpType::BROADCAST: {
-      const std::string& payload = contribs.at(h.root_rank);
-      std::string reply = make_reply({}, payload);
-      for (int m : members) (*replies)[m] = reply;
-      break;
-    }
-    case OpType::ALLTOALL: {
-      // splits live per-contribution: we re-read them from each sender's
-      // header copy — but headers are per-op here, so senders pack their
-      // splits at the front of the payload instead.
-      // Payload layout: [i64 n][splits...][bytes]
-      std::map<int, std::vector<int64_t>> splits;
-      std::map<int, std::string> bufs;
-      for (int m : members) {
-        Reader rd(contribs.at(m));
-        splits[m] = rd.GetI64Vec();
-        bufs[m] = rd.GetString();
-      }
-      for (size_t j = 0; j < members.size(); ++j) {
-        int dest = members[j];
-        std::string out;
-        std::vector<int64_t> recv_splits;
-        for (int src : members) {
-          const auto& sp = splits[src];
-          int64_t offset_rows = 0;
-          for (size_t k = 0; k < j; ++k) offset_rows += sp[k];
-          int64_t rows = sp[j];
-          out.append(bufs[src].data() + offset_rows * h.row_bytes,
-                     rows * h.row_bytes);
-          recv_splits.push_back(rows);
-        }
-        (*replies)[dest] = make_reply(recv_splits, out);
-      }
-      break;
-    }
-    case OpType::BARRIER:
-    case OpType::JOIN: {
-      std::string reply = make_reply({}, "");
-      for (int m : members) (*replies)[m] = reply;
-      break;
-    }
+    ReduceInto(base + start(recv_c) * item, rd.cursor(), len(recv_c), dtype,
+               op);
   }
-}
-
-void SocketController::CompleteDataOp(DataOpState& st) {
-  std::vector<int> members;
-  process_sets_.Ranks(st.header.process_set_id, &members);
-  std::map<int, std::string> replies;
-  ExecuteDataOp(st.header, st.contributions, members, &replies);
-  for (auto& [rank, reply] : replies) {
-    if (rank == 0) {
-      std::lock_guard<std::mutex> l(data_mu_);
-      local_reply_[st.header.seq] = std::move(reply);
-      data_cv_.notify_all();
-    } else {
-      if (!data_socks_[rank].SendFrame(reply)) {
-        HVD_LOG(WARNING) << "data reply to rank " << rank << " failed";
-      }
+  // Phase 2: ring allgather of the reduced chunks.
+  for (int s = 0; s < m - 1; ++s) {
+    const int send_c = ((idx + 1 - s) % m + m) % m;
+    const int recv_c = ((idx - s) % m + m) % m;
+    Writer w;
+    PutFrameHeader(&w, current_seq_, kTagAllgatherPhase + s);
+    w.PutRaw(base + start(send_c) * item, len(send_c) * item);
+    std::string in;
+    Status st = ExchangeStep(next, w.data(), prev, &in);
+    if (!st.ok()) return st;
+    Reader rd(in);
+    st = CheckFrameHeader(&rd, kTagAllgatherPhase + s, "ring allgather");
+    if (!st.ok()) return st;
+    if (static_cast<int64_t>(rd.remaining()) != len(recv_c) * item) {
+      aborted_ = true;
+      return Status::Error(StatusCode::ABORTED,
+                           "ring allgather chunk size mismatch");
     }
+    std::memcpy(base + start(recv_c) * item, rd.cursor(), len(recv_c) * item);
   }
+  return Status::OK();
 }
-
-// ---------------------------------------------------------------------------
-// Public data-plane API (called from the Python executor thread)
-// ---------------------------------------------------------------------------
-
-namespace {
-// Parse the uniform reply frame.
-void ParseReply(const std::string& reply, std::vector<int64_t>* meta,
-                std::string* payload) {
-  Reader rd(reply);
-  *meta = rd.GetI64Vec();
-  *payload = rd.GetString();
-}
-}  // namespace
 
 Status SocketController::AllreduceBuffer(void* buf, int64_t count,
                                          DataType dtype, ReduceOp op,
                                          int psid) {
-  DataOpHeader h;
-  h.seq = current_seq_;
-  h.op = OpType::ALLREDUCE;
-  h.dtype = dtype;
-  h.reduce_op = op;
-  h.process_set_id = psid;
-  int64_t nbytes = count * ItemSize(dtype);
-  std::string payload(static_cast<const char*>(buf), nbytes);
-  std::string reply;
-  Status s = MemberDataOp(h, payload, &reply);
-  if (!s.ok()) return s;
-  std::vector<int64_t> meta;
-  std::string out;
-  ParseReply(reply, &meta, &out);
-  std::memcpy(buf, out.data(), nbytes);
-  return Status::OK();
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  std::vector<int> members;
+  int idx;
+  Status st = Members(psid, &members, &idx);
+  if (!st.ok()) return st;
+  return RingAllreduce(buf, count, dtype, op, members, idx);
 }
 
 Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
                                          int psid, std::string* out,
                                          std::vector<int64_t>* per_rank) {
-  DataOpHeader h;
-  h.seq = current_seq_;
-  h.op = OpType::ALLGATHER;
-  h.process_set_id = psid;
-  std::string payload(static_cast<const char*>(in), nbytes);
-  std::string reply;
-  Status s = MemberDataOp(h, payload, &reply);
-  if (!s.ok()) return s;
-  ParseReply(reply, per_rank, out);
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  std::vector<int> members;
+  int idx;
+  Status st = Members(psid, &members, &idx);
+  if (!st.ok()) return st;
+  const int m = static_cast<int>(members.size());
+  if (m == 1) {
+    out->assign(static_cast<const char*>(in), nbytes);
+    per_rank->assign(1, nbytes);
+    return Status::OK();
+  }
+  const int next = members[(idx + 1) % m];
+  const int prev = members[(idx - 1 + m) % m];
+  // Ring allgather with per-rank sizes carried in-band: step s passes block
+  // (idx - s) along the ring; after m-1 steps everyone holds all blocks.
+  std::vector<std::string> blocks(m);
+  blocks[idx].assign(static_cast<const char*>(in), nbytes);
+  for (int s = 0; s < m - 1; ++s) {
+    const int send_b = ((idx - s) % m + m) % m;
+    const int recv_b = ((idx - s - 1) % m + m) % m;
+    Writer w;
+    PutFrameHeader(&w, current_seq_, kTagAllgather + s);
+    w.PutRaw(blocks[send_b].data(), blocks[send_b].size());
+    std::string frame;
+    st = ExchangeStep(next, w.data(), prev, &frame);
+    if (!st.ok()) return st;
+    Reader rd(frame);
+    st = CheckFrameHeader(&rd, kTagAllgather + s, "allgather");
+    if (!st.ok()) return st;
+    blocks[recv_b].assign(rd.cursor(), rd.remaining());
+  }
+  out->clear();
+  per_rank->clear();
+  for (int b = 0; b < m; ++b) {
+    per_rank->push_back(static_cast<int64_t>(blocks[b].size()));
+    out->append(blocks[b]);
+  }
   return Status::OK();
 }
 
 Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
                                          int root_rank, int psid) {
-  DataOpHeader h;
-  h.seq = current_seq_;
-  h.op = OpType::BROADCAST;
-  h.process_set_id = psid;
-  h.root_rank = root_rank;
-  std::string payload;
-  if (cfg_.rank == root_rank) {
-    payload.assign(static_cast<const char*>(buf), nbytes);
-  }
-  std::string reply;
-  Status s = MemberDataOp(h, payload, &reply);
-  if (!s.ok()) return s;
-  std::vector<int64_t> meta;
-  std::string out;
-  ParseReply(reply, &meta, &out);
-  if (static_cast<int64_t>(out.size()) != nbytes) {
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  std::vector<int> members;
+  int idx;
+  Status st = Members(psid, &members, &idx);
+  if (!st.ok()) return st;
+  const int m = static_cast<int>(members.size());
+  if (m == 1) return Status::OK();
+  auto root_it = std::find(members.begin(), members.end(), root_rank);
+  if (root_it == members.end()) {
     return Status::Error(StatusCode::INVALID_ARGUMENT,
-                         "broadcast size mismatch across ranks");
+                         "broadcast root " + std::to_string(root_rank) +
+                             " not in process set");
   }
-  std::memcpy(buf, out.data(), nbytes);
+  const int root_idx = static_cast<int>(root_it - members.begin());
+  const int vrank = (idx - root_idx + m) % m;
+  // Binomial tree: log2(m) rounds; parent sends after it has the payload.
+  int mask = 1;
+  while (mask < m) {
+    if (vrank & mask) {
+      const int src = members[(root_idx + vrank - mask) % m];
+      std::string frame;
+      if (!peer_socks_[src].RecvFrame(&frame)) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast recv from rank " +
+                                 std::to_string(src) + " failed");
+      }
+      Reader rd(frame);
+      st = CheckFrameHeader(&rd, kTagBroadcast, "broadcast");
+      if (!st.ok()) return st;
+      if (static_cast<int64_t>(rd.remaining()) != nbytes) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast size mismatch across ranks");
+      }
+      std::memcpy(buf, rd.cursor(), nbytes);
+      break;
+    }
+    mask <<= 1;
+  }
+  mask >>= 1;
+  while (mask > 0) {
+    if (vrank + mask < m) {
+      const int dst = members[(root_idx + vrank + mask) % m];
+      Writer w;
+      PutFrameHeader(&w, current_seq_, kTagBroadcast);
+      w.PutRaw(buf, nbytes);
+      if (!peer_socks_[dst].SendFrame(w.data())) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast send to rank " + std::to_string(dst) +
+                                 " failed");
+      }
+    }
+    mask >>= 1;
+  }
   return Status::OK();
 }
 
@@ -663,30 +645,75 @@ Status SocketController::AlltoallBuffer(const void* in,
                                         int64_t row_bytes, int psid,
                                         std::string* out,
                                         std::vector<int64_t>* recv_splits) {
-  DataOpHeader h;
-  h.seq = current_seq_;
-  h.op = OpType::ALLTOALL;
-  h.process_set_id = psid;
-  h.row_bytes = row_bytes;
-  int64_t rows = 0;
-  for (auto v : splits) rows += v;
-  Writer w;
-  w.PutI64Vec(splits);
-  w.PutString(std::string(static_cast<const char*>(in), rows * row_bytes));
-  std::string reply;
-  Status s = MemberDataOp(h, w.data(), &reply);
-  if (!s.ok()) return s;
-  ParseReply(reply, recv_splits, out);
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  std::vector<int> members;
+  int idx;
+  Status st = Members(psid, &members, &idx);
+  if (!st.ok()) return st;
+  const int m = static_cast<int>(members.size());
+  if (static_cast<int>(splits.size()) != m) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "alltoall splits length != process set size");
+  }
+  const char* base = static_cast<const char*>(in);
+  std::vector<int64_t> offs(m + 1, 0);
+  for (int j = 0; j < m; ++j) offs[j + 1] = offs[j] + splits[j];
+  std::vector<std::string> recv_bufs(m);
+  std::vector<int64_t> rows_from(m, 0);
+  recv_bufs[idx].assign(base + offs[idx] * row_bytes,
+                        splits[idx] * row_bytes);
+  rows_from[idx] = splits[idx];
+  // Pairwise exchange: round d trades with the member d positions away in
+  // each direction; the duplex step keeps the cycle deadlock-free.
+  for (int d = 1; d < m; ++d) {
+    const int to_i = (idx + d) % m;
+    const int from_i = (idx - d + m) % m;
+    Writer w;
+    PutFrameHeader(&w, current_seq_, kTagAlltoall + d);
+    w.PutI64(splits[to_i]);
+    w.PutRaw(base + offs[to_i] * row_bytes, splits[to_i] * row_bytes);
+    std::string frame;
+    st = ExchangeStep(members[to_i], w.data(), members[from_i], &frame);
+    if (!st.ok()) return st;
+    Reader rd(frame);
+    st = CheckFrameHeader(&rd, kTagAlltoall + d, "alltoall");
+    if (!st.ok()) return st;
+    int64_t rows = rd.GetI64();
+    if (static_cast<int64_t>(rd.remaining()) != rows * row_bytes) {
+      aborted_ = true;
+      return Status::Error(StatusCode::ABORTED,
+                           "alltoall payload size mismatch");
+    }
+    recv_bufs[from_i].assign(rd.cursor(), rd.remaining());
+    rows_from[from_i] = rows;
+  }
+  out->clear();
+  recv_splits->assign(rows_from.begin(), rows_from.end());
+  for (int j = 0; j < m; ++j) out->append(recv_bufs[j]);
   return Status::OK();
 }
 
 Status SocketController::Barrier(int psid) {
-  DataOpHeader h;
-  h.seq = current_seq_;
-  h.op = OpType::BARRIER;
-  h.process_set_id = psid;
-  std::string reply;
-  return MemberDataOp(h, "", &reply);
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  std::vector<int> members;
+  int idx;
+  Status st = Members(psid, &members, &idx);
+  if (!st.ok()) return st;
+  const int m = static_cast<int>(members.size());
+  // Dissemination barrier: ceil(log2(m)) duplex rounds.
+  for (int k = 1; k < m; k <<= 1) {
+    const int to = members[(idx + k) % m];
+    const int from = members[(idx - k + m) % m];
+    Writer w;
+    PutFrameHeader(&w, current_seq_, kTagBarrier + k);
+    std::string frame;
+    st = ExchangeStep(to, w.data(), from, &frame);
+    if (!st.ok()) return st;
+    Reader rd(frame);
+    st = CheckFrameHeader(&rd, kTagBarrier + k, "barrier");
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
 }
 
 }  // namespace hvdtpu

@@ -1,12 +1,13 @@
 // Multi-process controller: rank-0 coordinator negotiation over TCP plus a
-// coordinator-rooted host data plane.
+// full-mesh worker data plane running ring/tree algorithms.
 //
-// Reference analogs (SURVEY.md §2.1, §3.2): controller.cc
+// Reference analogs (SURVEY.md §2.1, §2.8, §3.2): controller.cc
 // Controller::ComputeResponseList (rank-0 request intersection), gloo/
-// (MPI-free CPU transport + rendezvous), response_cache.cc (bit-vector
-// steady state), stall_inspector.cc (per-rank missing lists).
+// (MPI-free CPU transport + rendezvous + full-mesh TCP pairs + ring
+// collectives), response_cache.cc (bit-vector steady state),
+// stall_inspector.cc (per-rank missing lists).
 //
-// Protocol (per negotiation cycle, lock-step):
+// Negotiation protocol (per cycle, lock-step, coordinator-rooted):
 //   worker -> coord : CYCLE frame = [n_cached, cached_ids...,
 //                                    n_requests, full requests...]
 //   coord  -> worker: RESPONSES frame = [n, responses...]
@@ -16,19 +17,20 @@
 // dispatch one cached fused XLA program per response with no further
 // coordination.
 //
-// Data plane: members send DATA frames (tagged by the response's global
-// seq) to the coordinator's data service thread, which combines and
-// replies.  Host arrays only — the TPU path never touches these sockets.
+// Data plane: every pair of ranks holds a TCP connection (established at
+// Initialize via a coordinator-brokered address book — the Gloo full-mesh
+// analog).  Collectives run *on the calling executor thread* of each
+// member, in the globally negotiated order: ring allreduce (reduce-scatter
+// + allgather phases, bandwidth-optimal O(bytes) per rank instead of the
+// round-1 coordinator star's O(size*bytes) rank-0 ingress), ring
+// allgather, binomial-tree broadcast, pairwise alltoall, dissemination
+// barrier.  Host arrays only — the TPU path never touches these sockets.
 #pragma once
 
-#include <condition_variable>
-#include <deque>
+#include <atomic>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <set>
-#include <thread>
-#include <unordered_map>
 #include <vector>
 
 #include "common.h"
@@ -83,43 +85,34 @@ class SocketController : public Controller {
   void Announce(int rank, TensorRequest req, std::vector<Response>* errors);
   void UpdateCachesAndSeq(std::vector<Response>* responses);
 
-  // -- data plane -----------------------------------------------------------
-  struct DataOpHeader {
-    int64_t seq = 0;
-    OpType op = OpType::BARRIER;
-    DataType dtype = DataType::FLOAT32;
-    ReduceOp reduce_op = ReduceOp::SUM;
-    int32_t process_set_id = 0;
-    int32_t root_rank = 0;
-    int64_t row_bytes = 0;
-    std::vector<int64_t> splits;
-  };
-  struct DataOpState {
-    DataOpHeader header;
-    std::map<int, std::string> contributions;  // rank -> payload
-    bool header_set = false;
-  };
-  // Executes a data op as a member (worker: over the socket; coordinator:
-  // via the local channel to the data service thread).
-  Status MemberDataOp(const DataOpHeader& h, const std::string& payload,
-                      std::string* reply);
-  void DataServiceLoop();
-  void CompleteDataOp(DataOpState& st);
-  static void ExecuteDataOp(const DataOpHeader& h,
-                            const std::map<int, std::string>& contribs,
-                            const std::vector<int>& members,
-                            std::map<int, std::string>* replies);
+  // -- data plane (full mesh, caller-thread algorithms) ---------------------
+  // Resolve a process set into its sorted member ranks + this rank's index.
+  Status Members(int psid, std::vector<int>* members, int* my_idx) const;
+  // One collective step: send `frame` to rank `send_to` while receiving a
+  // frame from rank `recv_from` (deadlock-free duplex).
+  Status ExchangeStep(int send_to, const std::string& frame, int recv_from,
+                      std::string* in);
+  // Frame helpers: every data frame is [i64 seq][i32 tag][raw payload];
+  // seq/tag mismatches mean the mesh desynced and abort the job.
+  static void PutFrameHeader(Writer* w, int64_t seq, int32_t tag);
+  Status CheckFrameHeader(Reader* rd, int32_t tag, const char* what);
+
+  Status RingAllreduce(void* buf, int64_t count, DataType dtype, ReduceOp op,
+                       const std::vector<int>& members, int idx);
+  Status ConnectMesh(const std::vector<std::string>& addrs,
+                     const std::vector<int>& ports);
 
   // -- wiring ---------------------------------------------------------------
   bool is_coordinator() const { return cfg_.rank == 0; }
 
-  Listener listener_;
-  // coordinator: per-worker sockets (index = rank, [0] unused)
+  Listener listener_;       // coordinator: rendezvous/ctrl accept
+  Listener data_listener_;  // every rank: mesh peer accept (ephemeral port)
+  // coordinator: per-worker ctrl sockets (index = rank, [0] unused)
   std::vector<Socket> ctrl_socks_;
-  std::vector<Socket> data_socks_;
-  // worker: connections to the coordinator
+  // worker: ctrl connection to the coordinator
   Socket coord_ctrl_;
-  Socket coord_data_;
+  // full mesh: peer_socks_[r] is the data connection to rank r ([rank] unused)
+  std::vector<Socket> peer_socks_;
 
   ResponseCache cache_;
   std::map<std::string, Pending> pending_;  // coordinator only
@@ -127,20 +120,8 @@ class SocketController : public Controller {
   int64_t seq_counter_ = 0;   // global data-op sequence (all ranks agree)
   int64_t current_seq_ = -1;  // seq for the next data op on this rank
 
-  // coordinator data service
-  std::thread data_thread_;
-  std::mutex data_mu_;
-  std::condition_variable data_cv_;
-  std::map<int64_t, DataOpState> data_ops_;
-  std::map<int64_t, std::map<int, std::string>> data_replies_;
-  bool data_shutdown_ = false;
-  // local (rank 0) contribution channel into the data service
-  std::deque<std::pair<DataOpHeader, std::string>> local_contrib_;
-  std::map<int64_t, std::string> local_reply_;
-  std::map<int64_t, std::vector<int64_t>> reply_splits_;  // seq -> counts
-
   bool initialized_ = false;
-  bool aborted_ = false;
+  std::atomic<bool> aborted_{false};
 };
 
 }  // namespace hvdtpu

@@ -169,6 +169,136 @@ bool Socket::RecvFrame(std::string* payload) {
   return RecvAll(&(*payload)[0], len);
 }
 
+std::string Socket::PeerAddr() const {
+  sockaddr_in sa{};
+  socklen_t slen = sizeof(sa);
+  if (::getpeername(fd_, reinterpret_cast<sockaddr*>(&sa), &slen) != 0) {
+    return "";
+  }
+  char buf[INET_ADDRSTRLEN] = {0};
+  if (!::inet_ntop(AF_INET, &sa.sin_addr, buf, sizeof(buf))) return "";
+  return buf;
+}
+
+namespace {
+
+bool SetNonblocking(int fd, bool on) {
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  if (flags < 0) return false;
+  flags = on ? (flags | O_NONBLOCK) : (flags & ~O_NONBLOCK);
+  return ::fcntl(fd, F_SETFL, flags) == 0;
+}
+
+}  // namespace
+
+bool DuplexExchange(Socket& send_sock, const std::string& out,
+                    Socket& recv_sock, std::string* in,
+                    const std::function<bool()>& cancelled) {
+  const int sfd = send_sock.fd();
+  const int rfd = recv_sock.fd();
+  if (sfd < 0 || rfd < 0) return false;
+
+  // Outgoing: 4-byte length prefix + payload (matches Send/RecvFrame).
+  std::string sbuf;
+  sbuf.reserve(4 + out.size());
+  uint32_t slen = static_cast<uint32_t>(out.size());
+  sbuf.append(reinterpret_cast<const char*>(&slen), 4);
+  sbuf += out;
+  size_t sent = 0;
+
+  // Incoming state machine: length prefix, then payload.
+  uint32_t rlen = 0;
+  size_t rlen_got = 0;
+  size_t rgot = 0;
+  bool rlen_done = false;
+  in->clear();
+
+  if (!SetNonblocking(sfd, true)) return false;
+  if (rfd != sfd && !SetNonblocking(rfd, true)) {
+    SetNonblocking(sfd, false);
+    return false;
+  }
+  bool ok = true;
+  while (ok && (sent < sbuf.size() || !rlen_done || rgot < rlen)) {
+    if (cancelled && cancelled()) {
+      ok = false;
+      break;
+    }
+    pollfd pfds[2];
+    int n = 0;
+    const bool want_send = sent < sbuf.size();
+    const bool want_recv = !rlen_done || rgot < rlen;
+    if (sfd == rfd) {
+      pfds[n++] = pollfd{
+          sfd,
+          static_cast<short>((want_send ? POLLOUT : 0) |
+                             (want_recv ? POLLIN : 0)),
+          0};
+    } else {
+      if (want_send) pfds[n++] = pollfd{sfd, POLLOUT, 0};
+      if (want_recv) pfds[n++] = pollfd{rfd, POLLIN, 0};
+    }
+    int rc = ::poll(pfds, n, 200);  // short: re-check cancellation
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      ok = false;
+      break;
+    }
+    if (rc == 0) continue;  // peer may still be computing toward this step
+    for (int i = 0; i < n && ok; ++i) {
+      if (pfds[i].revents & POLLNVAL) {
+        ok = false;
+        break;
+      }
+      // POLLERR/POLLHUP with a pending send: attempt the send so the socket
+      // error surfaces instead of spinning on a dead peer.
+      if ((pfds[i].revents & (POLLOUT | POLLERR | POLLHUP)) && want_send &&
+          pfds[i].fd == sfd) {
+        ssize_t w = ::send(pfds[i].fd, sbuf.data() + sent, sbuf.size() - sent,
+                           MSG_NOSIGNAL);
+        if (w > 0) {
+          sent += static_cast<size_t>(w);
+        } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                   errno != EINTR) {
+          ok = false;
+          break;
+        }
+      }
+      if ((pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) && want_recv &&
+          pfds[i].fd == rfd) {
+        if (!rlen_done) {
+          ssize_t r = ::recv(pfds[i].fd,
+                             reinterpret_cast<char*>(&rlen) + rlen_got,
+                             4 - rlen_got, 0);
+          if (r > 0) {
+            rlen_got += static_cast<size_t>(r);
+            if (rlen_got == 4) {
+              rlen_done = true;
+              in->resize(rlen);
+            }
+          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
+                                errno != EINTR)) {
+            ok = false;
+            break;
+          }
+        } else if (rgot < rlen) {
+          ssize_t r = ::recv(pfds[i].fd, &(*in)[rgot], rlen - rgot, 0);
+          if (r > 0) {
+            rgot += static_cast<size_t>(r);
+          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
+                                errno != EINTR)) {
+            ok = false;
+            break;
+          }
+        }
+      }
+    }
+  }
+  SetNonblocking(sfd, false);
+  if (rfd != sfd) SetNonblocking(rfd, false);
+  return ok;
+}
+
 // ---- Listener -------------------------------------------------------------
 
 Listener::~Listener() { Close(); }

@@ -7,6 +7,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -63,6 +64,8 @@ class Reader {
   }
   bool ok() const { return ok_; }
   size_t remaining() const { return size_ - pos_; }
+  // Zero-copy view of the unread tail (bulk data-plane payloads).
+  const char* cursor() const { return data_ + pos_; }
 
  private:
   void Get(void* out, size_t n) {
@@ -103,6 +106,8 @@ class Socket {
   // Raw (unframed) helpers for bulk data-plane payloads.
   bool SendAll(const void* p, size_t n);
   bool RecvAll(void* p, size_t n);
+  // Peer IPv4 address ("1.2.3.4") of a connected socket, "" on error.
+  std::string PeerAddr() const;
   void Close();
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }
@@ -110,6 +115,17 @@ class Socket {
  private:
   int fd_ = -1;
 };
+
+// Simultaneously send one frame on `send_sock` and receive one frame from
+// `recv_sock` without deadlocking — ring/pairwise collective steps have every
+// member sending first, so blocking sends can gridlock once payloads exceed
+// the kernel socket buffers (the reason Gloo's ring algorithms are
+// event-driven).  The two sockets may be the same object (2-member ring).
+// `cancelled` is polled between progress events; returning true aborts.
+// Returns false on peer failure or cancellation.
+bool DuplexExchange(Socket& send_sock, const std::string& out,
+                    Socket& recv_sock, std::string* in,
+                    const std::function<bool()>& cancelled);
 
 // Listening socket; Accept returns connected Sockets.
 class Listener {

@@ -286,3 +286,77 @@ def test_autotune_np2(tmp_path):
     from horovod_tpu.runner import run
 
     assert run(_autotune_worker, args=(str(tmp_path),), np=2) == [0, 1]
+
+
+def _ring_np4_worker():
+    """Ring/tree/pairwise data plane at np=4: payloads large enough to span
+    multiple ring chunks and the kernel socket buffers (exercises the
+    deadlock-free duplex path), every op, plus a non-contiguous process set
+    whose ring skips ranks."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    assert s == 4
+
+    # Large allreduce: 4 MB per rank (>> socket buffers), odd length so the
+    # ring chunking hits the remainder path.
+    n = 1_000_003
+    x = np.arange(n, dtype=np.float32) * (r + 1) / n
+    out = hvd.allreduce(x, op=hvd.Sum, name="ring.big")
+    np.testing.assert_allclose(
+        out, np.arange(n, dtype=np.float32) * 10.0 / n, rtol=1e-5)
+
+    # min/max/product ride the same ring reduce-scatter
+    v = np.full(5, float(r + 1), np.float64)
+    np.testing.assert_allclose(
+        hvd.allreduce(v, op=hvd.Min, name="ring.min"), 1.0)
+    np.testing.assert_allclose(
+        hvd.allreduce(v, op=hvd.Max, name="ring.max"), 4.0)
+    np.testing.assert_allclose(
+        hvd.allreduce(v, op=hvd.Product, name="ring.prod"), 24.0)
+
+    # ragged ring allgather, blocks of different sizes per rank
+    g = hvd.allgather(np.full((r + 1, 2), float(r), np.float32),
+                      name="ring.ag")
+    got = np.asarray(g)
+    assert got.shape == (10, 2)
+    row = 0
+    for rr in range(4):
+        np.testing.assert_allclose(got[row:row + rr + 1], float(rr))
+        row += rr + 1
+
+    # binomial-tree broadcast from every root, payload > one chunk
+    for root in range(s):
+        out = hvd.broadcast(
+            np.full(100_000, float(r), np.float32), root_rank=root,
+            name=f"ring.bc.{root}")
+        np.testing.assert_allclose(np.asarray(out), float(root))
+
+    # pairwise alltoall: rank r sends (j+1) rows to member j
+    splits = [j + 1 for j in range(s)]
+    rows = sum(splits)
+    data = (np.arange(rows, dtype=np.float32) + 100 * r).reshape(rows, 1)
+    out, rsplits = hvd.alltoall(data, splits=splits, name="ring.a2a")
+    np.testing.assert_array_equal(rsplits, [r + 1] * s)
+    expected = []
+    for src in range(s):
+        off = sum(range(1, r + 1))  # rows for me start after splits[:r]
+        expected.extend((np.arange(off, off + r + 1) + 100 * src).tolist())
+    np.testing.assert_allclose(np.asarray(out).ravel(), expected)
+
+    # non-contiguous process set: ring over ranks {0, 2, 3}
+    ps = hvd.add_process_set([0, 2, 3])
+    if r in (0, 2, 3):
+        out = hvd.allreduce(np.full(7, float(r), np.float32), op=hvd.Sum,
+                            process_set=ps, name="ring.ps")
+        np.testing.assert_allclose(out, 5.0)
+
+    hvd.barrier()
+    hvd.shutdown()
+    return r
+
+
+def test_ring_collectives_np4():
+    assert run(_ring_np4_worker, np=4) == [0, 1, 2, 3]

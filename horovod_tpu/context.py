@@ -56,6 +56,27 @@ def _scale(arr: np.ndarray, factor: float) -> np.ndarray:
     return (arr.astype(np.float64) * factor).astype(arr.dtype)
 
 
+class _FusionBuffer:
+    """Reusable pack/unpack buffer for the host data plane.
+
+    Reference: fusion_buffer_manager.cc — a preallocated per-device buffer
+    that MemcpyInFusionBuffer packs gradients into so each cycle issues one
+    collective with no per-cycle allocation.  Grows to the largest bucket
+    seen (a single tensor may exceed HOROVOD_FUSION_THRESHOLD; it then forms
+    a bucket of one)."""
+
+    def __init__(self, initial_bytes: int = 0):
+        self._buf = np.empty(int(initial_bytes), np.uint8)
+
+    def view(self, dtype, count: int) -> np.ndarray:
+        """A contiguous `count`-element view of the buffer as `dtype`."""
+        dtype = np.dtype(dtype)
+        nbytes = int(count) * dtype.itemsize
+        if self._buf.nbytes < nbytes:
+            self._buf = np.empty(nbytes, np.uint8)
+        return self._buf[:nbytes].view(dtype)
+
+
 def _select_backend(cfg: Config) -> CoreBackend:
     """Pick the native C++ core when available, pure-Python otherwise.
 
@@ -99,6 +120,10 @@ class HorovodContext:
         self._handle_counter = itertools.count(1)
         self._noname_counter = itertools.count(0)
         self._shutdown = threading.Event()
+        # Only the executor thread touches the fusion buffer; responses are
+        # executed one at a time, so one buffer per process suffices
+        # (reference: FusionBufferManager::GetBuffer per device).
+        self._fusion = _FusionBuffer(min(cfg.fusion_threshold_bytes, 64 << 20))
         self.core.start(cfg)
         self._executor = threading.Thread(
             target=self._executor_loop, name="hvd-executor", daemon=True
@@ -254,7 +279,7 @@ class HorovodContext:
         if op == OpType.ALLREDUCE:
             self._exec_allreduce(entries, psid)
         elif op == OpType.ALLGATHER:
-            self._exec_allgather(entries[0], psid)
+            self._exec_allgather(entries, psid)
         elif op == OpType.BROADCAST:
             self._exec_broadcast(entries[0], psid)
         elif op == OpType.ALLTOALL:
@@ -275,10 +300,14 @@ class HorovodContext:
         # MemcpyInFusionBuffer analog: pack members into one contiguous buffer.
         dtype = entries[0].array.dtype
         reduce_op = entries[0].reduce_op
-        if len(entries) == 1:
-            fused = entries[0].array.ravel().copy()
-        else:
-            fused = np.concatenate([e.array.ravel() for e in entries])
+        # Pack into the preallocated fusion buffer — no per-cycle allocation.
+        total = sum(e.array.size for e in entries)
+        fused = self._fusion.view(dtype, total)
+        off = 0
+        for e in entries:
+            n = e.array.size
+            np.copyto(fused[off:off + n], e.array.ravel(), casting="no")
+            off += n
         pre = entries[0].prescale_factor
         if pre != 1.0:
             fused = _scale(fused, pre)
@@ -313,21 +342,64 @@ class HorovodContext:
         post = entries[0].postscale_factor
         if post != 1.0:
             fused = _scale(fused, post)
-        # MemcpyOutFusionBuffer analog.
+        # MemcpyOutFusionBuffer analog: results must own their memory — the
+        # fusion buffer is reused by the next response.
         offset = 0
         for e in entries:
             n = e.array.size
-            e.result = fused[offset:offset + n].reshape(e.array.shape)
+            e.result = fused[offset:offset + n].reshape(e.array.shape).copy()
             offset += n
 
-    def _exec_allgather(self, e: TensorEntry, psid: int) -> None:
+    def _exec_allgather(self, entries: List[TensorEntry], psid: int) -> None:
+        if len(entries) == 1:
+            e = entries[0]
+            stacked, counts = self.core.allgather_buffer(
+                e.array.reshape(e.array.shape[0] if e.array.ndim else 1, -1)
+                if e.array.ndim else e.array.reshape(1, 1),
+                psid,
+            )
+            rest = e.array.shape[1:] if e.array.ndim else ()
+            e.result = np.asarray(stacked).reshape(
+                (int(np.sum(counts)),) + tuple(rest))
+            return
+        # Fused allgather (reference: AllgatherOp rides the fusion buffer
+        # too): pack members length-prefixed into one payload, gather once,
+        # then split each rank's block back into per-tensor segments.  The
+        # prefix is required because allgather first dims vary per rank, so
+        # the response metas cannot describe remote segment sizes.
+        parts = []
+        for e in entries:
+            raw = np.ascontiguousarray(e.array).view(np.uint8).ravel()
+            parts.append(np.frombuffer(
+                np.int64(raw.nbytes).tobytes(), np.uint8))
+            parts.append(raw)
+        # Rows of one byte: rank blocks are ragged (per-rank first dims), so
+        # the per-rank counts must come back in bytes, not in my-row units.
+        packed = np.concatenate(parts)
         stacked, counts = self.core.allgather_buffer(
-            e.array.reshape(e.array.shape[0] if e.array.ndim else 1, -1)
-            if e.array.ndim else e.array.reshape(1, 1),
-            psid,
-        )
-        rest = e.array.shape[1:] if e.array.ndim else ()
-        e.result = np.asarray(stacked).reshape((int(np.sum(counts)),) + tuple(rest))
+            packed.reshape(-1, 1), psid)
+        flat = np.asarray(stacked).view(np.uint8).ravel()
+        per_entry: List[List[np.ndarray]] = [[] for _ in entries]
+        off = 0
+        for rank_bytes in counts:
+            end = off + int(rank_bytes)
+            for i, e in enumerate(entries):
+                n = int(flat[off:off + 8].view(np.int64)[0])
+                off += 8
+                per_entry[i].append(flat[off:off + n])
+                off += n
+            if off != end:
+                raise HorovodInternalError(
+                    "fused allgather block framing desynced")
+        for i, e in enumerate(entries):
+            rest = tuple(e.array.shape[1:]) if e.array.ndim else ()
+            row_bytes = int(np.prod(rest, dtype=np.int64)) * e.array.itemsize \
+                if rest else e.array.itemsize
+            blob = np.concatenate(per_entry[i]) if per_entry[i] else \
+                np.empty(0, np.uint8)
+            total_rows = blob.nbytes // max(row_bytes, 1)
+            e.result = blob.view(e.array.dtype).reshape(
+                (total_rows,) + rest)
 
     def _exec_broadcast(self, e: TensorEntry, psid: int) -> None:
         e.result = self.core.broadcast_buffer(e.array, e.root_rank, psid)

@@ -59,7 +59,7 @@ std::vector<Response> FuseRequests(const std::vector<TensorRequest>& ready,
   auto flush = [&]() {
     if (bucket.empty()) return;
     Response r;
-    r.op = OpType::ALLREDUCE;
+    r.op = bucket.front()->op;
     r.dtype = bucket.front()->dtype;
     r.process_set_id = bucket.front()->process_set_id;
     for (auto* t : bucket) {
@@ -74,11 +74,23 @@ std::vector<Response> FuseRequests(const std::vector<TensorRequest>& ready,
   for (const auto& t : ready) {
     if (t.op == OpType::ALLREDUCE) {
       bool fusable = !bucket.empty() &&
+                     bucket.front()->op == OpType::ALLREDUCE &&
                      bucket.front()->dtype == t.dtype &&
                      bucket.front()->process_set_id == t.process_set_id &&
                      bucket.front()->reduce_op == t.reduce_op &&
                      bucket.front()->prescale == t.prescale &&
                      bucket.front()->postscale == t.postscale &&
+                     bucket_bytes + t.nbytes <= fusion_threshold;
+      if (!fusable) flush();
+      bucket.push_back(&t);
+      bucket_bytes += t.nbytes;
+    } else if (t.op == OpType::ALLGATHER) {
+      // Allgathers fuse too (reference: AllgatherOp shares the fusion
+      // buffer): the executor packs members length-prefixed, so only the
+      // process set has to match.
+      bool fusable = !bucket.empty() &&
+                     bucket.front()->op == OpType::ALLGATHER &&
+                     bucket.front()->process_set_id == t.process_set_id &&
                      bucket_bytes + t.nbytes <= fusion_threshold;
       if (!fusable) flush();
       bucket.push_back(&t);

@@ -1,6 +1,7 @@
 #include "parameter_manager.h"
 
 #include <algorithm>
+#include <cmath>
 
 #include "common.h"
 #include "logging.h"
@@ -8,11 +9,181 @@
 namespace hvdtpu {
 
 namespace {
-constexpr int64_t kMinFusion = 1 << 20;         // 1 MiB
-constexpr int64_t kMaxFusion = 512LL << 20;     // 512 MiB
+constexpr int64_t kMinFusion = 1 << 20;      // 1 MiB
+constexpr int64_t kMaxFusion = 512LL << 20;  // 512 MiB
 constexpr double kMinCycleMs = 0.2;
 constexpr double kMaxCycleMs = 100.0;
+// log2 spans of the two knobs (normalize to the unit square).
+const double kFusionSpan = std::log2(static_cast<double>(kMaxFusion) /
+                                     static_cast<double>(kMinFusion));
+const double kCycleSpan = std::log2(kMaxCycleMs / kMinCycleMs);
+
+constexpr double kLengthscale = 0.3;  // RBF, unit-square coordinates
+constexpr double kNoise = 1e-2;      // observation noise (normalized scores)
+constexpr int kGrid = 24;            // EI candidate grid per axis
+constexpr int kMaxTuneSamples = 40;  // GP sample cap (bounds O(n^3) refit)
+constexpr int kMaxWindowsSinceBest = 12;  // plateau -> converge
+
+double FusionToX(int64_t fusion) {
+  double f = std::min<double>(std::max<double>(fusion, kMinFusion),
+                              static_cast<double>(kMaxFusion));
+  return std::log2(f / kMinFusion) / kFusionSpan;
+}
+int64_t XToFusion(double x) {
+  double f = std::exp2(x * kFusionSpan) * kMinFusion;
+  return std::min(kMaxFusion, std::max<int64_t>(
+      kMinFusion, static_cast<int64_t>(f)));
+}
+double CycleToX(double ms) {
+  double c = std::min(std::max(ms, kMinCycleMs), kMaxCycleMs);
+  return std::log2(c / kMinCycleMs) / kCycleSpan;
+}
+double XToCycle(double x) {
+  return std::min(kMaxCycleMs,
+                  std::max(kMinCycleMs, std::exp2(x * kCycleSpan) *
+                                            kMinCycleMs));
+}
+
+double Rbf(double ax, double ay, double bx, double by) {
+  double dx = ax - bx, dy = ay - by;
+  return std::exp(-(dx * dx + dy * dy) / (2 * kLengthscale * kLengthscale));
+}
+
+// Standard normal pdf/cdf for Expected Improvement.
+double Phi(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+double phi(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
 }  // namespace
+
+// ---- BayesianOptimizer -----------------------------------------------------
+
+void BayesianOptimizer::AddSample(double x0, double x1, double score) {
+  xs_.emplace_back(x0, x1);
+  ys_.push_back(score);
+  y_max_ = std::max(y_max_, std::abs(score));
+  FitGP();
+}
+
+void BayesianOptimizer::FitGP() {
+  const int n = static_cast<int>(xs_.size());
+  if (n == 0) return;
+  const double denom = y_max_ > 0 ? y_max_ : 1.0;
+  // K = k(X, X) + noise * I  (row-major), then lower Cholesky in place.
+  chol_.assign(static_cast<size_t>(n) * n, 0.0);
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double k = Rbf(xs_[i].first, xs_[i].second, xs_[j].first,
+                     xs_[j].second);
+      if (i == j) k += kNoise;
+      chol_[i * n + j] = k;
+    }
+  }
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double sum = chol_[i * n + j];
+      for (int k = 0; k < j; ++k) sum -= chol_[i * n + k] * chol_[j * n + k];
+      if (i == j) {
+        chol_[i * n + i] = std::sqrt(std::max(sum, 1e-12));
+      } else {
+        chol_[i * n + j] = sum / chol_[j * n + j];
+      }
+    }
+  }
+  // alpha = K^-1 y via two triangular solves.
+  alpha_.assign(n, 0.0);
+  for (int i = 0; i < n; ++i) {
+    double sum = ys_[i] / denom;
+    for (int k = 0; k < i; ++k) sum -= chol_[i * n + k] * alpha_[k];
+    alpha_[i] = sum / chol_[i * n + i];
+  }
+  for (int i = n - 1; i >= 0; --i) {
+    double sum = alpha_[i];
+    for (int k = i + 1; k < n; ++k) sum -= chol_[k * n + i] * alpha_[k];
+    alpha_[i] = sum / chol_[i * n + i];
+  }
+}
+
+void BayesianOptimizer::Predict(double x0, double x1, double* mean,
+                                double* var) const {
+  const int n = static_cast<int>(xs_.size());
+  if (n == 0) {
+    *mean = 0;
+    *var = 1;
+    return;
+  }
+  std::vector<double> kstar(n);
+  for (int i = 0; i < n; ++i) {
+    kstar[i] = Rbf(x0, x1, xs_[i].first, xs_[i].second);
+  }
+  double m = 0;
+  for (int i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
+  // v = L^-1 k*; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (int i = 0; i < n; ++i) {
+    double sum = kstar[i];
+    for (int k = 0; k < i; ++k) sum -= chol_[i * n + k] * v[k];
+    v[i] = sum / chol_[i * n + i];
+  }
+  double vv = 0;
+  for (int i = 0; i < n; ++i) vv += v[i] * v[i];
+  *mean = m;
+  *var = std::max(1e-12, 1.0 + kNoise - vv);
+}
+
+void BayesianOptimizer::Suggest(double* x0, double* x1) {
+  // Seed phase: spread the first probes before trusting the GP (the
+  // reference warms its GP with a fixed design too).
+  static const double kSeeds[][2] = {
+      {0.15, 0.15}, {0.85, 0.15}, {0.5, 0.5}, {0.15, 0.85}, {0.85, 0.85}};
+  const int n = num_samples();
+  if (n < 5) {
+    *x0 = kSeeds[n][0];
+    *x1 = kSeeds[n][1];
+    return;
+  }
+  const double denom = y_max_ > 0 ? y_max_ : 1.0;
+  double best_y = *std::max_element(ys_.begin(), ys_.end()) / denom;
+  double best_ei = -1, bx = 0.5, by = 0.5;
+  for (int i = 0; i <= kGrid; ++i) {
+    for (int j = 0; j <= kGrid; ++j) {
+      // Deterministic jitter decorrelates the grid across rounds.
+      rng_ = rng_ * 1664525u + 1013904223u;
+      double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+      rng_ = rng_ * 1664525u + 1013904223u;
+      double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+      double cx = std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
+      double cy = std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
+      double mean, var;
+      Predict(cx, cy, &mean, &var);
+      double sd = std::sqrt(var);
+      double z = (mean - best_y - 0.01) / sd;
+      double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
+      if (ei > best_ei) {
+        best_ei = ei;
+        bx = cx;
+        by = cy;
+      }
+    }
+  }
+  *x0 = bx;
+  *x1 = by;
+}
+
+void BayesianOptimizer::Best(double* x0, double* x1, double* score) const {
+  if (ys_.empty()) {
+    *x0 = *x1 = 0.5;
+    *score = 0;
+    return;
+  }
+  size_t i = std::max_element(ys_.begin(), ys_.end()) - ys_.begin();
+  *x0 = xs_[i].first;
+  *x1 = xs_[i].second;
+  *score = ys_[i];
+}
+
+// ---- ParameterManager ------------------------------------------------------
 
 void ParameterManager::Initialize(int64_t fusion_threshold,
                                   double cycle_time_ms,
@@ -42,34 +213,40 @@ void ParameterManager::Log(double score) {
 
 void ParameterManager::Score(double score) {
   Log(score);
+  if (converged_) return;
   if (warmup_windows_ > 0) {
+    // The first window mixes pre-traffic noise; don't teach the GP with it.
     --warmup_windows_;
-    best_score_ = std::max(best_score_, score);
     return;
   }
-  if (score >= best_score_) {
-    // Keep climbing in the same direction on the same knob.
+  bo_.AddSample(FusionToX(fusion_), CycleToX(cycle_ms_), score);
+  if (score > best_score_ * 1.02) {
+    windows_since_best_ = 0;
+  } else {
+    ++windows_since_best_;
+  }
+  if (score > best_score_) {
     best_score_ = score;
     best_fusion_ = fusion_;
     best_cycle_ = cycle_ms_;
-  } else {
-    // Revert and move to the next knob/direction.
+  }
+  // Converge (reference: ParameterManager stops tuning once samples stop
+  // improving): lock in the best configuration instead of exploring
+  // forever — steady-state jobs must not pay EI-exploration throughput,
+  // and the GP refit is O(n^3) in the sample count.
+  if (bo_.num_samples() >= kMaxTuneSamples ||
+      windows_since_best_ >= kMaxWindowsSinceBest) {
+    converged_ = true;
     fusion_ = best_fusion_;
     cycle_ms_ = best_cycle_;
-    if (direction_ == 1) {
-      direction_ = -1;
-    } else {
-      direction_ = 1;
-      knob_ = (knob_ + 1) % 2;
-    }
+    HVD_LOG(INFO) << "autotune converged: fusion=" << fusion_
+                  << " cycle_ms=" << cycle_ms_;
+    return;
   }
-  if (knob_ == 0) {
-    int64_t next = direction_ > 0 ? fusion_ * 2 : fusion_ / 2;
-    fusion_ = std::min(kMaxFusion, std::max(kMinFusion, next));
-  } else {
-    double next = direction_ > 0 ? cycle_ms_ * 2 : cycle_ms_ / 2;
-    cycle_ms_ = std::min(kMaxCycleMs, std::max(kMinCycleMs, next));
-  }
+  double x0, x1;
+  bo_.Suggest(&x0, &x1);
+  fusion_ = XToFusion(x0);
+  cycle_ms_ = XToCycle(x1);
 }
 
 bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {

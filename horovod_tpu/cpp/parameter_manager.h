@@ -1,11 +1,14 @@
 // Online autotuning of fusion threshold and cycle time.
 //
-// Reference: horovod/common/parameter_manager.h (ParameterManager with
-// Bayesian optimization; SURVEY.md §2.1).  This build uses coordinate-wise
-// hill climbing on the same score (negotiated tensor bytes per second),
-// which converges for the two monotone-ish knobs involved and needs no
-// linear-algebra dependency; the tuned values flow back into the cycle loop
-// exactly as in the reference (HOROVOD_AUTOTUNE / HOROVOD_AUTOTUNE_LOG).
+// Reference: horovod/common/parameter_manager.h (ParameterManager +
+// BayesianOptimization over fusion threshold / cycle time with a Gaussian
+// process and Expected Improvement; SURVEY.md §2.1).  This build implements
+// the same joint optimization natively: the 2-D knob space is normalized to
+// the unit square in log2 scale, a GP with RBF kernel is fit to the scored
+// windows (small dense Cholesky — the sample count is the number of 2-second
+// windows, so the cost is trivial), and the next configuration maximizes EI
+// over a candidate grid.  Score = negotiated tensor bytes per second, logged
+// to HOROVOD_AUTOTUNE_LOG exactly as the reference does.
 #pragma once
 
 #include <cstdint>
@@ -14,6 +17,32 @@
 #include <vector>
 
 namespace hvdtpu {
+
+// Gaussian-process regression + Expected Improvement on the unit square.
+// Exposed for the synthetic-surface self-test (autotune_selftest.cc).
+class BayesianOptimizer {
+ public:
+  // Observations are (x in [0,1]^2, score); scores are internally
+  // max-normalized so the kernel scales stay dimensionless.
+  void AddSample(double x0, double x1, double score);
+  // Next point to try: argmax EI over a jittered grid.  Falls back to
+  // latin-square-ish seed points for the first few calls.
+  void Suggest(double* x0, double* x1);
+  // Best observed sample.
+  void Best(double* x0, double* x1, double* score) const;
+  int num_samples() const { return static_cast<int>(xs_.size()); }
+
+ private:
+  void FitGP();
+  void Predict(double x0, double x1, double* mean, double* var) const;
+
+  std::vector<std::pair<double, double>> xs_;
+  std::vector<double> ys_;      // raw scores
+  std::vector<double> alpha_;   // K^-1 y_norm
+  std::vector<double> chol_;    // Cholesky factor of K (row-major lower)
+  double y_max_ = 0;
+  unsigned rng_ = 0x9e3779b9u;
+};
 
 class ParameterManager {
  public:
@@ -27,6 +56,12 @@ class ParameterManager {
   // Called every cycle; returns true when parameters changed.
   bool Tick(int64_t* fusion_threshold, double* cycle_time_ms);
 
+  // Test hook: force a window boundary with an externally supplied score.
+  void ScoreWindowForTest(double score) { Score(score); }
+  int64_t fusion() const { return fusion_; }
+  double cycle_ms() const { return cycle_ms_; }
+  double best_score() const { return best_score_; }
+
  private:
   void Score(double score);
   void Log(double score);
@@ -38,12 +73,13 @@ class ParameterManager {
 
   int64_t fusion_ = 0;
   double cycle_ms_ = 1.0;
-  int knob_ = 0;       // 0: fusion, 1: cycle
-  int direction_ = 1;  // +1 double, -1 halve
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
   int warmup_windows_ = 1;
+  int windows_since_best_ = 0;
+  bool converged_ = false;
+  BayesianOptimizer bo_;
   FILE* log_ = nullptr;
 };
 

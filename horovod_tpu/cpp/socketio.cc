@@ -3,6 +3,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -103,19 +104,36 @@ void Socket::Close() {
 }
 
 bool Socket::Connect(const std::string& addr, int port, double timeout_s) {
+  // Rendezvous addresses may be hostnames (TPU-VM pod metadata hands out
+  // names, not IPs); resolution is retried inside the deadline loop because
+  // DNS may come up after the worker does, exactly like the listener may.
+  sockaddr_in resolved{};
+  resolved.sin_family = AF_INET;
+  resolved.sin_port = htons(static_cast<uint16_t>(port));
+  bool have_addr = ::inet_pton(AF_INET, addr.c_str(), &resolved.sin_addr) == 1;
   double deadline = MonotonicSeconds() + timeout_s;
   while (MonotonicSeconds() < deadline) {
+    if (!have_addr) {
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(addr.c_str(), nullptr, &hints, &res) == 0 && res) {
+        resolved.sin_addr =
+            reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+        ::freeaddrinfo(res);
+        have_addr = true;
+      } else {
+        HVD_LOG(DEBUG) << "cannot resolve host '" << addr << "' (will retry)";
+        std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        continue;
+      }
+    }
     int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return false;
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    sockaddr_in sa{};
-    sa.sin_family = AF_INET;
-    sa.sin_port = htons(static_cast<uint16_t>(port));
-    if (::inet_pton(AF_INET, addr.c_str(), &sa.sin_addr) != 1) {
-      ::close(fd);
-      return false;
-    }
+    sockaddr_in sa = resolved;
     if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
       fd_ = fd;
       return true;

@@ -12,7 +12,6 @@ import traceback
 
 def main() -> int:
     payload_path, out_dir = sys.argv[1], sys.argv[2]
-    rank = os.environ.get("HOROVOD_RANK", "0")
     try:
         import cloudpickle
 
@@ -23,6 +22,10 @@ def main() -> int:
     except BaseException as exc:  # noqa: BLE001 - report to parent
         traceback.print_exc()
         status, value = "error", f"{type(exc).__name__}: {exc}"
+    # Read the rank only now: elastic workers learn it inside fn (the
+    # driver assigns ranks per rendezvous round, not at spawn).
+    rank = os.environ.get("HOROVOD_RANK") \
+        or os.environ.get("HOROVOD_ELASTIC_WORKER_ID", "0").replace(":", "_")
     try:
         import cloudpickle
 

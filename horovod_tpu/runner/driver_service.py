@@ -1,0 +1,305 @@
+"""Pre-flight driver/task services: verify the cluster before launching.
+
+Reference: horovod/runner/driver/driver_service.py (HorovodRunDriverService),
+runner/task/task_service.py and common/util/network.py (SURVEY.md §2.5,
+§3.4): before a single worker starts, the launcher drives a tiny task
+service on every remote host which (a) proves the host is reachable and can
+exec our interpreter, and (b) discovers which of the driver's network
+addresses that host can route to — so multi-NIC machines pick a rendezvous
+interface every worker can reach, and a dead host fails the launch in
+seconds with its name attached instead of hanging the first collective.
+
+Protocol (one line of signed JSON over TCP, HMAC per runner/util.py):
+  task -> driver: {"host": h, "slots": n, "driver_addr": addr_it_reached,
+                   "task_addrs": [...]}
+  driver -> task: {"ok": true}
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .util import (local_hostnames, make_secret, signed_dumps,
+                   verified_loads)
+
+
+def local_addresses() -> List[str]:
+    """Candidate IPv4 addresses of this machine, most-routable first
+    (reference: network.get_local_host_addresses / driver_service's
+    _get_common_interfaces)."""
+    addrs: List[str] = []
+    # The address that routes toward the outside world (no packet is sent).
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 9))
+        addrs.append(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None,
+                                       socket.AF_INET):
+            addrs.append(info[4][0])
+    except OSError:
+        pass
+    addrs.append("127.0.0.1")
+    out = []
+    for a in addrs:
+        if a not in out:
+            out.append(a)
+    return out
+
+
+class DriverService:
+    """Listens for task-probe registrations (reference:
+    HorovodRunDriverService: register_task / wait_for_initial_registration)."""
+
+    def __init__(self, secret: str):
+        self.secret = secret
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._registrations: Dict[str, dict] = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10.0)
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = conn.recv(4096)
+                if not chunk:
+                    return
+                data += chunk
+            msg = verified_loads(data.decode().strip(), self.secret)
+            if not isinstance(msg, dict) or "host" not in msg:
+                return  # unverifiable or malformed: ignore (signed RPC)
+            with self._cv:
+                self._registrations[msg["host"]] = msg
+                self._cv.notify_all()
+            conn.sendall((signed_dumps({"ok": True}, self.secret) +
+                          "\n").encode())
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def wait_for(self, hosts: Sequence[str], timeout: float) -> Dict[str, dict]:
+        """Block until every host registered; raise naming the missing ones."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                missing = [h for h in hosts if h not in self._registrations]
+                if not missing:
+                    return dict(self._registrations)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        "pre-flight probe timed out after "
+                        f"{timeout:.0f}s; unreachable host(s): "
+                        + ", ".join(missing)
+                        + (" (reachable: "
+                           + ", ".join(sorted(self._registrations)) + ")"
+                           if self._registrations else ""))
+                self._cv.wait(min(remaining, 0.5))
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def run_task_probe(driver_addrs: Sequence[str], port: int, host: str,
+                   secret: str, slots: int = 1,
+                   timeout: float = 10.0) -> int:
+    """Task side: test every driver candidate address (the NIC-matching
+    handshake of the reference's driver/task services), then register over
+    the first reachable one, reporting the full reachable set."""
+    reachable: List[str] = []
+    last_err = "no driver addresses given"
+    for addr in driver_addrs:
+        try:
+            probe = socket.create_connection((addr, port), timeout=3.0)
+            probe.close()
+            reachable.append(addr)
+        except OSError as exc:
+            last_err = f"{addr}:{port}: {exc}"
+    for addr in reachable:
+        try:
+            conn = socket.create_connection((addr, port), timeout=timeout)
+        except OSError as exc:
+            last_err = f"{addr}:{port}: {exc}"
+            continue
+        try:
+            msg = {
+                "host": host,
+                "slots": slots,
+                "driver_addr": addr,
+                "reachable": reachable,
+                "task_addrs": local_addresses(),
+            }
+            conn.sendall((signed_dumps(msg, secret) + "\n").encode())
+            conn.settimeout(timeout)
+            reply = b""
+            while not reply.endswith(b"\n"):
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                reply += chunk
+            try:
+                ack = verified_loads(reply.decode().strip(), secret)
+            except Exception:
+                ack = None  # empty/garbled ack (e.g. rejected signature)
+            if isinstance(ack, dict) and ack.get("ok"):
+                return 0
+            last_err = f"{addr}:{port}: bad ack"
+        except OSError as exc:
+            last_err = f"{addr}:{port}: {exc}"
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+    print(f"task probe failed: {last_err}", file=sys.stderr)
+    return 1
+
+
+def _probe_command(host: str, driver_addrs: Sequence[str], port: int,
+                   secret: str, slots: int,
+                   ssh_port: Optional[int]) -> List[str]:
+    """The exec'd probe command; remote hosts get it wrapped in ssh
+    (mock point for the unit tests, reference §4 item 3)."""
+    inner = [
+        sys.executable, "-m", "horovod_tpu.runner.driver_service",
+        "--driver-addrs", ",".join(driver_addrs), "--port", str(port),
+        "--host", host, "--slots", str(slots),
+    ]
+    if host in local_hostnames():
+        return inner
+    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+               "-o", "ConnectTimeout=10"]
+    if ssh_port:
+        ssh_cmd += ["-p", str(ssh_port)]
+    env = f"HOROVOD_PROBE_SECRET={shlex.quote(secret)}"
+    pypath = os.environ.get("PYTHONPATH", "")
+    if pypath:
+        env += f" PYTHONPATH={shlex.quote(pypath)}"
+    remote = (f"cd {shlex.quote(os.getcwd())} && env {env} "
+              + " ".join(shlex.quote(c) for c in inner))
+    return ssh_cmd + [host, remote]
+
+
+def preflight_probe(hosts: Sequence[object], ssh_port: Optional[int] = None,
+                    timeout: float = 30.0,
+                    exec_fn=None) -> Dict[str, object]:
+    """Probe every host before launch.  Returns
+    {"rendezvous_addr": <driver addr every host reached>,
+     "registrations": {host: {...}}}.  Raises RuntimeError naming
+    unreachable hosts.  `exec_fn(cmd, env)` spawns a probe process
+    (injectable for tests; defaults to subprocess.Popen)."""
+    secret = make_secret()
+    driver = DriverService(secret)
+    procs = []
+    errlogs: Dict[str, List[str]] = {}
+    try:
+        addrs = local_addresses()
+        hostnames = []
+        for h in hosts:
+            hostname = getattr(h, "hostname", h)
+            slots = getattr(h, "slots", 1)
+            hostnames.append(hostname)
+            cmd = _probe_command(hostname, addrs, driver.port, secret, slots,
+                                 ssh_port)
+            env = dict(os.environ)
+            env["HOROVOD_PROBE_SECRET"] = secret
+            if exec_fn is not None:
+                procs.append(exec_fn(cmd, env))
+            else:
+                proc = subprocess.Popen(
+                    cmd, env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE, text=True)
+                procs.append(proc)
+                # Drain stderr continuously: ssh banners/errors must neither
+                # fill the pipe (blocking the probe) nor vanish — they are
+                # the diagnosis when a host fails.
+                log = errlogs.setdefault(hostname, [])
+
+                def _drain(p=proc, log=log):
+                    for line in iter(p.stderr.readline, ""):
+                        log.append(line.rstrip())
+
+                threading.Thread(target=_drain, daemon=True).start()
+        try:
+            regs = driver.wait_for(hostnames, timeout)
+        except RuntimeError as exc:
+            detail = "; ".join(
+                f"{h}: {' | '.join(lines[-3:])}"
+                for h, lines in errlogs.items() if lines)
+            raise RuntimeError(
+                str(exc) + (f" [probe stderr: {detail}]" if detail else "")
+            ) from None
+        # The rendezvous interface must be routable from every host.
+        common = [a for a in addrs
+                  if all(a in r.get("reachable", [r.get("driver_addr")])
+                         for r in regs.values())]
+        rendezvous = common[0] if common else \
+            next(iter(regs.values()))["driver_addr"]
+        return {"rendezvous_addr": rendezvous, "registrations": regs}
+    finally:
+        driver.close()
+        for p in procs:
+            try:
+                if p.poll() is None:
+                    p.wait(timeout=5)
+            except Exception:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+
+
+def _main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="horovod_tpu task probe")
+    ap.add_argument("--driver-addrs", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--slots", type=int, default=1)
+    args = ap.parse_args()
+    secret = os.environ.get("HOROVOD_PROBE_SECRET", "")
+    return run_task_probe(args.driver_addrs.split(","), args.port, args.host,
+                          secret, args.slots)
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

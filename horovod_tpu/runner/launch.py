@@ -231,9 +231,18 @@ def _run(args: argparse.Namespace) -> int:
 
     rendezvous_addr = "127.0.0.1"
     if any(a["hostname"] not in local_hostnames() for a in assignments):
-        import socket as pysocket
+        # Pre-flight probe (reference: driver/task services, SURVEY.md §2.5):
+        # verify every host can exec us and find a mutually-routable
+        # interface; fail fast with host names instead of hanging the first
+        # collective.
+        from .driver_service import preflight_probe
 
-        rendezvous_addr = pysocket.gethostbyname(pysocket.gethostname())
+        probe = preflight_probe(hosts, ssh_port=args.ssh_port,
+                                timeout=args.start_timeout)
+        rendezvous_addr = probe["rendezvous_addr"]
+        if args.verbose:
+            print(f"pre-flight: all hosts reachable; rendezvous over "
+                  f"{rendezvous_addr}", file=sys.stderr)
     rendezvous_port = find_free_port(
         "0.0.0.0" if rendezvous_addr != "127.0.0.1" else "127.0.0.1")
 

@@ -66,7 +66,12 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
             s.bind(("", 0))
             port = s.getsockname()[1]
             s.close()
-            info = f"{host}:{port}"
+            # Advertise a routable IP: executor hostnames are not always
+            # resolvable from peers, and gethostbyname(hostname) maps to
+            # 127.0.1.1 on stock Debian — useless off-host.
+            from ..runner.driver_service import local_addresses
+
+            info = f"{local_addresses()[0]}:{port}"
         else:
             info = ""
         all_info = [i for i in ctx.allGather(info) if i]

@@ -263,11 +263,18 @@ def _autotune_worker(tmpdir):
     hvd.init(build_mesh=False)
     r = hvd.rank()
     # Push traffic for > 2 autotune windows (window_s = 2.0) so the
-    # hill-climber records at least one score line and proposes a move.
+    # optimizer records at least one score line and proposes a move.  Ranks
+    # agree on the stop iteration via a Min-allreduced flag — wall-clock
+    # loops diverge once autotuning stretches the cycle time.
     import time
     t0 = time.monotonic()
     i = 0
-    while time.monotonic() - t0 < 5.0:
+    while True:
+        cont = 1.0 if time.monotonic() - t0 < 5.0 else 0.0
+        flag = hvd.allreduce(np.array([cont], np.float32), op=hvd.Min,
+                             name=f"at.cont.{i}")
+        if float(np.asarray(flag)[0]) < 1.0:
+            break
         hvd.allreduce(np.ones(4096, np.float32), op=hvd.Sum,
                       name=f"at.{i}")
         i += 1
@@ -360,3 +367,47 @@ def _ring_np4_worker():
 
 def test_ring_collectives_np4():
     assert run(_ring_np4_worker, np=4) == [0, 1, 2, 3]
+
+
+def _stall_shutdown_worker():
+    """Stall-shutdown watchdog (reference: StallInspector + HOROVOD_STALL_
+    SHUTDOWN_TIME_SECONDS, core_api.cc FailAllOutstanding): rank 1 never
+    submits the second tensor; every rank's synchronize must raise
+    HorovodInternalError naming the stall, within the shutdown window."""
+    import os
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
+    os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "3"
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+
+    # A healthy collective first: the watchdog must not fire on live traffic.
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="ok")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    t0 = time.monotonic()
+    raised = False
+    try:
+        if r == 0:
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="stalled")
+        else:
+            # rank 1 never submits "stalled"; its next op arrives only after
+            # rank 0's watchdog has torn the job down.
+            time.sleep(8.0)
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="late")
+    except hvd.HorovodInternalError as exc:
+        raised = True
+        if r == 0:
+            assert "stall" in str(exc).lower(), exc
+    waited = time.monotonic() - t0
+    assert raised, f"rank {r}: expected HorovodInternalError"
+    assert waited < 15.0, f"rank {r}: stall shutdown took {waited:.1f}s"
+    hvd.shutdown()
+    return r
+
+
+def test_stall_shutdown_np2():
+    assert run(_stall_shutdown_worker, np=2) == [0, 1]

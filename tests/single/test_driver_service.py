@@ -1,0 +1,149 @@
+"""Pre-flight driver/task service tests (reference: test/single/test_run.py's
+service mocking pattern, SURVEY.md §4 item 3: launcher logic tested
+deterministically with mocked exec)."""
+
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from horovod_tpu.runner.driver_service import (
+    DriverService, _probe_command, local_addresses, preflight_probe,
+    run_task_probe)
+from horovod_tpu.runner.util import HostSlots, make_secret
+
+
+def test_local_addresses_nonempty():
+    addrs = local_addresses()
+    assert "127.0.0.1" in addrs
+    assert all(isinstance(a, str) for a in addrs)
+
+
+def test_task_registration_roundtrip():
+    """Task probe client against a live driver service, in process."""
+    secret = make_secret()
+    driver = DriverService(secret)
+    try:
+        rc = run_task_probe(["127.0.0.1"], driver.port, "hostA", secret,
+                            slots=4)
+        assert rc == 0
+        regs = driver.wait_for(["hostA"], timeout=5.0)
+        assert regs["hostA"]["slots"] == 4
+        assert regs["hostA"]["driver_addr"] == "127.0.0.1"
+        assert "127.0.0.1" in regs["hostA"]["reachable"]
+    finally:
+        driver.close()
+
+
+def test_unsigned_registration_rejected():
+    """A probe with the wrong secret must be ignored (HMAC-signed RPC)."""
+    secret = make_secret()
+    driver = DriverService(secret)
+    try:
+        rc = run_task_probe(["127.0.0.1"], driver.port, "evil",
+                            "wrong-secret")
+        assert rc != 0  # no valid ack comes back
+        with pytest.raises(RuntimeError, match="evil"):
+            driver.wait_for(["evil"], timeout=1.0)
+    finally:
+        driver.close()
+
+
+def test_probe_command_local_vs_ssh():
+    cmd_local = _probe_command("localhost", ["10.0.0.1"], 1234, "s", 2, None)
+    assert cmd_local[0] == sys.executable
+    assert "ssh" not in cmd_local
+
+    cmd_remote = _probe_command("nodeB", ["10.0.0.1", "10.0.0.2"], 1234,
+                                "s3cret", 2, 2222)
+    assert cmd_remote[0] == "ssh"
+    assert "-p" in cmd_remote and "2222" in cmd_remote
+    assert "nodeB" in cmd_remote
+    joined = " ".join(cmd_remote)
+    assert "HOROVOD_PROBE_SECRET=s3cret" in joined
+    assert "--driver-addrs 10.0.0.1,10.0.0.2" in joined
+
+
+def test_preflight_probe_mocked_exec():
+    """Full probe flow with exec mocked by in-process client threads."""
+    launched = []
+
+    def fake_exec(cmd, env):
+        launched.append(cmd)
+        # Parse the inner probe args out of the command we were given.
+        port = int(cmd[cmd.index("--port") + 1])
+        host = cmd[cmd.index("--host") + 1]
+        addrs = cmd[cmd.index("--driver-addrs") + 1].split(",")
+        secret = env["HOROVOD_PROBE_SECRET"]
+        t = threading.Thread(
+            target=run_task_probe, args=(addrs, port, host, secret))
+        t.start()
+
+        class P:
+            def poll(self):
+                return 0
+
+            def wait(self, timeout=None):
+                t.join(timeout)
+
+        return P()
+
+    result = preflight_probe(
+        [HostSlots("localhost", 2), HostSlots("127.0.0.1", 2)],
+        timeout=10.0, exec_fn=fake_exec)
+    assert len(launched) == 2
+    assert result["rendezvous_addr"] in local_addresses()
+    assert set(result["registrations"]) == {"localhost", "127.0.0.1"}
+
+
+def test_preflight_probe_names_dead_host():
+    """An unreachable host fails the launch fast, by name."""
+
+    def fake_exec(cmd, env):
+        if cmd[0] == "ssh":
+            # The dead remote host: ssh would hang/fail, so exec nothing.
+            pass
+        else:
+            host = cmd[cmd.index("--host") + 1]
+            port = int(cmd[cmd.index("--port") + 1])
+            addrs = cmd[cmd.index("--driver-addrs") + 1].split(",")
+            threading.Thread(target=run_task_probe,
+                             args=(addrs, port, host,
+                                   env["HOROVOD_PROBE_SECRET"])).start()
+
+        class P:
+            def poll(self):
+                return 0
+
+            def wait(self, timeout=None):
+                pass
+
+        return P()
+
+    with pytest.raises(RuntimeError) as exc:
+        preflight_probe([HostSlots("localhost", 1), HostSlots("deadnode", 1)],
+                        timeout=2.0, exec_fn=fake_exec)
+    assert "deadnode" in str(exc.value)
+    assert "localhost" in str(exc.value)  # the reachable set is named too
+
+
+def test_probe_subprocess_end_to_end():
+    """The real __main__ probe module as a subprocess against a live driver
+    (no ssh: localhost path)."""
+    secret = make_secret()
+    driver = DriverService(secret)
+    try:
+        cmd = _probe_command("localhost", ["127.0.0.1"], driver.port,
+                             secret, 1, None)
+        import os
+
+        env = dict(os.environ)
+        env["HOROVOD_PROBE_SECRET"] = secret
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        regs = driver.wait_for(["localhost"], timeout=5.0)
+        assert regs["localhost"]["host"] == "localhost"
+    finally:
+        driver.close()

@@ -117,6 +117,7 @@ class HorovodContext:
         self._entries: Dict[int, TensorEntry] = {}
         self._entries_lock = threading.Lock()
         self._inflight_names: set = set()
+        self._deferred: Dict[str, List[TensorEntry]] = {}
         self._handle_counter = itertools.count(1)
         self._noname_counter = itertools.count(0)
         self._shutdown = threading.Event()
@@ -214,13 +215,15 @@ class HorovodContext:
             orig_dtype=orig_dtype,
         )
         with self._entries_lock:
-            if name in self._inflight_names:
-                raise ValueError(
-                    f"a collective named {name!r} is already in flight; names must "
-                    "be unique among outstanding operations"
-                )
-            self._inflight_names.add(name)
             self._entries[handle] = entry
+            if name in self._inflight_names:
+                # Reference semantics: a second op with an in-flight name
+                # queues behind the first (the negotiation layer keys by
+                # name, so it is submitted once the first completes — safe
+                # because every rank orders instances the same way).
+                self._deferred.setdefault(name, []).append(entry)
+                return handle
+            self._inflight_names.add(name)
         self.core.enqueue(entry)
         return handle
 
@@ -240,7 +243,6 @@ class HorovodContext:
         entry.done.wait()
         with self._entries_lock:
             self._entries.pop(handle, None)
-            self._inflight_names.discard(entry.name)
         if entry.error is not None:
             raise HorovodInternalError(entry.error)
         result = entry.result
@@ -272,6 +274,23 @@ class HorovodContext:
                 for e in entries:
                     e.error = str(exc)
                     e.done.set()
+            self._release_names(entries)
+
+    def _release_names(self, entries: List[TensorEntry]) -> None:
+        """After a name's instance completes, submit its next queued
+        instance (duplicate-name queueing) or free the name."""
+        to_enqueue = []
+        with self._entries_lock:
+            for e in entries:
+                queued = self._deferred.get(e.name)
+                if queued:
+                    to_enqueue.append(queued.pop(0))
+                    if not queued:
+                        del self._deferred[e.name]
+                else:
+                    self._inflight_names.discard(e.name)
+        for nxt in to_enqueue:
+            self.core.enqueue(nxt)
 
     def _execute(self, resp: FusedResponse, entries: List[TensorEntry]) -> None:
         op = resp.op

@@ -64,10 +64,70 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    default=None)
     p.add_argument("--log-level", default=None)
     p.add_argument("--check-build", action="store_true")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file of launcher parameters (reference "
+                        "horovodrun --config-file layout); explicit CLI "
+                        "flags win over file values")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command")
     args = p.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(args, p,
+                           argv if argv is not None else sys.argv[1:])
     return args
+
+
+def _apply_config_file(args: argparse.Namespace,
+                       parser: argparse.ArgumentParser,
+                       argv: List[str]) -> None:
+    """Merge a YAML config file under explicit CLI flags (reference:
+    runner/launch.py parse_args' --config-file handling: file values fill
+    in, command line overrides)."""
+    import yaml
+
+    with open(args.config_file) as f:
+        cfg = yaml.safe_load(f) or {}
+    # Reference layout: flat keys plus nested timeline/autotune/stall-check.
+    flat = {
+        "verbose": cfg.get("verbose"),
+        "num_proc": cfg.get("num-proc", cfg.get("np")),
+        "hosts": cfg.get("hosts"),
+        "ssh_port": cfg.get("ssh-port"),
+        "start_timeout": cfg.get("start-timeout"),
+        "network_interface": cfg.get("network-interface"),
+        "fusion_threshold_mb": cfg.get("fusion-threshold-mb"),
+        "cycle_time_ms": cfg.get("cycle-time-ms"),
+        "cache_capacity": cfg.get("cache-capacity"),
+        "min_np": cfg.get("min-np"),
+        "max_np": cfg.get("max-np"),
+        "host_discovery_script": cfg.get("host-discovery-script"),
+        "slots_per_host": cfg.get("slots-per-host"),
+        "log_level": cfg.get("log-level"),
+    }
+    tl = cfg.get("timeline") or {}
+    flat["timeline_filename"] = tl.get("filename")
+    flat["timeline_mark_cycles"] = tl.get("mark-cycles")
+    at = cfg.get("autotune") or {}
+    flat["autotune"] = at.get("enabled")
+    flat["autotune_log_file"] = at.get("log-file")
+    sc = cfg.get("stall-check") or {}
+    flat["stall_check_disable"] = sc.get("disable")
+    flat["stall_check_warning_time_seconds"] = sc.get(
+        "warning-time-seconds")
+    # Only fill values the user did not pass on the command line.  Presence
+    # is detected from argv itself (comparing against parser defaults would
+    # let the file override an explicitly-passed default value).  Only the
+    # launcher's own flags — everything before the command remainder — are
+    # scanned, so flags inside the training command don't confuse it.
+    own_argv = argv[:len(argv) - len(args.command)]
+    explicit = set()
+    for action in parser._actions:
+        if any(opt in own_argv for opt in action.option_strings):
+            explicit.add(action.dest)
+    for key, value in flat.items():
+        if value is None or not hasattr(args, key) or key in explicit:
+            continue
+        setattr(args, key, value)
 
 
 def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:

@@ -18,7 +18,8 @@ import os
 import socket
 from typing import Any, Callable, List, Optional
 
-from .store import Store, LocalStore, FilesystemStore  # noqa: F401
+from .store import (Store, LocalStore, FilesystemStore,  # noqa: F401
+                    DBFSLocalStore, HDFSStore)
 
 
 def _require_pyspark():

@@ -1,0 +1,137 @@
+"""DataFrame materialization + sharded Parquet reading for estimators.
+
+Reference analogs (SURVEY.md §2.6): horovod/spark/common/util.py
+(prepare_data: DataFrame -> Parquet in the Store) and the Petastorm reader
+the Keras/Torch estimators train from.  The TPU build replaces Petastorm
+with a pyarrow row-group shard reader: row groups are assigned round-robin
+across ranks (the same unit Petastorm shards by), batches come out as numpy
+dicts ready for jnp.asarray, and readers never materialize the full dataset
+in memory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+def materialize_dataframe(df, store, run_id: str,
+                          partitions: Optional[int] = None) -> str:
+    """Write a DataFrame to Parquet under the store's train-data path.
+
+    Accepts a Spark DataFrame (uses ``df.write.parquet``, executed by the
+    cluster — the reference's prepare_data path) or a pandas DataFrame
+    (written locally via pyarrow; the local-mode test path).  Returns the
+    dataset directory.
+    """
+    from .store import HDFSStore
+
+    if isinstance(store, HDFSStore):
+        # The shard reader walks a mounted filesystem; training data must
+        # live somewhere workers can os.walk (local disk, NFS, the DBFS
+        # FUSE mount).  Checkpoints/metadata may still go to HDFS.
+        raise NotImplementedError(
+            "DataFrame materialization into HDFSStore is not supported: "
+            "workers read Parquet shards through the local filesystem. "
+            "Use a FilesystemStore/DBFSLocalStore on a shared mount for "
+            "train data (the Store for checkpoints can stay HDFS).")
+    path = store.get_train_data_path(run_id)
+    if hasattr(df, "write"):  # Spark DataFrame
+        writer = df.repartition(partitions).write if partitions else df.write
+        writer.mode("overwrite").parquet(path)
+        return path
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    table = pa.Table.from_pandas(df)
+    n_parts = partitions or 1
+    rows = table.num_rows
+    per = -(-rows // n_parts)
+    for i in range(n_parts):
+        chunk = table.slice(i * per, per)
+        if chunk.num_rows:
+            pq.write_table(chunk, os.path.join(path, f"part-{i:05d}.parquet"))
+    return path
+
+
+class ParquetShardReader:
+    """Iterate a rank's shard of a Parquet dataset in batches.
+
+    Row groups are assigned ``rank, rank+size, rank+2*size, ...`` over the
+    dataset's files in sorted order — deterministic, disjoint, and
+    balanced for similar-sized row groups (Petastorm's sharding unit).
+    """
+
+    def __init__(self, path: str, rank: int = 0, size: int = 1,
+                 batch_size: int = 32,
+                 columns: Optional[Sequence[str]] = None):
+        import pyarrow.parquet as pq
+
+        self._pq = pq
+        self.path = path
+        self.rank = rank
+        self.size = max(size, 1)
+        self.batch_size = batch_size
+        self.columns = list(columns) if columns else None
+        self._files = self._list_files(path)
+        if not self._files:
+            raise FileNotFoundError(f"no parquet files under {path}")
+        # Global row-group index: (file, local row-group id)
+        self._groups: List = []
+        for f in self._files:
+            md = pq.ParquetFile(f)
+            for g in range(md.num_row_groups):
+                self._groups.append((f, g))
+
+    @staticmethod
+    def _list_files(path: str) -> List[str]:
+        if os.path.isfile(path):
+            return [path]
+        out = []
+        for root, _, names in os.walk(path):
+            for n in sorted(names):
+                if n.endswith(".parquet"):
+                    out.append(os.path.join(root, n))
+        return sorted(out)
+
+    def __len__(self) -> int:
+        """Rows in this rank's shard."""
+        total = 0
+        for i, (f, g) in enumerate(self._groups):
+            if i % self.size == self.rank:
+                total += self._pq.ParquetFile(f).metadata.row_group(g).num_rows
+        return total
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield column-name -> numpy batches from this rank's row groups."""
+        pending: Optional[Dict[str, np.ndarray]] = None
+        for i, (f, g) in enumerate(self._groups):
+            if i % self.size != self.rank:
+                continue
+            table = self._pq.ParquetFile(f).read_row_group(
+                g, columns=self.columns)
+            cols = {name: _column_to_numpy(table.column(name))
+                    for name in table.column_names}
+            if pending is not None:
+                cols = {k: np.concatenate([pending[k], cols[k]])
+                        for k in cols}
+            n = len(next(iter(cols.values()))) if cols else 0
+            off = 0
+            while n - off >= self.batch_size:
+                yield {k: v[off:off + self.batch_size]
+                       for k, v in cols.items()}
+                off += self.batch_size
+            pending = {k: v[off:] for k, v in cols.items()} if off < n \
+                else None
+        if pending is not None and len(next(iter(pending.values()))):
+            yield pending
+
+
+def _column_to_numpy(col) -> np.ndarray:
+    arr = col.to_numpy(zero_copy_only=False)
+    if arr.dtype == object:  # list<...> columns: stack to a 2-D array
+        arr = np.stack([np.asarray(v) for v in arr])
+    return arr

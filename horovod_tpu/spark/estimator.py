@@ -40,7 +40,8 @@ class JaxEstimator:
                  batch_size: int = 32, epochs: int = 1,
                  store: Optional[Store] = None, backend: str = "local",
                  num_proc: Optional[int] = None, run_id: str = "run",
-                 seed: int = 0):
+                 seed: int = 0, feature_cols: Optional[list] = None,
+                 label_cols: Optional[list] = None):
         self.model = model
         self.loss = loss
         self.optimizer = optimizer
@@ -51,33 +52,88 @@ class JaxEstimator:
         self.num_proc = num_proc
         self.run_id = run_id
         self.seed = seed
+        self.feature_cols = feature_cols or ["features"]
+        self.label_cols = label_cols or ["label"]
 
     # -- training -----------------------------------------------------------
-    def fit(self, x: np.ndarray, y: np.ndarray) -> "JaxModel":
+    def fit(self, x, y: Optional[np.ndarray] = None) -> "JaxModel":
+        """Train.  Accepts (x, y) numpy arrays, or a single DataFrame
+        (Spark or pandas) carrying ``feature_cols``/``label_cols`` — the
+        DataFrame is materialized to Parquet in the Store and workers read
+        disjoint row-group shards (reference: estimator.fit(df) through
+        prepare_data + Petastorm)."""
+        if y is None and not isinstance(x, np.ndarray):
+            return self.fit_on_dataframe(x)
+        return self._fit_arrays(np.asarray(x), np.asarray(y))
+
+    def fit_on_dataframe(self, df) -> "JaxModel":
+        from .data import materialize_dataframe
+
+        # num_proc is pinned to 1 when unset: letting spark's default
+        # parallelism pick the worker count could exceed the partition
+        # count and leave ranks with empty shards.
+        n = self.num_proc or 1
+        self.num_proc = n
+        # 4x partitions per worker: round-robin row groups stay balanced
+        # even when group sizes vary.
+        path = materialize_dataframe(df, self.store, self.run_id,
+                                     partitions=4 * n)
+        return self.fit_on_parquet(path)
+
+    def fit_on_parquet(self, train_path: str) -> "JaxModel":
+        """Train from a materialized Parquet dataset (each worker reads its
+        own row-group shard; nothing is broadcast through the driver)."""
+        worker_args = (self.model, self.loss, self.optimizer, None, None,
+                       self.batch_size, self.epochs, self.seed,
+                       train_path, tuple(self.feature_cols),
+                       tuple(self.label_cols))
         if self.backend == "spark":
             from . import run as spark_run
 
-            params = spark_run(
-                _train_worker,
-                args=(self.model, self.loss, self.optimizer, x, y,
-                      self.batch_size, self.epochs, self.seed),
-                num_proc=self.num_proc)[0]
+            out = spark_run(_train_worker, args=worker_args,
+                            num_proc=self.num_proc)[0]
         else:
-            params = _train_worker(self.model, self.loss, self.optimizer,
-                                   x, y, self.batch_size, self.epochs,
-                                   self.seed)
+            out = _train_worker(*worker_args)
+        return self._finish(out)
+
+    def _fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "JaxModel":
+        worker_args = (self.model, self.loss, self.optimizer, x, y,
+                       self.batch_size, self.epochs, self.seed)
+        if self.backend == "spark":
+            from . import run as spark_run
+
+            out = spark_run(_train_worker, args=worker_args,
+                            num_proc=self.num_proc)[0]
+        else:
+            out = _train_worker(*worker_args)
+        return self._finish(out)
+
+    def _finish(self, out) -> "JaxModel":
+        params, history = out
         ckpt = self.store.get_checkpoint_path(self.run_id)
         self.store.write(ckpt, pickle.dumps(params))
-        return JaxModel(self.model, params)
+        import json
+
+        meta = {
+            "run_id": self.run_id,
+            "epochs": self.epochs,
+            "batch_size": self.batch_size,
+            "loss_history": [float(v) for v in history],
+            "model": type(self.model).__name__,
+        }
+        self.store.write(self.store.get_metadata_path(self.run_id),
+                         json.dumps(meta).encode())
+        return JaxModel(self.model, params, metadata=meta)
 
 
 class JaxModel:
     """Trained-model wrapper (reference: the estimators' *Model transformer
     returned by fit())."""
 
-    def __init__(self, model: Any, params: Any):
+    def __init__(self, model: Any, params: Any, metadata: Optional[dict] = None):
         self.model = model
         self.params = params
+        self.metadata = metadata or {}
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
@@ -92,9 +148,11 @@ class JaxModel:
 
 
 def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
-                  seed) -> Any:
-    """Per-worker training loop: shard by rank, DistributedOptimizer
-    averaging, return rank-0's params."""
+                  seed, train_path: Optional[str] = None,
+                  feature_cols: Tuple[str, ...] = ("features",),
+                  label_cols: Tuple[str, ...] = ("label",)) -> Any:
+    """Per-worker training loop: shard by rank (in-memory slices or Parquet
+    row groups), DistributedOptimizer averaging; returns (params, history)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -106,18 +164,41 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
         hvd.init(build_mesh=False)
     try:
         rank, size = hvd.rank(), hvd.size()
-        per_rank = len(x) // max(size, 1)
-        if per_rank == 0:
-            raise ValueError(
-                f"dataset of {len(x)} samples cannot be sharded over "
-                f"{size} workers")
-        # Trim to whole batches when possible; otherwise train on the full
-        # (smaller-than-batch) shard rather than silently skipping training.
-        n = per_rank // batch_size * batch_size or per_rank
-        xs = x[rank * per_rank:rank * per_rank + n]
-        ys = y[rank * per_rank:rank * per_rank + n]
 
-        params = model.init(jax.random.PRNGKey(seed), jnp.asarray(xs[:1]))
+        def epoch_batches():
+            if train_path is not None:
+                from .data import ParquetShardReader
+
+                reader = ParquetShardReader(train_path, rank, size,
+                                            batch_size)
+                for batch in reader.batches():
+                    bx = np.column_stack([batch[c] for c in feature_cols]) \
+                        if len(feature_cols) > 1 else batch[feature_cols[0]]
+                    by = np.column_stack([batch[c] for c in label_cols]) \
+                        if len(label_cols) > 1 else batch[label_cols[0]]
+                    yield bx, by
+                return
+            per_rank = len(x) // max(size, 1)
+            if per_rank == 0:
+                raise ValueError(
+                    f"dataset of {len(x)} samples cannot be sharded over "
+                    f"{size} workers")
+            # Trim to whole batches when possible; otherwise train on the
+            # full (smaller-than-batch) shard rather than skipping training.
+            n = per_rank // batch_size * batch_size or per_rank
+            xs = x[rank * per_rank:rank * per_rank + n]
+            ys = y[rank * per_rank:rank * per_rank + n]
+            for i in range(0, len(xs), batch_size):
+                yield xs[i:i + batch_size], ys[i:i + batch_size]
+
+        first = next(iter(epoch_batches()), None)
+        if first is None:
+            raise ValueError(
+                f"rank {rank}: empty training shard — the dataset has fewer "
+                f"row groups than workers; materialize with more partitions "
+                f"or reduce num_proc")
+        params = model.init(jax.random.PRNGKey(seed),
+                            jnp.asarray(first[0][:1]))
         params = hvd.broadcast_parameters(params, root_rank=0)
         tx = hvd.DistributedOptimizer(optimizer)
         opt_state = tx.init(params)
@@ -127,15 +208,34 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
             return jax.value_and_grad(
                 lambda q: loss_fn(model.apply(q, bx), by))(p)
 
-        for _ in range(epochs):
-            for i in range(0, len(xs), batch_size):
-                bx = jnp.asarray(xs[i:i + batch_size])
-                by = jnp.asarray(ys[i:i + batch_size])
-                _, grads = grads_fn(params, bx, by)
+        history = []
+        for epoch in range(epochs):
+            epoch_loss, nb = 0.0, 0
+            batches = epoch_batches()
+            step = 0
+            # Lockstep guard: Parquet shards may hold different batch
+            # counts per rank, and gradient averaging is collective — all
+            # ranks must agree per step whether to continue (the classic
+            # uneven-shard hang the reference solves with hvd.join()).
+            while True:
+                batch = next(batches, None)
+                cont = hvd.allreduce(
+                    np.array([1.0 if batch is not None else 0.0],
+                             np.float32),
+                    op=hvd.Min, name=f"est.cont.{epoch}.{step}")
+                if float(np.asarray(cont)[0]) < 1.0:
+                    break
+                bx, by = batch
+                loss, grads = grads_fn(params, jnp.asarray(bx),
+                                       jnp.asarray(by))
                 # Eager update: engages the core's fusion/negotiation path.
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-        return jax.device_get(params)
+                epoch_loss += float(loss)
+                nb += 1
+                step += 1
+            history.append(epoch_loss / max(nb, 1))
+        return jax.device_get(params), history
     finally:
         if owns_init:
             hvd.shutdown()

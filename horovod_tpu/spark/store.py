@@ -30,6 +30,14 @@ class Store:
     def get_logs_path(self, run_id: str) -> str:
         return os.path.join(self.prefix_path, run_id, "logs")
 
+    def get_train_data_path(self, run_id: str) -> str:
+        """Materialized-Parquet dataset directory (reference:
+        store.get_train_data_path consumed by Petastorm)."""
+        return os.path.join(self.prefix_path, run_id, "train_data")
+
+    def get_metadata_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, run_id, "metadata.json")
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -73,3 +81,90 @@ class LocalStore(FilesystemStore):
     def __init__(self, prefix_path: Optional[str] = None):
         super().__init__(prefix_path or os.path.join(
             os.getcwd(), ".horovod_tpu_store"))
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS through its local FUSE mount (reference:
+    store.DBFSLocalStore): ``dbfs:/...`` URLs translate to ``/dbfs/...``
+    paths and then behave like any local filesystem."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(self.normalize_path(prefix_path))
+
+    @staticmethod
+    def normalize_path(path: str) -> str:
+        if path.startswith("dbfs:///"):
+            return "/dbfs/" + path[len("dbfs:///"):]
+        if path.startswith("dbfs:/"):
+            return "/dbfs/" + path[len("dbfs:/"):]
+        return path
+
+
+class HDFSStore(Store):
+    """HDFS-backed store over pyarrow's Hadoop client (reference:
+    store.HDFSStore).  Requires a working libhdfs install; constructing it
+    without one raises with guidance rather than at import."""
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None):
+        url_host, url_port, path = self._parse_url(prefix_path)
+        super().__init__(path)
+        # An authority embedded in the URL wins over defaults — silently
+        # connecting to the default namenode while the caller named another
+        # cluster would route data to the wrong filesystem.
+        host = host or url_host or "default"
+        port = port if port is not None else (url_port or 0)
+        try:
+            from pyarrow import fs as pafs
+
+            self._fs = pafs.HadoopFileSystem(host=host, port=port, user=user)
+        except Exception as exc:
+            raise RuntimeError(
+                "HDFSStore requires pyarrow's HadoopFileSystem (libhdfs + "
+                "a Hadoop install); use FilesystemStore/DBFSLocalStore "
+                f"otherwise. Underlying error: {exc}") from exc
+
+    @staticmethod
+    def _parse_url(path: str):
+        """hdfs://host:port/path -> (host, port, /path); bare paths pass
+        through with no authority."""
+        if not path.startswith("hdfs://"):
+            return None, None, path
+        rest = path[len("hdfs://"):]
+        slash = rest.find("/")
+        authority, p = (rest[:slash], rest[slash:]) if slash >= 0 \
+            else (rest, "/")
+        if not authority:
+            return None, None, p
+        if ":" in authority:
+            h, prt = authority.rsplit(":", 1)
+            return h, int(prt), p
+        return authority, None, p
+
+    def exists(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        return self._fs.get_file_info(path).type != pafs.FileType.NotFound
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        self._fs.create_dir(os.path.dirname(path), recursive=True)
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)
+
+    def sync_fn(self, run_id: str):
+        target_root = os.path.join(self.prefix_path, run_id)
+
+        def _sync(local_dir: str) -> None:
+            for root, _, names in os.walk(local_dir):
+                rel = os.path.relpath(root, local_dir)
+                for n in names:
+                    dest = os.path.join(target_root, rel, n) if rel != "." \
+                        else os.path.join(target_root, n)
+                    with open(os.path.join(root, n), "rb") as f:
+                        self.write(dest, f.read())
+
+        return _sync

@@ -59,7 +59,9 @@ class _ActorHandle:
     def __init__(self, cls):
         import cloudpickle
 
-        ctx = mp.get_context("fork")
+        # spawn, not fork: pytest's process carries thread pools whose locks
+        # deadlock forked children.
+        ctx = mp.get_context("spawn")
         self._cmd_q = ctx.Queue()
         self._res_q = ctx.Queue()
         self._done = {}

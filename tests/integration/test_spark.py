@@ -50,7 +50,10 @@ class _FakeBarrierContext:
         return self._from_driver.get()
 
 
-def _partition_main(f, rank, to_driver, from_driver, results):
+def _partition_main(f_blob, rank, to_driver, from_driver, results):
+    import cloudpickle
+
+    f = cloudpickle.loads(f_blob)
     _FakeBarrierContext._current = _FakeBarrierContext(
         rank, to_driver, from_driver)
     try:
@@ -69,13 +72,18 @@ class _FakeBarrierRDD:
         return self
 
     def collect(self):
-        ctx = mp.get_context("fork")
+        import cloudpickle
+
+        # spawn, not fork: the pytest process is multi-threaded (pyarrow
+        # thread pools, driver-service servers), and forking it deadlocks.
+        ctx = mp.get_context("spawn")
         to_driver = ctx.Queue()
         from_driver = [ctx.Queue() for _ in range(self._n)]
         results = ctx.Queue()
+        f_blob = cloudpickle.dumps(self._f)
         procs = [
             ctx.Process(target=_partition_main,
-                        args=(self._f, r, to_driver, from_driver[r], results))
+                        args=(f_blob, r, to_driver, from_driver[r], results))
             for r in range(self._n)
         ]
         for p in procs:

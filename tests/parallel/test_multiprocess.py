@@ -411,3 +411,36 @@ def _stall_shutdown_worker():
 
 def test_stall_shutdown_np2():
     assert run(_stall_shutdown_worker, np=2) == [0, 1]
+
+
+def _duplicate_name_worker():
+    """Duplicate in-flight names queue behind each other (reference
+    semantics: the negotiation layer keys by name and processes instances
+    in submission order) instead of raising."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    h1 = hvd.allreduce_async(np.full(4, 1.0 + r, np.float32), op=hvd.Sum,
+                             name="dup")
+    h2 = hvd.allreduce_async(np.full(4, 10.0 + r, np.float32), op=hvd.Sum,
+                             name="dup")
+    h3 = hvd.allreduce_async(np.full(4, 100.0 + r, np.float32), op=hvd.Sum,
+                             name="dup")
+    np.testing.assert_allclose(hvd.synchronize(h1), 3.0)
+    np.testing.assert_allclose(hvd.synchronize(h2), 21.0)
+    np.testing.assert_allclose(hvd.synchronize(h3), 201.0)
+    # out-of-order synchronize also works
+    ha = hvd.allreduce_async(np.full(2, 1.0, np.float32), op=hvd.Sum,
+                             name="dup2")
+    hb = hvd.allreduce_async(np.full(2, 2.0, np.float32), op=hvd.Sum,
+                             name="dup2")
+    np.testing.assert_allclose(hvd.synchronize(hb), 4.0)
+    np.testing.assert_allclose(hvd.synchronize(ha), 2.0)
+    hvd.shutdown()
+    return r
+
+
+def test_duplicate_names_queue_np2():
+    assert run(_duplicate_name_worker, np=2) == [0, 1]

@@ -75,3 +75,42 @@ def test_signed_wire_messages():
 def test_host_hash_stable():
     assert host_hash() == host_hash()
     assert len(host_hash()) == 16
+
+
+def test_config_file_yaml(tmp_path):
+    """--config-file fills launcher params; explicit CLI flags win
+    (reference: horovodrun --config-file)."""
+    import textwrap
+
+    from horovod_tpu.runner.launch import parse_args
+
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text(textwrap.dedent("""
+        num-proc: 4
+        fusion-threshold-mb: 32
+        cycle-time-ms: 2.5
+        timeline:
+            filename: /tmp/tl.json
+            mark-cycles: true
+        autotune:
+            enabled: true
+            log-file: /tmp/at.csv
+        stall-check:
+            warning-time-seconds: 12
+    """))
+    args = parse_args(["--config-file", str(cfg), "python", "t.py"])
+    assert args.num_proc == 4
+    assert args.fusion_threshold_mb == 32
+    assert args.cycle_time_ms == 2.5
+    assert args.timeline_filename == "/tmp/tl.json"
+    assert args.timeline_mark_cycles is True
+    assert args.autotune is True
+    assert args.autotune_log_file == "/tmp/at.csv"
+    assert args.stall_check_warning_time_seconds == 12
+
+    # CLI beats file
+    args = parse_args(["--config-file", str(cfg), "-np", "2",
+                       "--cycle-time-ms", "9", "python", "t.py"])
+    assert args.num_proc == 2
+    assert args.cycle_time_ms == 9.0
+    assert args.fusion_threshold_mb == 32  # still from file

@@ -123,11 +123,15 @@ class TestEagerOps:
     def test_barrier(self):
         hvd.barrier()
 
-    def test_duplicate_inflight_name_raises(self):
-        h = hvd.allreduce_async(np.ones(2, np.float32), name="dup")
-        with pytest.raises(ValueError):
-            hvd.allreduce_async(np.ones(2, np.float32), name="dup")
-        hvd.synchronize(h)
+    def test_duplicate_inflight_names_queue(self):
+        # Reference semantics: same-name ops queue behind each other in
+        # submission order instead of raising.
+        h1 = hvd.allreduce_async(np.full(2, 1.0, np.float32), op=hvd.Sum,
+                                 name="dup")
+        h2 = hvd.allreduce_async(np.full(2, 5.0, np.float32), op=hvd.Sum,
+                                 name="dup")
+        np.testing.assert_allclose(hvd.synchronize(h1), 1.0)
+        np.testing.assert_allclose(hvd.synchronize(h2), 5.0)
 
     def test_compression_fp16(self):
         x = np.linspace(-1, 1, 64, dtype=np.float32)

@@ -26,7 +26,7 @@ from .mpi_ops import (  # noqa: F401
     broadcast, broadcast_, broadcast_async, broadcast_async_,
     alltoall, alltoall_async,
     reducescatter, reducescatter_async,
-    barrier, synchronize, poll,
+    barrier, join, synchronize, poll,
 )
 from .process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, global_process_set,

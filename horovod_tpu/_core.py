@@ -205,6 +205,8 @@ class NativeCore(CoreBackend):
             process_set_id=obj["psid"],
             handles=list(obj["handles"]),
             error=obj["error"] or None,
+            counts=obj.get("counts"),
+            last_joined=obj.get("last_joined", -1),
         )
 
     # -- process sets -------------------------------------------------------

@@ -118,6 +118,7 @@ class HorovodContext:
         self._entries_lock = threading.Lock()
         self._inflight_names: set = set()
         self._deferred: Dict[str, List[TensorEntry]] = {}
+        self._joined = False  # this rank called join() and awaits the rest
         self._handle_counter = itertools.count(1)
         self._noname_counter = itertools.count(0)
         self._shutdown = threading.Event()
@@ -263,6 +264,13 @@ class HorovodContext:
                     if e is not None:
                         entries.append(e)
             if not entries:
+                # Joined rank (hvd.join): no local tensors, but ring
+                # collectives need every member — participate with zeros.
+                if self._joined and not resp.error:
+                    try:
+                        self._participate_absent(resp)
+                    except Exception as exc:  # noqa: BLE001
+                        log.warning("zero-participation failed: %s", exc)
                 continue
             try:
                 if resp.error:
@@ -271,6 +279,11 @@ class HorovodContext:
                 for e in entries:
                     e.done.set()
             except Exception as exc:  # noqa: BLE001 - propagate via handle
+                if resp.op == OpType.JOIN:
+                    # A failed join (e.g. a peer shut down mid-join) must
+                    # not leave this rank zero-participating forever.
+                    with self._entries_lock:
+                        self._joined = False
                 for e in entries:
                     e.error = str(exc)
                     e.done.set()
@@ -309,8 +322,36 @@ class HorovodContext:
             self.core.barrier(psid)
             for e in entries:
                 e.result = e.array
+        elif op == OpType.JOIN:
+            # Completion of the join itself: every rank joined; no data
+            # moves.  The result is the last rank to join (reference:
+            # join() return value).
+            with self._entries_lock:
+                self._joined = False
+            for e in entries:
+                e.result = np.int64(resp.last_joined)
         else:
             raise HorovodInternalError(f"unsupported op {op}")
+
+    def _participate_absent(self, resp: FusedResponse) -> None:
+        """Walk a collective this rank submitted nothing for (it joined):
+        zero contribution for sum/average allreduce, plain participation
+        for barriers.  The coordinator guarantees only these op types become
+        ready while ranks are joined."""
+        psid = resp.process_set_id
+        if self.cfg.rank not in self.core.process_set_ranks(psid):
+            return
+        if resp.op == OpType.ALLREDUCE:
+            count = int(sum(resp.counts or []))
+            zeros = np.zeros(count, numpy_dtype(resp.dtype))
+            self.core.allreduce_buffer(zeros, psid, ReduceOp.SUM)
+        elif resp.op == OpType.BARRIER:
+            self.core.barrier(psid)
+        elif resp.op == OpType.JOIN:
+            pass  # our own join entry always exists locally
+        else:
+            raise HorovodInternalError(
+                f"op {resp.op} cannot proceed with joined ranks")
 
     def _ps_size(self, psid: int) -> int:
         return len(self.core.process_set_ranks(psid))

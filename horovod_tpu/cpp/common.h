@@ -116,6 +116,7 @@ struct Response {
   std::string error;                 // non-empty -> deliver failure
   bool cache_hit = false;
   int64_t seq = -1;  // global data-op sequence (tags data-plane frames)
+  int32_t last_joined = -1;  // JOIN responses: the last rank to join
 };
 
 struct CoreConfig {

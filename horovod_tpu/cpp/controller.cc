@@ -120,6 +120,10 @@ Status LocalController::Initialize() {
 Status LocalController::ComputeResponses(
     std::vector<TensorRequest>& new_requests, std::vector<Response>* out) {
   *out = FuseRequests(new_requests, cfg_.fusion_threshold);
+  for (auto& r : *out) {
+    // Single process: this rank is trivially the last (and only) joiner.
+    if (r.op == OpType::JOIN) r.last_joined = 0;
+  }
   return Status::OK();
 }
 

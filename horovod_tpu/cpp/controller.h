@@ -49,6 +49,11 @@ class Controller {
 
   virtual Status Initialize() = 0;
   virtual void Shutdown() {}
+  // Clean-exit notification sent before Shutdown(): workers tell the
+  // coordinator they are leaving, the coordinator tells the workers —
+  // turning teardown races into expected, quiet events (reference: the
+  // DONE/shutdown message in the reference's controller protocol).
+  virtual void Farewell() {}
 
   // One negotiation cycle: feed newly enqueued local requests, receive the
   // globally agreed (identical on all ranks) response list.

@@ -71,7 +71,9 @@ struct GlobalState {
 
   std::thread background;
   std::atomic<bool> shutdown{false};
+  std::atomic<bool> background_done{false};
   std::atomic<bool> aborted{false};
+  std::atomic<bool> join_inflight{false};
 
   Timeline timeline;
   ParameterManager params;
@@ -95,13 +97,26 @@ std::string ResponseToJson(const Response& r) {
   os << "{\"op\":" << static_cast<int>(r.op)
      << ",\"dtype\":" << static_cast<int>(r.dtype)
      << ",\"psid\":" << r.process_set_id << ",\"seq\":" << r.seq
-     << ",\"cache_hit\":" << (r.cache_hit ? 1 : 0) << ",\"error\":\""
+     << ",\"cache_hit\":" << (r.cache_hit ? 1 : 0)
+     << ",\"last_joined\":" << r.last_joined << ",\"error\":\""
      << JsonEscape(r.error) << "\",\"handles\":[";
   for (size_t i = 0; i < r.handles.size(); ++i) {
     if (i) os << ',';
     os << r.handles[i];
   }
-  os << "]}";
+  os << "]";
+  // Per-member element counts + reduce op: a joined rank has no local
+  // entries yet must still walk the ring with a zero buffer of the right
+  // size (hvd.join zero-contribution semantics).
+  if (!r.metas.empty()) {
+    os << ",\"counts\":[";
+    for (size_t i = 0; i < r.metas.size(); ++i) {
+      if (i) os << ',';
+      os << r.metas[i].nbytes / ItemSize(r.metas[i].dtype);
+    }
+    os << "]";
+  }
+  os << "}";
   return os.str();
 }
 
@@ -153,7 +168,23 @@ void BackgroundLoop() {
       if (g->shutdown.load()) break;
       g->aborted.store(true);
       SetLastError(s.reason);
-      HVD_LOG(ERROR) << "negotiation failed: " << s.reason;
+      auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+      if (sc && sc->peer_shutdown()) {
+        // Deliberate peer exit: only noteworthy if work was pending.
+        bool pending;
+        {
+          std::lock_guard<std::mutex> l(g->queue_mu);
+          pending = !g->outstanding.empty() || !newreqs.empty();
+        }
+        if (pending) {
+          HVD_LOG(WARNING) << "peer shut down with collectives pending: "
+                           << s.reason;
+        } else {
+          HVD_LOG(INFO) << s.reason;
+        }
+      } else {
+        HVD_LOG(ERROR) << "negotiation failed: " << s.reason;
+      }
       FailAllOutstanding("Horovod negotiation failed: " + s.reason);
       continue;
     }
@@ -174,14 +205,27 @@ void BackgroundLoop() {
     }
     for (const auto& r : responses) {
       if (!r.error.empty() && r.handles.empty()) {
-        // Errors that name no local tensors (e.g. response-cache divergence
-        // detected by the coordinator) would otherwise vanish: fail the
-        // whole job so every blocked synchronize() wakes with the reason.
-        g->aborted.store(true);
-        SetLastError(r.error);
-        HVD_LOG(ERROR) << "negotiation error: " << r.error;
-        FailAllOutstanding("Horovod negotiation error: " + r.error);
-      } else if (!r.handles.empty()) {
+        if (r.names.empty()) {
+          // Errors naming no tensor at all (response-cache divergence)
+          // would otherwise vanish: fail the whole job so every blocked
+          // synchronize() wakes with the reason.
+          g->aborted.store(true);
+          SetLastError(r.error);
+          HVD_LOG(ERROR) << "negotiation error: " << r.error;
+          FailAllOutstanding("Horovod negotiation error: " + r.error);
+        }
+        // else: a named-tensor error this rank never submitted (e.g. the
+        // join guard rejecting another rank's op) — the owning ranks get
+        // it on their handles; nothing to do here.
+      } else if (!r.handles.empty() || g->join_inflight.load()) {
+        // Handle-less non-error responses matter only to a rank with a
+        // join in flight: it holds no tensors for the collectives that
+        // keep flowing, yet must still walk the ring with zero
+        // contributions (the Python executor decides membership).  Without
+        // a local join, uninvolved ranks drop them in C++ as before.
+        if (r.op == OpType::JOIN && !r.handles.empty()) {
+          g->join_inflight.store(false);
+        }
         DeliverResponse(r);
       }
     }
@@ -233,6 +277,7 @@ void BackgroundLoop() {
       }
     }
   }
+  g->background_done.store(true);
 }
 
 }  // namespace
@@ -301,7 +346,22 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
 int hvd_shutdown() {
   if (g == nullptr) return -1;
   g->shutdown.store(true);
-  g->controller->Shutdown();
+  // Let the background loop finish its current cycle before touching the
+  // sockets (every rank replies every cycle, so this is normally bounded
+  // by the cycle time), then send the clean-exit notice — teardown stops
+  // looking like a peer crash on the other ranks.  If a peer has wedged
+  // (alive TCP, no frames), the loop stays blocked in recv: after a grace
+  // period force the sockets closed so shutdown always terminates.
+  double deadline = MonotonicSeconds() + 2.0;
+  while (!g->background_done.load() && MonotonicSeconds() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  if (g->background_done.load()) {
+    g->controller->Farewell();
+    g->controller->Shutdown();
+  } else {
+    g->controller->Shutdown();  // unblocks the recv; no farewell possible
+  }
   if (g->background.joinable()) g->background.join();
   FailAllOutstanding("Horovod has been shut down");
   g->timeline.Stop();
@@ -339,6 +399,7 @@ long long hvd_enqueue(long long handle, const char* name, int op, int dtype,
   r.postscale = postscale;
   if (splits && nsplits > 0) r.splits.assign(splits, splits + nsplits);
   r.enqueued_at = MonotonicSeconds();
+  if (r.op == OpType::JOIN) g->join_inflight.store(true);
   {
     std::lock_guard<std::mutex> l(g->queue_mu);
     if (g->outstanding.count(r.name)) return -2;  // duplicate in flight

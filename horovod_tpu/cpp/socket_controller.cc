@@ -167,6 +167,21 @@ Status SocketController::ConnectMesh(const std::vector<std::string>& addrs,
   return Status::OK();
 }
 
+void SocketController::Farewell() {
+  if (!initialized_ || aborted_) return;
+  Writer w;
+  w.PutI32(-1);  // BYE sentinel in the cycle-frame position
+  if (is_coordinator()) {
+    for (int rank = 1; rank < cfg_.size; ++rank) {
+      if (ctrl_socks_[rank].valid() && !departed_ranks_.count(rank)) {
+        ctrl_socks_[rank].SendFrame(w.data());
+      }
+    }
+  } else {
+    coord_ctrl_.SendFrame(w.data());  // best effort
+  }
+}
+
 void SocketController::Shutdown() {
   if (!initialized_) return;
   initialized_ = false;
@@ -191,6 +206,15 @@ Status SocketController::ComputeResponses(
 
 void SocketController::Announce(int rank, TensorRequest req,
                                 std::vector<Response>* errors) {
+  // hvd.join(): mark the rank as contributing zeros to every collective
+  // until all ranks have joined (reference: JoinOp / the joined-rank
+  // wildcard in ComputeResponseList).  The JOIN request itself still goes
+  // through the normal pending table (fixed name => ready when the last
+  // rank joins).
+  if (req.op == OpType::JOIN) {
+    joined_ranks_.insert(rank);
+    last_joined_ = rank;
+  }
   // Process-set registration happens on each rank's Python thread and may
   // race announcements arriving from faster ranks; an unknown process set
   // is therefore *deferred* (the tensor stays pending until the local
@@ -266,6 +290,7 @@ Status SocketController::CoordinatorCycle(
   // Own announcements first (deterministic: coordinator, then rank order).
   for (auto& r : new_requests) Announce(0, std::move(r), &errors);
   for (int rank = 1; rank < cfg_.size; ++rank) {
+    if (departed_ranks_.count(rank)) continue;
     std::string frame;
     if (!ctrl_socks_[rank].RecvFrame(&frame)) {
       aborted_ = true;
@@ -274,6 +299,11 @@ Status SocketController::CoordinatorCycle(
     }
     Reader rd(frame);
     int32_t n_cached = rd.GetI32();
+    if (n_cached == -1) {  // BYE: clean worker exit
+      departed_ranks_.insert(rank);
+      HVD_LOG(INFO) << "rank " << rank << " shut down cleanly";
+      continue;
+    }
     for (int32_t i = 0; i < n_cached; ++i) {
       int64_t id = rd.GetI64();
       TensorRequest req;
@@ -293,22 +323,80 @@ Status SocketController::CoordinatorCycle(
   }
 
   // Collect ready tensors in deterministic (arrival-order) sequence.
+  // Joined ranks (hvd.join) count as announced for every tensor — they
+  // will participate with zero contributions.
   std::vector<std::pair<int64_t, std::string>> ready_names;
+  std::vector<std::string> join_rejected;
   for (auto& kv : pending_) {
     std::vector<int> members;
     if (!process_sets_.Ranks(kv.second.meta.process_set_id, &members)) {
       continue;  // set not registered yet on this (coordinator) rank
     }
     bool ready = true;
+    bool via_join = false;
+    int departed = -1;
     for (int m : members) {
+      if (departed_ranks_.count(m)) {
+        departed = m;  // a member left: this tensor can never complete
+        break;
+      }
       if (!kv.second.announced.count(m)) {
+        if (kv.second.meta.op != OpType::JOIN && joined_ranks_.count(m)) {
+          via_join = true;
+          continue;
+        }
         ready = false;
         break;
       }
     }
-    if (ready) ready_names.emplace_back(kv.second.order, kv.first);
+    if (departed >= 0) {
+      Response e;
+      e.error = "tensor " + kv.first + " cannot complete: rank " +
+                std::to_string(departed) + " has shut down";
+      e.names.push_back(kv.first);
+      e.metas.push_back(kv.second.meta);
+      errors.push_back(std::move(e));
+      join_rejected.push_back(kv.first);
+      continue;
+    }
+    if (!ready) continue;
+    if (via_join) {
+      // Zero contribution only makes sense for summing allreduces and
+      // barriers (reference: Join supports allreduce/barrier; min/max/
+      // product and data-bearing gathers have no neutral element here).
+      const auto& meta = kv.second.meta;
+      bool allowed =
+          meta.op == OpType::BARRIER ||
+          (meta.op == OpType::ALLREDUCE &&
+           (meta.reduce_op == ReduceOp::SUM ||
+            meta.reduce_op == ReduceOp::AVERAGE));
+      if (!allowed) {
+        Response e;
+        e.error = "tensor " + kv.first +
+                  " became ready while some ranks had joined; only "
+                  "sum/average allreduce and barrier may proceed after "
+                  "hvd.join()";
+        e.names.push_back(kv.first);
+        e.metas.push_back(meta);
+        errors.push_back(std::move(e));
+        join_rejected.push_back(kv.first);
+        continue;
+      }
+    }
+    ready_names.emplace_back(kv.second.order, kv.first);
   }
+  for (const auto& name : join_rejected) pending_.erase(name);
   std::sort(ready_names.begin(), ready_names.end());
+  // JOIN completion must come after every via-join collective of the same
+  // cycle: once a rank's executor processes the JOIN it stops zero-
+  // participating, so a later-ordered via-join response would hang the
+  // ring.  The partition is deterministic, so all ranks stay identical.
+  std::stable_partition(
+      ready_names.begin(), ready_names.end(),
+      [this](const std::pair<int64_t, std::string>& p) {
+        auto it = pending_.find(p.second);
+        return it != pending_.end() && it->second.meta.op != OpType::JOIN;
+      });
   std::vector<TensorRequest> ready;
   ready.reserve(ready_names.size());
   for (auto& [ord, name] : ready_names) {
@@ -317,6 +405,14 @@ Status SocketController::CoordinatorCycle(
   }
 
   *out = FuseRequests(ready, cfg_.fusion_threshold);
+  for (auto& r : *out) {
+    if (r.op == OpType::JOIN) {
+      // Everyone joined: report the last joiner and reset join state.
+      r.last_joined = last_joined_;
+      joined_ranks_.clear();
+      last_joined_ = -1;
+    }
+  }
   out->insert(out->begin(), errors.begin(), errors.end());
   UpdateCachesAndSeq(out);
 
@@ -326,6 +422,7 @@ Status SocketController::CoordinatorCycle(
   for (const auto& r : *out) SerializeResponse(r, &w);
   const std::string payload = w.data();
   for (int rank = 1; rank < cfg_.size; ++rank) {
+    if (departed_ranks_.count(rank)) continue;
     if (!ctrl_socks_[rank].SendFrame(payload)) {
       aborted_ = true;
       return Status::Error(StatusCode::ABORTED,
@@ -365,6 +462,12 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   }
   Reader rd(frame);
   int32_t n = rd.GetI32();
+  if (n == -1) {  // coordinator farewell: the job is ending deliberately
+    peer_shutdown_ = true;
+    aborted_ = true;
+    return Status::Error(StatusCode::ABORTED,
+                         "coordinator shut down the job");
+  }
   out->clear();
   out->reserve(n);
   for (int32_t i = 0; i < n; ++i) out->push_back(DeserializeResponse(&rd));

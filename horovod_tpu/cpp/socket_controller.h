@@ -47,6 +47,9 @@ class SocketController : public Controller {
 
   Status Initialize() override;
   void Shutdown() override;
+  void Farewell() override;
+  // True when the peer ended the session deliberately (clean shutdown).
+  bool peer_shutdown() const { return peer_shutdown_; }
 
   Status ComputeResponses(std::vector<TensorRequest>& new_requests,
                           std::vector<Response>* out) override;
@@ -116,6 +119,10 @@ class SocketController : public Controller {
 
   ResponseCache cache_;
   std::map<std::string, Pending> pending_;  // coordinator only
+  std::set<int> joined_ranks_;              // hvd.join wildcard (coordinator)
+  std::set<int> departed_ranks_;            // clean-exited workers
+  int32_t last_joined_ = -1;
+  bool peer_shutdown_ = false;
   int64_t arrival_counter_ = 0;
   int64_t seq_counter_ = 0;   // global data-op sequence (all ranks agree)
   int64_t current_seq_ = -1;  // seq for the next data op on this rank

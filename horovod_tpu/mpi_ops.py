@@ -282,6 +282,22 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     ctx.synchronize(h)
 
 
+def join() -> int:
+    """Signal that this rank has no more collectives to submit; block until
+    every rank has joined, then return the last rank that joined
+    (reference: hvd.join in torch/mpi_ops.py — the uneven-batches
+    mechanism).  While this rank waits, other ranks' sum/average allreduces
+    and barriers proceed with a zero contribution from it; Average still
+    divides by the full process-set size, matching the reference's
+    documented join semantics."""
+    ctx = HorovodContext.instance()
+    with ctx._entries_lock:
+        ctx._joined = True
+    h = ctx.enqueue(np.zeros((), dtype=np.float32), OpType.JOIN,
+                    name="__join__")
+    return int(np.asarray(ctx.synchronize(h)))
+
+
 def synchronize(handle: int):
     """Block until the async op behind ``handle`` completes; return its
     result (reference: horovod/torch/mpi_ops.py synchronize)."""

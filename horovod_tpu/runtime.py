@@ -79,6 +79,11 @@ class FusedResponse:
     process_set_id: int
     handles: List[int]
     error: Optional[str] = None
+    # Zero-participation metadata (hvd.join): per-member element counts so
+    # a joined rank can walk the ring with zeros (the wire reduce op is
+    # always SUM for the ops allowed past a join).
+    counts: Optional[List[int]] = None
+    last_joined: int = -1
 
 
 class CoreBackend:
@@ -348,6 +353,9 @@ class PyLocalCore(CoreBackend):
                         dtype=e.dtype,
                         process_set_id=e.process_set_id,
                         handles=[e.handle],
+                        # single process: this rank is trivially the last
+                        # (and only) joiner
+                        last_joined=0 if e.op == OpType.JOIN else -1,
                     )
                 )
         flush()

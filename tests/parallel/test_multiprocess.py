@@ -444,3 +444,48 @@ def _duplicate_name_worker():
 
 def test_duplicate_names_queue_np2():
     assert run(_duplicate_name_worker, np=2) == [0, 1]
+
+
+def _join_worker():
+    """hvd.join() with uneven step counts (reference: torch join tests):
+    rank r runs r+1 allreduce steps then joins; later steps sum only the
+    still-active ranks (joined ranks contribute zeros), and every rank's
+    join() returns the last rank to join."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    assert s == 3
+    # step k is executed by ranks with r >= k; value contributed: r + 1
+    for k in range(r + 1):
+        out = hvd.allreduce(np.full(4, float(r + 1), np.float32),
+                            op=hvd.Sum, name=f"join.step{k}")
+        expected = sum(rr + 1 for rr in range(s) if rr >= k)
+        np.testing.assert_allclose(np.asarray(out), expected, err_msg=f"step{k}")
+    last = hvd.join()
+    assert last == 2, last
+
+    # the runtime is healthy after a join round: a fresh collective works
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="post.join")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+    # ops with no zero-neutral element fail cleanly while ranks are joined
+    if r == 0:
+        hvd.join()
+        raised = True  # rank 0 submits nothing; join returns when others do
+    else:
+        try:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Min,
+                          name="join.min")
+            raised = False
+        except hvd.HorovodInternalError as exc:
+            raised = "join" in str(exc).lower()
+        hvd.join()
+    assert raised
+    hvd.shutdown()
+    return r
+
+
+def test_join_np3():
+    assert run(_join_worker, np=3) == [0, 1, 2]

@@ -133,6 +133,11 @@ class TestEagerOps:
         np.testing.assert_allclose(hvd.synchronize(h1), 1.0)
         np.testing.assert_allclose(hvd.synchronize(h2), 5.0)
 
+
+    def test_join_single_process(self):
+        # Single process: join returns immediately with rank 0 as last.
+        assert hvd.join() == 0
+
     def test_compression_fp16(self):
         x = np.linspace(-1, 1, 64, dtype=np.float32)
         out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16,

@@ -215,6 +215,22 @@ void SocketController::Announce(int rank, TensorRequest req,
     joined_ranks_.insert(rank);
     last_joined_ = rank;
   }
+  // A name the coordinator recently failed: this rank missed the error
+  // (it had not announced yet) — deliver it now instead of letting the
+  // fresh pending entry wait forever on ranks that already moved on.
+  auto tomb = error_tombstones_.find(req.name);
+  if (tomb != error_tombstones_.end()) {
+    if (MonotonicSeconds() < tomb->second.second) {
+      Response e;
+      e.op = req.op;
+      e.error = tomb->second.first;
+      e.names.push_back(req.name);
+      e.metas.push_back(req);
+      errors->push_back(std::move(e));
+      return;
+    }
+    error_tombstones_.erase(tomb);
+  }
   // Process-set registration happens on each rank's Python thread and may
   // race announcements arriving from faster ranks; an unknown process set
   // is therefore *deferred* (the tensor stays pending until the local
@@ -224,6 +240,7 @@ void SocketController::Announce(int rank, TensorRequest req,
   if (process_sets_.Ranks(req.process_set_id, &members) &&
       !std::binary_search(members.begin(), members.end(), rank)) {
     Response e;
+    e.op = req.op;
     e.error = "rank " + std::to_string(rank) +
               " is not in process set of tensor " + req.name;
     e.names.push_back(req.name);
@@ -273,11 +290,13 @@ void SocketController::Announce(int rank, TensorRequest req,
   }
   if (!mismatch.empty()) {
     Response e;
+    e.op = req.op;
     e.error = "Mismatched " + mismatch + " for tensor " + req.name +
               " across ranks";
     e.names.push_back(req.name);
     e.metas.push_back(p.meta);
     errors->push_back(std::move(e));
+    error_tombstones_[req.name] = {e.error, MonotonicSeconds() + 60.0};
     pending_.erase(it);
     return;
   }
@@ -351,12 +370,20 @@ Status SocketController::CoordinatorCycle(
     }
     if (departed >= 0) {
       Response e;
+      e.op = kv.second.meta.op;
       e.error = "tensor " + kv.first + " cannot complete: rank " +
                 std::to_string(departed) + " has shut down";
       e.names.push_back(kv.first);
       e.metas.push_back(kv.second.meta);
+      error_tombstones_[kv.first] = {e.error, MonotonicSeconds() + 60.0};
       errors.push_back(std::move(e));
       join_rejected.push_back(kv.first);
+      if (kv.second.meta.op == OpType::JOIN) {
+        // The join round is dead: forget who joined, or stragglers would
+        // keep zero-filling for ranks that think they aborted.
+        joined_ranks_.clear();
+        last_joined_ = -1;
+      }
       continue;
     }
     if (!ready) continue;
@@ -372,12 +399,14 @@ Status SocketController::CoordinatorCycle(
             meta.reduce_op == ReduceOp::AVERAGE));
       if (!allowed) {
         Response e;
+        e.op = meta.op;
         e.error = "tensor " + kv.first +
                   " became ready while some ranks had joined; only "
                   "sum/average allreduce and barrier may proceed after "
                   "hvd.join()";
         e.names.push_back(kv.first);
         e.metas.push_back(meta);
+        error_tombstones_[kv.first] = {e.error, MonotonicSeconds() + 60.0};
         errors.push_back(std::move(e));
         join_rejected.push_back(kv.first);
         continue;

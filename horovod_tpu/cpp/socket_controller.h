@@ -119,6 +119,10 @@ class SocketController : public Controller {
 
   ResponseCache cache_;
   std::map<std::string, Pending> pending_;  // coordinator only
+  // Names recently failed by the coordinator: a straggler announcing one
+  // later gets the error immediately instead of waiting forever on ranks
+  // that already saw the failure.  Values: (error text, expiry time).
+  std::map<std::string, std::pair<std::string, double>> error_tombstones_;
   std::set<int> joined_ranks_;              // hvd.join wildcard (coordinator)
   std::set<int> departed_ranks_;            // clean-exited workers
   int32_t last_joined_ = -1;

@@ -433,8 +433,12 @@ class ElasticDriver:
 
 def run_elastic(args, command: List[str]) -> int:
     """Entry from the launcher CLI (reference: launch.py _run_elastic)."""
-    if args.host_discovery_script:
-        discovery: HostDiscovery = HostDiscoveryScript(
+    if getattr(args, "tpu_discovery", False):
+        from .tpu_discovery import TPUPodDiscovery
+
+        discovery: HostDiscovery = TPUPodDiscovery(args.slots_per_host)
+    elif args.host_discovery_script:
+        discovery = HostDiscoveryScript(
             args.host_discovery_script, args.slots_per_host)
     elif args.hosts:
         from .util import parse_hosts

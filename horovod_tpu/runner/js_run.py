@@ -1,0 +1,115 @@
+"""LSF / jsrun launch path.
+
+Reference: horovod/runner/js_run.py (js_run) + runner/util/lsf.py
+(LSFUtils) — on LSF-scheduled clusters `horovodrun` delegates process
+placement to `jsrun` instead of ssh.  The TPU build keeps the same shape:
+detect an LSF allocation from its environment, derive hosts/slots from
+LSB_MCPU_HOSTS, and build the `jsrun` command line that launches one
+resource set per worker with the usual HOROVOD_* env contract.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+from typing import Dict, List, Optional
+
+
+class LSFUtils:
+    """Queries over the LSF allocation environment (reference:
+    runner/util/lsf.py LSFUtils)."""
+
+    @staticmethod
+    def using_lsf() -> bool:
+        return "LSB_JOBID" in os.environ
+
+    @staticmethod
+    def get_allocated_hosts(env: Optional[Dict[str, str]] = None
+                            ) -> List[tuple]:
+        """Parse LSB_MCPU_HOSTS ('host1 ncpu1 host2 ncpu2 ...') into
+        [(host, slots)], skipping the launch node's batch slot."""
+        env = env if env is not None else os.environ
+        toks = env.get("LSB_MCPU_HOSTS", "").split()
+        pairs = [(toks[i], int(toks[i + 1]))
+                 for i in range(0, len(toks) - 1, 2)]
+        # The first entry is the batch/launch node with one slot when
+        # compute hosts follow (LSF's usual bsub layout) — skip it.
+        if len(pairs) > 1 and pairs[0][1] == 1:
+            pairs = pairs[1:]
+        return pairs
+
+    @staticmethod
+    def get_num_processes(env: Optional[Dict[str, str]] = None) -> int:
+        return sum(n for _, n in LSFUtils.get_allocated_hosts(env))
+
+
+def make_jsrun_command(num_proc: int, command: List[str],
+                      env: Dict[str, str],
+                      gpu_per_rs: int = 0,
+                      launch_args: str = "") -> List[str]:
+    """Build the jsrun invocation (reference: js_run.py js_run):
+    one resource set per worker, one task each, env forwarded."""
+    cmd = [
+        "jsrun",
+        "--nrs", str(num_proc),        # resource sets == workers
+        "--tasks_per_rs", "1",
+        "--cpu_per_rs", "ALL_CPUS" if num_proc == 1 else "1",
+        "--launch_distribution", "packed",
+    ]
+    if gpu_per_rs:
+        cmd += ["--gpu_per_rs", str(gpu_per_rs)]
+    if launch_args:
+        cmd += shlex.split(launch_args)
+    env_str = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env.items()
+        if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_")))
+    wrapped = "env " + env_str + " " + \
+        " ".join(shlex.quote(c) for c in command)
+    cmd += ["sh", "-c", wrapped]
+    return cmd
+
+
+def js_run(args, command: List[str]) -> int:
+    """Launch a job through jsrun inside an LSF allocation.  Rank/size come
+    from jsrun's own placement (OMPI_COMM_WORLD_RANK et al. are translated
+    by the worker-side env shim below)."""
+    import random
+    import subprocess
+
+    num_proc = args.num_proc or LSFUtils.get_num_processes()
+    # The coordinator is rank 0's worker process, which jsrun's packed
+    # distribution places on the FIRST allocated compute host — not the
+    # batch node this launcher runs on.  Advertise that host, with a port
+    # picked from the dynamic range (it cannot be probed remotely; the
+    # coordinator binds it and workers retry until it listens).
+    hosts = LSFUtils.get_allocated_hosts()
+    addr = hosts[0][0] if hosts else "127.0.0.1"
+    port = random.randint(23000, 59000)
+    env = dict(os.environ)
+    env.update({
+        "HOROVOD_SIZE": str(num_proc),
+        "HOROVOD_CONTROLLER": "socket",
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+        # jsrun assigns ranks; the worker shim maps them to HOROVOD_RANK
+        "HOROVOD_RANK_FROM_JSRUN": "1",
+    })
+    cmd = make_jsrun_command(num_proc, command, env)
+    return subprocess.call(cmd, env=env)
+
+
+def apply_jsrun_rank_env() -> None:
+    """Worker-side shim: translate jsrun/OpenMPI rank env into the
+    HOROVOD_* contract (called from Config.from_env when
+    HOROVOD_RANK_FROM_JSRUN is set)."""
+    if os.environ.get("HOROVOD_RANK_FROM_JSRUN") != "1":
+        return
+    for src, dst in (
+        ("OMPI_COMM_WORLD_RANK", "HOROVOD_RANK"),
+        ("OMPI_COMM_WORLD_LOCAL_RANK", "HOROVOD_LOCAL_RANK"),
+        ("OMPI_COMM_WORLD_LOCAL_SIZE", "HOROVOD_LOCAL_SIZE"),
+        ("JSM_NAMESPACE_RANK", "HOROVOD_RANK"),
+        ("JSM_NAMESPACE_LOCAL_RANK", "HOROVOD_LOCAL_RANK"),
+    ):
+        if src in os.environ and dst not in os.environ:
+            os.environ[dst] = os.environ[src]

@@ -49,6 +49,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--tpu-discovery", action="store_true",
+                   help="elastic host discovery from the TPU-VM metadata "
+                        "server (worker endpoints + preemption events) "
+                        "instead of a discovery script")
     p.add_argument("--slots-per-host", type=int, default=1,
                    help="slots per discovered host (elastic mode)")
     # Tuning flags mirroring the reference CLI -> env contract.
@@ -277,10 +281,17 @@ def _run(args: argparse.Namespace) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
-    if args.host_discovery_script or args.min_np is not None:
+    if args.host_discovery_script or args.tpu_discovery \
+            or args.min_np is not None:
         from .elastic_driver import run_elastic
 
         return run_elastic(args, command)
+    # LSF allocation without explicit hosts: delegate placement to jsrun
+    # (reference: launch.py routes to js_run on LSF clusters).
+    from .js_run import LSFUtils, js_run
+
+    if args.hosts is None and LSFUtils.using_lsf():
+        return js_run(args, command)
     if args.num_proc is None:
         print("error: -np is required", file=sys.stderr)
         return 2

@@ -1,0 +1,87 @@
+"""TPU-pod host discovery for elastic training.
+
+Reference analog (SURVEY.md §3.5, §5): the reference's elastic driver polls
+a user discovery script for the live host set; on TPU pods the equivalent
+signal lives in the GCE metadata server — the worker endpoint list from the
+TPU environment attributes, and per-host preemption / maintenance events.
+``TPUPodDiscovery`` is a ``HostDiscovery`` that serves exactly that, so
+``horovodrun --min-np N --tpu-discovery`` rides preemptions the way the
+reference rides discovery-script changes (BASELINE config 5).
+
+The metadata base URL is overridable (HOROVOD_TPU_METADATA_URL) which is
+also how the tests drive it against a local fake server.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.request
+from typing import Dict, Optional
+
+from .elastic_driver import HostDiscovery
+
+_DEFAULT_METADATA = "http://metadata.google.internal"
+_HEADERS = {"Metadata-Flavor": "Google"}
+
+
+def _get(base: str, path: str, timeout: float = 2.0) -> Optional[str]:
+    req = urllib.request.Request(base + path, headers=_HEADERS)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode().strip()
+    except Exception:
+        return None
+
+
+class TPUPodDiscovery(HostDiscovery):
+    """Live host set of a TPU pod from the metadata server.
+
+    Worker endpoints come from the TPU environment attribute
+    (``tpu-env`` -> WORKER_NETWORK_ENDPOINTS, the canonical source on TPU
+    VMs) or, when absent, ``HOROVOD_TPU_WORKERS`` (comma-separated) as the
+    static fallback.  A host is dropped while the metadata server reports
+    it preempted or under a TERMINATE maintenance event.
+    """
+
+    def __init__(self, slots_per_host: int = 1,
+                 metadata_url: Optional[str] = None):
+        self.slots = max(slots_per_host, 1)
+        self.base = (metadata_url
+                     or os.environ.get("HOROVOD_TPU_METADATA_URL")
+                     or _DEFAULT_METADATA)
+
+    # -- worker set ---------------------------------------------------------
+    def _workers(self) -> list:
+        env_workers = os.environ.get("HOROVOD_TPU_WORKERS")
+        if env_workers:
+            return [w.strip() for w in env_workers.split(",") if w.strip()]
+        tpu_env = _get(self.base, "/computeMetadata/v1/instance/attributes/"
+                                  "tpu-env")
+        if tpu_env:
+            for line in tpu_env.splitlines():
+                if line.startswith("WORKER_NETWORK_ENDPOINTS"):
+                    # format: 'WORKER_NETWORK_ENDPOINTS: ip1,ip2,...'
+                    # (each endpoint may be id:port:ip — take the last part)
+                    _, _, value = line.partition(":")
+                    out = []
+                    for ep in value.strip().strip("'\"").split(","):
+                        ep = ep.strip()
+                        if ep:
+                            out.append(ep.rsplit(":", 1)[-1])
+                    return out
+        return []
+
+    def _host_healthy(self, host: str) -> bool:
+        state = _get(self.base, f"/computeMetadata/v1/instance/preempted"
+                               f"?host={host}")
+        if state is not None and state.upper() == "TRUE":
+            return False
+        maint = _get(self.base, f"/computeMetadata/v1/instance/"
+                               f"maintenance-event?host={host}")
+        if maint is not None and maint.upper().startswith("TERMINATE"):
+            return False
+        return True
+
+    def find_available_hosts(self) -> Dict[str, int]:
+        return {h: self.slots for h in self._workers()
+                if self._host_healthy(h)}

@@ -111,6 +111,12 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         env = os.environ
+        if env.get("HOROVOD_RANK_FROM_JSRUN") == "1":
+            # jsrun-placed workers carry OpenMPI/JSM rank env instead of
+            # HOROVOD_RANK (reference: js_run's worker-side env mapping).
+            from ..runner.js_run import apply_jsrun_rank_env
+
+            apply_jsrun_rank_env()
         return Config(
             rank=get_int("HOROVOD_RANK", 0),
             size=get_int("HOROVOD_SIZE", 1),

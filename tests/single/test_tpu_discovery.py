@@ -1,0 +1,90 @@
+"""TPU-pod elastic discovery against a fake metadata server (reference
+pattern: elastic discovery driven by controllable test doubles, SURVEY.md
+§4 item 2 — here the 'discovery script' is the GCE metadata API)."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from horovod_tpu.runner.tpu_discovery import TPUPodDiscovery
+
+
+class _FakeMetadata(BaseHTTPRequestHandler):
+    tpu_env = ("ACCELERATOR_TYPE: 'v5p-16'\n"
+               "WORKER_NETWORK_ENDPOINTS: '0:8470:10.0.0.1,"
+               "1:8470:10.0.0.2,2:8470:10.0.0.3'\n")
+    preempted = set()
+    maintenance = {}
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_response(403)
+            self.end_headers()
+            return
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        host = q.get("host", [""])[0]
+        if url.path.endswith("/attributes/tpu-env"):
+            body = self.tpu_env
+        elif url.path.endswith("/instance/preempted"):
+            body = "TRUE" if host in self.preempted else "FALSE"
+        elif url.path.endswith("/maintenance-event"):
+            body = self.maintenance.get(host, "NONE")
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def metadata_server():
+    _FakeMetadata.preempted = set()
+    _FakeMetadata.maintenance = {}
+    srv = HTTPServer(("127.0.0.1", 0), _FakeMetadata)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_discovers_pod_workers(metadata_server):
+    disc = TPUPodDiscovery(slots_per_host=4, metadata_url=metadata_server)
+    assert disc.find_available_hosts() == {
+        "10.0.0.1": 4, "10.0.0.2": 4, "10.0.0.3": 4}
+
+
+def test_preempted_host_dropped(metadata_server):
+    disc = TPUPodDiscovery(metadata_url=metadata_server)
+    _FakeMetadata.preempted = {"10.0.0.2"}
+    assert set(disc.find_available_hosts()) == {"10.0.0.1", "10.0.0.3"}
+    # preemption clears (host replaced): it returns
+    _FakeMetadata.preempted = set()
+    assert set(disc.find_available_hosts()) == {
+        "10.0.0.1", "10.0.0.2", "10.0.0.3"}
+
+
+def test_terminate_maintenance_dropped(metadata_server):
+    disc = TPUPodDiscovery(metadata_url=metadata_server)
+    _FakeMetadata.maintenance = {"10.0.0.3": "TERMINATE_ON_HOST_MAINTENANCE"}
+    assert set(disc.find_available_hosts()) == {"10.0.0.1", "10.0.0.2"}
+
+
+def test_env_worker_fallback(metadata_server, monkeypatch):
+    monkeypatch.setenv("HOROVOD_TPU_WORKERS", "hostA,hostB")
+    disc = TPUPodDiscovery(slots_per_host=2, metadata_url=metadata_server)
+    assert disc.find_available_hosts() == {"hostA": 2, "hostB": 2}
+
+
+def test_unreachable_metadata_returns_empty():
+    disc = TPUPodDiscovery(metadata_url="http://127.0.0.1:1")  # nothing there
+    assert disc.find_available_hosts() == {}

@@ -144,6 +144,22 @@ def stop_timeline() -> None:
     HorovodContext.instance().core.stop_timeline()
 
 
+def start_device_trace(logdir: str) -> None:
+    """Start the XLA profiler (TensorBoard trace) — the on-device half of
+    observability: the host timeline covers NEGOTIATE/data-plane phases,
+    this covers the compiled XLA programs on the chip (SURVEY.md §5:
+    timeline hand-off into jax.profiler)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
 # -- build-configuration queries (reference API parity) ---------------------
 
 def mpi_threads_supported() -> bool:

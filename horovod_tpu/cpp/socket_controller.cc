@@ -206,6 +206,27 @@ Status SocketController::ComputeResponses(
 
 void SocketController::Announce(int rank, TensorRequest req,
                                 std::vector<Response>* errors) {
+  // A name the coordinator recently failed: a rank still owed that error
+  // (it had not announced when the failure was emitted) gets it now
+  // instead of forming a pending entry that waits forever on ranks that
+  // already moved on.  Ranks that have seen the error and announce the
+  // name again are fresh, consistent resubmissions and fall through to
+  // the normal path.  This check runs before any join bookkeeping so a
+  // dead join round cannot re-register the announcer as joined.
+  auto tomb = error_tombstones_.find(req.name);
+  if (tomb != error_tombstones_.end() &&
+      MonotonicSeconds() < tomb->second.expiry &&
+      tomb->second.owed.count(rank)) {
+    Response e;
+    e.op = req.op;
+    e.error = tomb->second.error;
+    e.names.push_back(req.name);
+    e.metas.push_back(req);
+    errors->push_back(std::move(e));
+    tomb->second.owed.erase(rank);
+    if (tomb->second.owed.empty()) error_tombstones_.erase(tomb);
+    return;
+  }
   // hvd.join(): mark the rank as contributing zeros to every collective
   // until all ranks have joined (reference: JoinOp / the joined-rank
   // wildcard in ComputeResponseList).  The JOIN request itself still goes
@@ -214,22 +235,6 @@ void SocketController::Announce(int rank, TensorRequest req,
   if (req.op == OpType::JOIN) {
     joined_ranks_.insert(rank);
     last_joined_ = rank;
-  }
-  // A name the coordinator recently failed: this rank missed the error
-  // (it had not announced yet) — deliver it now instead of letting the
-  // fresh pending entry wait forever on ranks that already moved on.
-  auto tomb = error_tombstones_.find(req.name);
-  if (tomb != error_tombstones_.end()) {
-    if (MonotonicSeconds() < tomb->second.second) {
-      Response e;
-      e.op = req.op;
-      e.error = tomb->second.first;
-      e.names.push_back(req.name);
-      e.metas.push_back(req);
-      errors->push_back(std::move(e));
-      return;
-    }
-    error_tombstones_.erase(tomb);
   }
   // Process-set registration happens on each rank's Python thread and may
   // race announcements arriving from faster ranks; an unknown process set
@@ -295,17 +300,44 @@ void SocketController::Announce(int rank, TensorRequest req,
               " across ranks";
     e.names.push_back(req.name);
     e.metas.push_back(p.meta);
+    AddTombstone(req.name, e.error, p.announced);
     errors->push_back(std::move(e));
-    error_tombstones_[req.name] = {e.error, MonotonicSeconds() + 60.0};
     pending_.erase(it);
     return;
   }
   p.announced.insert(rank);
 }
 
+void SocketController::AddTombstone(const std::string& name,
+                                    const std::string& error,
+                                    const std::set<int>& already_informed) {
+  std::vector<int> members;
+  // Owed = process-set members that had not announced when the error was
+  // emitted (their announce may still be in flight, or they may be
+  // stragglers).  Ranks that announced get the error via their handles.
+  auto it = pending_.find(name);
+  int psid = it != pending_.end() ? it->second.meta.process_set_id : 0;
+  if (!process_sets_.Ranks(psid, &members)) return;
+  Tombstone t;
+  t.error = error;
+  t.expiry = MonotonicSeconds() + 60.0;
+  for (int m : members) {
+    if (!already_informed.count(m)) t.owed.insert(m);
+  }
+  if (!t.owed.empty()) error_tombstones_[name] = std::move(t);
+}
+
 Status SocketController::CoordinatorCycle(
     std::vector<TensorRequest>& new_requests, std::vector<Response>* out) {
   std::vector<Response> errors;
+  // Sweep expired tombstones (bounded memory on long-running jobs).
+  for (auto it = error_tombstones_.begin(); it != error_tombstones_.end();) {
+    if (MonotonicSeconds() >= it->second.expiry) {
+      it = error_tombstones_.erase(it);
+    } else {
+      ++it;
+    }
+  }
   // Own announcements first (deterministic: coordinator, then rank order).
   for (auto& r : new_requests) Announce(0, std::move(r), &errors);
   for (int rank = 1; rank < cfg_.size; ++rank) {
@@ -375,7 +407,7 @@ Status SocketController::CoordinatorCycle(
                 std::to_string(departed) + " has shut down";
       e.names.push_back(kv.first);
       e.metas.push_back(kv.second.meta);
-      error_tombstones_[kv.first] = {e.error, MonotonicSeconds() + 60.0};
+      AddTombstone(kv.first, e.error, kv.second.announced);
       errors.push_back(std::move(e));
       join_rejected.push_back(kv.first);
       if (kv.second.meta.op == OpType::JOIN) {
@@ -406,7 +438,7 @@ Status SocketController::CoordinatorCycle(
                   "hvd.join()";
         e.names.push_back(kv.first);
         e.metas.push_back(meta);
-        error_tombstones_[kv.first] = {e.error, MonotonicSeconds() + 60.0};
+        AddTombstone(kv.first, e.error, kv.second.announced);
         errors.push_back(std::move(e));
         join_rejected.push_back(kv.first);
         continue;

@@ -121,8 +121,19 @@ class SocketController : public Controller {
   std::map<std::string, Pending> pending_;  // coordinator only
   // Names recently failed by the coordinator: a straggler announcing one
   // later gets the error immediately instead of waiting forever on ranks
-  // that already saw the failure.  Values: (error text, expiry time).
-  std::map<std::string, std::pair<std::string, double>> error_tombstones_;
+  // that already saw the failure.  Delivery is once per rank — a rank that
+  // already received the error and announces the name AGAIN is making a
+  // fresh, consistent resubmission (recurring tensor names like per-step
+  // gradients) and must proceed normally.  Entries expire by time or once
+  // every owed rank has been served; expired entries are swept each cycle.
+  struct Tombstone {
+    std::string error;
+    double expiry = 0;
+    std::set<int> owed;  // ranks that have not seen the error yet
+  };
+  std::map<std::string, Tombstone> error_tombstones_;
+  void AddTombstone(const std::string& name, const std::string& error,
+                    const std::set<int>& already_informed);
   std::set<int> joined_ranks_;              // hvd.join wildcard (coordinator)
   std::set<int> departed_ranks_;            // clean-exited workers
   int32_t last_joined_ = -1;

@@ -26,15 +26,32 @@ class LSFUtils:
     @staticmethod
     def get_allocated_hosts(env: Optional[Dict[str, str]] = None
                             ) -> List[tuple]:
-        """Parse LSB_MCPU_HOSTS ('host1 ncpu1 host2 ncpu2 ...') into
-        [(host, slots)], skipping the launch node's batch slot."""
+        """Allocated (host, slots), preferring LSB_DJOB_HOSTFILE (one line
+        per slot — authoritative, the reference's source) and falling back
+        to LSB_MCPU_HOSTS parsing with the batch-slot heuristic."""
         env = env if env is not None else os.environ
+        hostfile = env.get("LSB_DJOB_HOSTFILE")
+        if hostfile and os.path.exists(hostfile):
+            counts: Dict[str, int] = {}
+            order: List[str] = []
+            with open(hostfile) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+            # First line is the batch/launch slot when compute lines follow.
+            if len(lines) > 1 and lines.count(lines[0]) == 1:
+                lines = lines[1:]
+            for host in lines:
+                if host not in counts:
+                    order.append(host)
+                counts[host] = counts.get(host, 0) + 1
+            return [(h, counts[h]) for h in order]
         toks = env.get("LSB_MCPU_HOSTS", "").split()
         pairs = [(toks[i], int(toks[i + 1]))
                  for i in range(0, len(toks) - 1, 2)]
-        # The first entry is the batch/launch node with one slot when
-        # compute hosts follow (LSF's usual bsub layout) — skip it.
-        if len(pairs) > 1 and pairs[0][1] == 1:
+        # Heuristic fallback: a leading single-slot entry followed by
+        # compute hosts is the batch node (ambiguous when a compute host
+        # genuinely has one slot — provide LSB_DJOB_HOSTFILE for those).
+        if len(pairs) > 2 and pairs[0][1] == 1 and \
+                all(n > 1 for _, n in pairs[1:]):
             pairs = pairs[1:]
         return pairs
 

@@ -72,15 +72,32 @@ class TPUPodDiscovery(HostDiscovery):
         return []
 
     def _host_healthy(self, host: str) -> bool:
-        state = _get(self.base, f"/computeMetadata/v1/instance/preempted"
-                               f"?host={host}")
+        """TCP reachability probe: a preempted/terminated TPU-VM worker
+        stops accepting connections, which is the only per-host signal the
+        launcher can observe (the metadata server's preempted/
+        maintenance-event endpoints describe the *requesting* instance
+        only).  Probe port: HOROVOD_TPU_PROBE_PORT, default 22 (sshd is up
+        on every live TPU VM)."""
+        import socket as pysocket
+
+        port = int(os.environ.get("HOROVOD_TPU_PROBE_PORT", "22"))
+        try:
+            conn = pysocket.create_connection((host, port), timeout=2.0)
+            conn.close()
+            return True
+        except OSError:
+            return False
+
+    def self_preempted(self) -> bool:
+        """Whether the *local* instance has been preempted / scheduled for
+        termination (valid use of the instance-scoped metadata endpoints;
+        workers can poll this to checkpoint before the axe falls)."""
+        state = _get(self.base, "/computeMetadata/v1/instance/preempted")
         if state is not None and state.upper() == "TRUE":
-            return False
-        maint = _get(self.base, f"/computeMetadata/v1/instance/"
-                               f"maintenance-event?host={host}")
-        if maint is not None and maint.upper().startswith("TERMINATE"):
-            return False
-        return True
+            return True
+        maint = _get(self.base,
+                     "/computeMetadata/v1/instance/maintenance-event")
+        return maint is not None and maint.upper().startswith("TERMINATE")
 
     def find_available_hosts(self) -> Dict[str, int]:
         return {h: self.slots for h in self._workers()

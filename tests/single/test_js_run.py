@@ -61,3 +61,21 @@ def test_jsrun_rank_env_mapping(monkeypatch):
         # or every later hvd.init() in this process sees rank 3.
         for k in targets:
             os.environ.pop(k, None)
+
+
+def test_allocated_hosts_from_hostfile(tmp_path):
+    """LSB_DJOB_HOSTFILE is authoritative: one line per slot, launch slot
+    first — no slot-count guessing (covers single-slot compute hosts the
+    MCPU heuristic cannot disambiguate)."""
+    hf = tmp_path / "hostfile"
+    hf.write_text("batch01\nnode01\nnode01\nnode02\n")
+    env = {"LSB_DJOB_HOSTFILE": str(hf),
+           "LSB_MCPU_HOSTS": "ignored 1"}
+    assert LSFUtils.get_allocated_hosts(env) == [("node01", 2),
+                                                 ("node02", 1)]
+
+    # single-slot compute hosts survive
+    hf.write_text("batch01\nnode01\nnode02\n")
+    env = {"LSB_DJOB_HOSTFILE": str(hf)}
+    assert LSFUtils.get_allocated_hosts(env) == [("node01", 1),
+                                                 ("node02", 1)]

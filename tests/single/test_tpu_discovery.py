@@ -1,10 +1,14 @@
 """TPU-pod elastic discovery against a fake metadata server (reference
 pattern: elastic discovery driven by controllable test doubles, SURVEY.md
-§4 item 2 — here the 'discovery script' is the GCE metadata API)."""
+§4 item 2).  Worker listing comes from the metadata tpu-env attribute;
+per-worker health is a TCP reachability probe (preempted VMs stop
+accepting connections), simulated here with real listeners that the test
+opens and closes."""
 
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import urlparse
 
 import pytest
 
@@ -12,26 +16,22 @@ from horovod_tpu.runner.tpu_discovery import TPUPodDiscovery
 
 
 class _FakeMetadata(BaseHTTPRequestHandler):
-    tpu_env = ("ACCELERATOR_TYPE: 'v5p-16'\n"
-               "WORKER_NETWORK_ENDPOINTS: '0:8470:10.0.0.1,"
-               "1:8470:10.0.0.2,2:8470:10.0.0.3'\n")
-    preempted = set()
-    maintenance = {}
+    tpu_env = ""
+    preempted = "FALSE"
+    maintenance = "NONE"
 
     def do_GET(self):  # noqa: N802 - http.server API
         if self.headers.get("Metadata-Flavor") != "Google":
             self.send_response(403)
             self.end_headers()
             return
-        url = urlparse(self.path)
-        q = parse_qs(url.query)
-        host = q.get("host", [""])[0]
-        if url.path.endswith("/attributes/tpu-env"):
+        path = urlparse(self.path).path
+        if path.endswith("/attributes/tpu-env"):
             body = self.tpu_env
-        elif url.path.endswith("/instance/preempted"):
-            body = "TRUE" if host in self.preempted else "FALSE"
-        elif url.path.endswith("/maintenance-event"):
-            body = self.maintenance.get(host, "NONE")
+        elif path.endswith("/instance/preempted"):
+            body = self.preempted
+        elif path.endswith("/maintenance-event"):
+            body = self.maintenance
         else:
             self.send_response(404)
             self.end_headers()
@@ -48,8 +48,8 @@ class _FakeMetadata(BaseHTTPRequestHandler):
 
 @pytest.fixture()
 def metadata_server():
-    _FakeMetadata.preempted = set()
-    _FakeMetadata.maintenance = {}
+    _FakeMetadata.preempted = "FALSE"
+    _FakeMetadata.maintenance = "NONE"
     srv = HTTPServer(("127.0.0.1", 0), _FakeMetadata)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -57,34 +57,52 @@ def metadata_server():
     srv.shutdown()
 
 
-def test_discovers_pod_workers(metadata_server):
+@pytest.fixture()
+def worker_listener(monkeypatch):
+    """A live TCP listener standing in for a healthy worker's probe port."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(8)
+    monkeypatch.setenv("HOROVOD_TPU_PROBE_PORT", str(s.getsockname()[1]))
+    yield s
+    s.close()
+
+
+def test_discovers_pod_workers(metadata_server, worker_listener):
+    _FakeMetadata.tpu_env = (
+        "ACCELERATOR_TYPE: 'v5p-16'\n"
+        "WORKER_NETWORK_ENDPOINTS: '0:8470:127.0.0.1'\n")
     disc = TPUPodDiscovery(slots_per_host=4, metadata_url=metadata_server)
-    assert disc.find_available_hosts() == {
-        "10.0.0.1": 4, "10.0.0.2": 4, "10.0.0.3": 4}
+    assert disc.find_available_hosts() == {"127.0.0.1": 4}
 
 
-def test_preempted_host_dropped(metadata_server):
+def test_unreachable_worker_dropped(metadata_server, worker_listener):
+    """A worker whose probe port stopped answering (preempted VM) leaves
+    the host set; it returns when the replacement VM comes up."""
+    _FakeMetadata.tpu_env = (
+        "WORKER_NETWORK_ENDPOINTS: '0:8470:127.0.0.1'\n")
     disc = TPUPodDiscovery(metadata_url=metadata_server)
-    _FakeMetadata.preempted = {"10.0.0.2"}
-    assert set(disc.find_available_hosts()) == {"10.0.0.1", "10.0.0.3"}
-    # preemption clears (host replaced): it returns
-    _FakeMetadata.preempted = set()
-    assert set(disc.find_available_hosts()) == {
-        "10.0.0.1", "10.0.0.2", "10.0.0.3"}
+    assert set(disc.find_available_hosts()) == {"127.0.0.1"}
+    worker_listener.close()  # the VM goes away
+    assert disc.find_available_hosts() == {}
 
 
-def test_terminate_maintenance_dropped(metadata_server):
-    disc = TPUPodDiscovery(metadata_url=metadata_server)
-    _FakeMetadata.maintenance = {"10.0.0.3": "TERMINATE_ON_HOST_MAINTENANCE"}
-    assert set(disc.find_available_hosts()) == {"10.0.0.1", "10.0.0.2"}
-
-
-def test_env_worker_fallback(metadata_server, monkeypatch):
-    monkeypatch.setenv("HOROVOD_TPU_WORKERS", "hostA,hostB")
+def test_env_worker_fallback(metadata_server, worker_listener, monkeypatch):
+    monkeypatch.setenv("HOROVOD_TPU_WORKERS", "127.0.0.1")
     disc = TPUPodDiscovery(slots_per_host=2, metadata_url=metadata_server)
-    assert disc.find_available_hosts() == {"hostA": 2, "hostB": 2}
+    assert disc.find_available_hosts() == {"127.0.0.1": 2}
 
 
-def test_unreachable_metadata_returns_empty():
+def test_self_preemption_signal(metadata_server):
+    disc = TPUPodDiscovery(metadata_url=metadata_server)
+    assert not disc.self_preempted()
+    _FakeMetadata.preempted = "TRUE"
+    assert disc.self_preempted()
+    _FakeMetadata.preempted = "FALSE"
+    _FakeMetadata.maintenance = "TERMINATE_ON_HOST_MAINTENANCE"
+    assert disc.self_preempted()
+
+
+def test_unreachable_metadata_returns_empty(worker_listener):
     disc = TPUPodDiscovery(metadata_url="http://127.0.0.1:1")  # nothing there
     assert disc.find_available_hosts() == {}

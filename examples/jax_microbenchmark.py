@@ -54,12 +54,14 @@ def bench_jit(mb: float, iters: int):
 def bench_eager(mb: float, iters: int):
     n = int(mb * (1 << 20) / 4)
     x = np.ones(n, np.float32)
+    # alltoall moves the same mb per rank: n rows split evenly across ranks.
+    rows = n // max(hvd.size(), 1) * hvd.size()
+    xa = np.ones((rows, 1), np.float32)
     results = {}
     for name, fn in [
         ("allreduce", lambda i: hvd.allreduce(x, name=f"b.ar.{i}")),
         ("allgather", lambda i: hvd.allgather(x, name=f"b.ag.{i}")),
-        ("alltoall", lambda i: hvd.alltoall(
-            np.ones((hvd.size() * 128, 64), np.float32), name=f"b.a2a.{i}")),
+        ("alltoall", lambda i: hvd.alltoall(xa, name=f"b.a2a.{i}")),
     ]:
         fn(0)  # warmup
         t0 = time.perf_counter()

@@ -261,11 +261,14 @@ class NativeCore(CoreBackend):
             ctypes.byref(n_counts))
         self._check(rc, "allgather")
         try:
-            raw = ctypes.string_at(out_ptr.value, out_len.value) \
-                if out_len.value else b""
+            # One copy, not two, and no per-length ctypes type-cache
+            # growth: memmove the C buffer straight into a numpy-owned
+            # array (the C side frees right after).
+            flat = np.empty(out_len.value // buf.itemsize, dtype=buf.dtype)
+            if out_len.value:
+                ctypes.memmove(flat.ctypes.data, out_ptr, out_len.value)
         finally:
             self._lib.hvd_free(out_ptr)
-        flat = np.frombuffer(raw, dtype=buf.dtype).copy()
         rows = flat.size // (row_bytes // buf.itemsize) if row_bytes else 0
         stacked = flat.reshape(rows, -1) if rows else flat.reshape(0, 1)
         row_counts = np.array(
@@ -299,11 +302,14 @@ class NativeCore(CoreBackend):
             ctypes.byref(out_len), recv, ctypes.byref(n_recv))
         self._check(rc, "alltoall")
         try:
-            raw = ctypes.string_at(out_ptr.value, out_len.value) \
-                if out_len.value else b""
+            # One copy, not two, and no per-length ctypes type-cache
+            # growth: memmove the C buffer straight into a numpy-owned
+            # array (the C side frees right after).
+            flat = np.empty(out_len.value // buf.itemsize, dtype=buf.dtype)
+            if out_len.value:
+                ctypes.memmove(flat.ctypes.data, out_ptr, out_len.value)
         finally:
             self._lib.hvd_free(out_ptr)
-        flat = np.frombuffer(raw, dtype=buf.dtype).copy()
         recv_splits = np.array([recv[i] for i in range(n_recv.value)],
                                dtype=np.int64)
         total_rows = int(recv_splits.sum())

@@ -300,7 +300,11 @@ void SocketController::Announce(int rank, TensorRequest req,
               " across ranks";
     e.names.push_back(req.name);
     e.metas.push_back(p.meta);
-    AddTombstone(req.name, e.error, p.announced);
+    // The announcing rank receives this error through the cycle broadcast
+    // (its handle maps by name) — it is informed, not owed a tombstone.
+    std::set<int> informed = p.announced;
+    informed.insert(rank);
+    AddTombstone(req.name, e.error, informed);
     errors->push_back(std::move(e));
     pending_.erase(it);
     return;

@@ -36,8 +36,15 @@ class LSFUtils:
             order: List[str] = []
             with open(hostfile) as f:
                 lines = [ln.strip() for ln in f if ln.strip()]
-            # First line is the batch/launch slot when compute lines follow.
-            if len(lines) > 1 and lines.count(lines[0]) == 1:
+            # On LSF+jsrun clusters the first line is the batch/launch
+            # node's slot, which jsrun never schedules on.  The file cannot
+            # distinguish that from a genuine single-slot compute host, so
+            # the skip is overridable: HOROVOD_LSF_INCLUDE_LAUNCH_HOST=1
+            # keeps every line.
+            include_launch = env.get(
+                "HOROVOD_LSF_INCLUDE_LAUNCH_HOST") == "1"
+            if not include_launch and len(lines) > 1 and \
+                    lines.count(lines[0]) == 1:
                 lines = lines[1:]
             for host in lines:
                 if host not in counts:

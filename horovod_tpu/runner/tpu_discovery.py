@@ -100,5 +100,14 @@ class TPUPodDiscovery(HostDiscovery):
         return maint is not None and maint.upper().startswith("TERMINATE")
 
     def find_available_hosts(self) -> Dict[str, int]:
-        return {h: self.slots for h in self._workers()
-                if self._host_healthy(h)}
+        # Probe concurrently: serial 2s timeouts would make a poll scale
+        # with the number of DEAD hosts, slowing reaction exactly when a
+        # preemption took out part of the pod.
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = self._workers()
+        if not workers:
+            return {}
+        with ThreadPoolExecutor(max_workers=min(32, len(workers))) as ex:
+            healthy = list(ex.map(self._host_healthy, workers))
+        return {h: self.slots for h, ok in zip(workers, healthy) if ok}

@@ -79,3 +79,12 @@ def test_allocated_hosts_from_hostfile(tmp_path):
     env = {"LSB_DJOB_HOSTFILE": str(hf)}
     assert LSFUtils.get_allocated_hosts(env) == [("node01", 1),
                                                  ("node02", 1)]
+
+
+def test_hostfile_include_launch_host_override(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("node01\nnode02\nnode02\n")
+    env = {"LSB_DJOB_HOSTFILE": str(hf),
+           "HOROVOD_LSF_INCLUDE_LAUNCH_HOST": "1"}
+    assert LSFUtils.get_allocated_hosts(env) == [("node01", 1),
+                                                 ("node02", 2)]

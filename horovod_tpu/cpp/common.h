@@ -117,6 +117,12 @@ struct Response {
   bool cache_hit = false;
   int64_t seq = -1;  // global data-op sequence (tags data-plane frames)
   int32_t last_joined = -1;  // JOIN responses: the last rank to join
+  // When >= 0, only this rank acts on the response (tombstone error
+  // deliveries: the name may have been consistently resubmitted by other
+  // ranks, whose fresh handles must not absorb the stale error).  The
+  // response list stays byte-identical on every rank; handling is what
+  // differs, deterministically.
+  int32_t target_rank = -1;
 };
 
 struct CoreConfig {

@@ -191,6 +191,9 @@ void BackgroundLoop() {
 
     int64_t bytes = 0;
     for (auto& r : responses) {
+      if (r.target_rank >= 0 && r.target_rank != g->cfg.rank) {
+        continue;  // targeted delivery (tombstone error for another rank)
+      }
       // Map globally agreed names to this rank's local handles.
       std::lock_guard<std::mutex> l(g->queue_mu);
       for (const auto& name : r.names) {
@@ -204,6 +207,7 @@ void BackgroundLoop() {
       for (const auto& m : r.metas) bytes += m.nbytes;
     }
     for (const auto& r : responses) {
+      if (r.target_rank >= 0 && r.target_rank != g->cfg.rank) continue;
       if (!r.error.empty() && r.handles.empty()) {
         if (r.names.empty()) {
           // Errors naming no tensor at all (response-cache divergence)

@@ -220,6 +220,7 @@ void SocketController::Announce(int rank, TensorRequest req,
     Response e;
     e.op = req.op;
     e.error = tomb->second.error;
+    e.target_rank = rank;  // others may have resubmitted this name
     e.names.push_back(req.name);
     e.metas.push_back(req);
     errors->push_back(std::move(e));

@@ -63,6 +63,7 @@ void SerializeResponse(const Response& r, Writer* w) {
   w->PutU8(r.cache_hit ? 1 : 0);
   w->PutI64(r.seq);
   w->PutI32(r.last_joined);
+  w->PutI32(r.target_rank);
   w->PutI32(static_cast<int32_t>(r.metas.size()));
   for (const auto& m : r.metas) SerializeRequest(m, w);
 }
@@ -76,6 +77,7 @@ Response DeserializeResponse(Reader* r) {
   resp.cache_hit = r->GetU8() != 0;
   resp.seq = r->GetI64();
   resp.last_joined = r->GetI32();
+  resp.target_rank = r->GetI32();
   int32_t n = r->GetI32();
   resp.metas.reserve(n);
   for (int32_t i = 0; i < n; ++i) {

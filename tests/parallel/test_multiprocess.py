@@ -489,3 +489,78 @@ def _join_worker():
 
 def test_join_np3():
     assert run(_join_worker, np=3) == [0, 1, 2]
+
+
+def _tombstone_resubmit_worker():
+    """Error-tombstone semantics, np=3 (the tombstone only forms when a
+    member has NOT yet announced at error time): ranks 0/1 collide on
+    "grad.0" with mismatched dtypes and error; straggler rank 2 announces
+    the same name late and must receive the stored error instead of
+    waiting forever; then a consistent resubmission of the SAME name by
+    all ranks must succeed (tombstones deliver once per owed rank — the
+    recurring-gradient-name case)."""
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    if r == 2:
+        time.sleep(1.5)  # announce after the error fired -> owed rank
+        bad = np.ones(4, np.float32)
+    else:
+        bad = np.ones(4, np.float32 if r == 0 else np.float64)
+    try:
+        hvd.allreduce(bad, op=hvd.Sum, name="grad.0")
+        raised = None
+    except hvd.HorovodInternalError as exc:
+        raised = str(exc)
+    assert raised is not None, f"rank {r}: expected the mismatch error"
+    assert "ismatch" in raised, raised  # tombstone text reaches rank 2 too
+    # Consistent resubmission of the same name -> completes with right sum.
+    out = hvd.allreduce(np.full(4, float(r + 1), np.float32), op=hvd.Sum,
+                        name="grad.0")
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+    # and again (steady state through the response cache)
+    out = hvd.allreduce(np.full(4, 1.0, np.float32), op=hvd.Sum,
+                        name="grad.0")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    hvd.shutdown()
+    return r
+
+
+def test_tombstone_delivers_to_straggler_then_allows_resubmit_np3():
+    assert run(_tombstone_resubmit_worker, np=3) == [0, 1, 2]
+
+
+def _early_exit_worker():
+    """Clean shutdown of one rank: survivors' next collective fails with a
+    named 'has shut down' error instead of a connection error or a hang
+    (BYE/farewell handshake)."""
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="ok")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    if r == 1:
+        hvd.shutdown()  # leaves deliberately
+        return r
+    # rank 0: give the BYE a moment, then attempt a collective rank 1
+    # will never join
+    time.sleep(1.0)
+    try:
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="after.exit")
+        raised = None
+    except hvd.HorovodInternalError as exc:
+        raised = str(exc)
+    assert raised is not None, "expected failure after peer shutdown"
+    assert "shut down" in raised, raised
+    hvd.shutdown()
+    return r
+
+
+def test_clean_early_exit_np2():
+    assert run(_early_exit_worker, np=2) == [0, 1]

@@ -198,11 +198,19 @@ void BackgroundLoop() {
       std::lock_guard<std::mutex> l(g->queue_mu);
       for (const auto& name : r.names) {
         auto it = g->outstanding.find(name);
-        if (it != g->outstanding.end()) {
-          r.handles.push_back(it->second.handle);
-          g->outstanding.erase(it);
-          g->timeline.End(name, "NEGOTIATE");
+        if (it == g->outstanding.end()) continue;
+        if (r.target_rank == g->cfg.rank && !r.error.empty() &&
+            !r.metas.empty() &&
+            r.metas.front().handle != it->second.handle) {
+          // Stale tombstone delivery: the submission it refers to (echoed
+          // back by handle in the meta) was already failed by the cycle
+          // broadcast; the outstanding entry is a fresh, consistent
+          // resubmission that must not absorb the old error.
+          continue;
         }
+        r.handles.push_back(it->second.handle);
+        g->outstanding.erase(it);
+        g->timeline.End(name, "NEGOTIATE");
       }
       for (const auto& m : r.metas) bytes += m.nbytes;
     }

@@ -362,8 +362,10 @@ Status SocketController::CoordinatorCycle(
     }
     for (int32_t i = 0; i < n_cached; ++i) {
       int64_t id = rd.GetI64();
+      int64_t handle = rd.GetI64();
       TensorRequest req;
       if (cache_.Get(id, &req)) {
+        req.handle = handle;  // the announcer's own current submission
         Announce(rank, std::move(req), &errors);
       } else {
         Response e;
@@ -502,19 +504,26 @@ Status SocketController::CoordinatorCycle(
 Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
                                      std::vector<Response>* out) {
   Writer w;
-  // Cache hits travel as bare ids (the reference's bit-vector fast path).
-  std::vector<int64_t> cached;
+  // Cache hits travel as (id, handle) pairs — the id is the reference's
+  // bit-vector fast path; the per-submission handle rides along so a
+  // tombstone error delivery can echo the announcing rank's own current
+  // submission (not the stale handle stored in the cache by the first
+  // announcer of an earlier negotiation).
+  std::vector<std::pair<int64_t, int64_t>> cached;
   std::vector<const TensorRequest*> full;
   for (const auto& r : new_requests) {
     int64_t id = cache_.Lookup(r);
     if (id >= 0) {
-      cached.push_back(id);
+      cached.emplace_back(id, r.handle);
     } else {
       full.push_back(&r);
     }
   }
   w.PutI32(static_cast<int32_t>(cached.size()));
-  for (int64_t id : cached) w.PutI64(id);
+  for (auto& [id, handle] : cached) {
+    w.PutI64(id);
+    w.PutI64(handle);
+  }
   w.PutI32(static_cast<int32_t>(full.size()));
   for (const auto* r : full) SerializeRequest(*r, &w);
   if (!coord_ctrl_.SendFrame(w.data())) {

@@ -26,6 +26,10 @@ double MonotonicSeconds() {
 // ---- request/response serialization ---------------------------------------
 
 void SerializeRequest(const TensorRequest& r, Writer* w) {
+  // handle rides the wire so tombstone error deliveries can echo the owed
+  // rank's own submission id back to it (core_api matches it against the
+  // outstanding entry to drop stale deliveries after a resubmission).
+  w->PutI64(r.handle);
   w->PutString(r.name);
   w->PutI32(static_cast<int32_t>(r.op));
   w->PutI32(static_cast<int32_t>(r.dtype));
@@ -41,6 +45,7 @@ void SerializeRequest(const TensorRequest& r, Writer* w) {
 
 TensorRequest DeserializeRequest(Reader* r) {
   TensorRequest t;
+  t.handle = r->GetI64();
   t.name = r->GetString();
   t.op = static_cast<OpType>(r->GetI32());
   t.dtype = static_cast<DataType>(r->GetI32());

@@ -134,6 +134,8 @@ class ElasticDriver:
         self._blacklist: set = set()
         self._failures: Dict[str, List[float]] = {}  # host -> failure times
         self._generation = -1
+        self._formed_size = 0     # size of the last formed generation
+        self._last_target = None  # last successful discovery result
         # Shared secret signing every coordinator RPC (reference:
         # common/util/secret.py): a stray/malicious connection cannot
         # register as a worker or push host updates.
@@ -287,13 +289,46 @@ class ElasticDriver:
         gen = self._generation + 1
         try:
             target = self._target_hosts()
+            self._last_target = target
         except RuntimeError as exc:
-            print(f"elastic driver: discovery failed: {exc}", file=sys.stderr)
-            target = {}
+            # A transient discovery blip (metadata-poll timeout, script
+            # hiccup) must not tear down a healthy job: reuse the last good
+            # host set, matching _discovery_loop's tolerance.  Abort only if
+            # discovery has never succeeded.  Re-apply the blacklist — it
+            # may have grown since the snapshot was taken.
+            prev = self._last_target
+            print(f"elastic driver: discovery failed: {exc}"
+                  + ("; reusing previous host set" if prev else ""),
+                  file=sys.stderr)
+            target = {h: s for h, s in (prev or {}).items()
+                      if h not in self._blacklist}
 
-        # Notify survivors of the upcoming round.
+        cap = self.max_np if self.max_np else sum(target.values())
+        slots = []
+        for h, s in target.items():
+            for i in range(s):
+                slots.append((h, i))
+        slots = slots[:cap]
+
+        # No-op guard: registrations/ready messages racing the previous
+        # formation leave a stale poke behind.  If the already-formed
+        # generation is intact — every one of its workers alive and running,
+        # they exactly cover the target slots, and no unassigned live worker
+        # is waiting — re-forming would interrupt training for nothing (and
+        # under load the teardown/re-register round can blow the start
+        # timeout).  `running` must equal the full formed size: survivors of
+        # a shrunken host set still need the hosts_updated push even when
+        # they happen to cover the new, smaller target.
         with self._lock:
             live = [w for w in self._workers.values() if not w.dead]
+            running = [w for w in live
+                       if w.rank is not None and not w.ready.is_set()]
+        if (self._generation >= 0 and running
+                and len(running) == len(live)
+                and len(running) == self._formed_size
+                and len(running) == len(slots)
+                and {(w.host, w.slot) for w in running} == set(slots)):
+            return True
         for w in live:
             if not w.ready.is_set():
                 w.send({"type": "hosts_updated"})
@@ -308,12 +343,6 @@ class ElasticDriver:
                     pass
 
         # Spawn missing slots up to max_np.
-        cap = self.max_np if self.max_np else sum(target.values())
-        slots = []
-        for h, s in target.items():
-            for i in range(s):
-                slots.append((h, i))
-        slots = slots[:cap]
         with self._lock:
             occupied = {(w.host, w.slot) for w in self._workers.values()
                         if not w.dead and w.host in target}
@@ -377,6 +406,7 @@ class ElasticDriver:
                 "rendezvous_port": rdv_port,
             })
         self._generation = gen
+        self._formed_size = size
         if self.verbose:
             print(f"elastic driver: generation {gen} formed with {size} "
                   f"worker(s)", file=sys.stderr)

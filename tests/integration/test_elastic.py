@@ -74,6 +74,9 @@ def test_elastic_basic_completion():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "RESULT" in proc.stdout
     assert "epoch=6" in proc.stdout
+    # Regression: registrations racing the first formation used to leave a
+    # stale poke that re-formed (and restarted training) once per run.
+    assert proc.stderr.count("formed") == 1, proc.stderr
 
 
 def test_elastic_worker_failure_recovers():

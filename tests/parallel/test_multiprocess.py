@@ -533,6 +533,84 @@ def test_tombstone_delivers_to_straggler_then_allows_resubmit_np3():
     assert run(_tombstone_resubmit_worker, np=3) == [0, 1, 2]
 
 
+def _tombstone_inflight_race_worker():
+    """In-flight-announce race, np=3: a rank whose announce of "race.i" is
+    already in flight when the coordinator emits the mismatch error gets the
+    error TWICE — once via the cycle broadcast (name-mapped to its handle)
+    and once via the targeted tombstone for its stale announce.  The stale
+    targeted delivery must not be absorbed by the rank's fresh, consistent
+    resubmission of the same name (core_api matches the echoed submission
+    handle).  Many near-simultaneous iterations to cover interleavings."""
+    import random
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    rng = random.Random(1234 + r)
+    for i in range(25):
+        if r == 2:
+            time.sleep(rng.uniform(0.0, 0.005))  # vary arrival order
+        bad = np.ones(4, np.float64 if r == 1 else np.float32)
+        try:
+            hvd.allreduce(bad, op=hvd.Sum, name=f"race.{i}")
+            raised = None
+        except hvd.HorovodInternalError as exc:
+            raised = str(exc)
+        assert raised is not None and "ismatch" in raised, \
+            f"rank {r} iter {i}: {raised}"
+        # Fresh consistent resubmission must never absorb the stale error.
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name=f"race.{i}")
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+    hvd.shutdown()
+    return r
+
+
+def test_tombstone_inflight_announce_race_np3():
+    assert run(_tombstone_inflight_race_worker, np=3) == [0, 1, 2]
+
+
+def _tombstone_cached_straggler_worker():
+    """Tombstone delivery for a CACHE-HIT announce, np=3: "cgrad.0" first
+    negotiates successfully (now in every rank's response cache), then
+    ranks 0/1 resubmit it with mismatched dtypes -> error + tombstone owed
+    to straggler rank 2.  Rank 2's late announce travels as a bare cache id;
+    the frame must carry rank 2's own submission handle so the targeted
+    error maps onto its outstanding entry (a cache-reconstructed foreign
+    handle would be dropped as stale -> permanent hang)."""
+    import time
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="cgrad.0")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    if r == 2:
+        time.sleep(1.5)  # announce after the error fired -> owed rank
+        bad = np.ones(4, np.float32)  # cache hit: same signature as before
+    else:
+        bad = np.ones(4, np.float32 if r == 0 else np.float64)
+    try:
+        hvd.allreduce(bad, op=hvd.Sum, name="cgrad.0")
+        raised = None
+    except hvd.HorovodInternalError as exc:
+        raised = str(exc)
+    assert raised is not None, f"rank {r}: expected the mismatch error"
+    assert "ismatch" in raised, raised
+    # Consistent resubmission still works afterwards.
+    out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="cgrad.0")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    hvd.shutdown()
+    return r
+
+
+def test_tombstone_cached_straggler_np3():
+    assert run(_tombstone_cached_straggler_worker, np=3) == [0, 1, 2]
+
+
 def _early_exit_worker():
     """Clean shutdown of one rank: survivors' next collective fails with a
     named 'has shut down' error instead of a connection error or a hang

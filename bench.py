@@ -81,11 +81,16 @@ def _flash_attention_entry() -> dict:
                                 - out_d.astype(jnp.float32))))
 
     def timeit(fn, iters=20):
-        jax.block_until_ready(fn(q, k, v))
+        # Chain iterations (out feeds the next q) and end with a scalar
+        # host readback: block_until_ready does not actually synchronize
+        # over the sandbox's remote-TPU tunnel, so only a data dependency
+        # chain + device->host transfer bounds the real device time.
+        float(jnp.max(jnp.abs(fn(q, k, v))))  # warmup + sync
         t0 = time.perf_counter()
+        out = q
         for _ in range(iters):
-            out = fn(q, k, v)
-        jax.block_until_ready(out)
+            out = fn(out, k, v)
+        float(jnp.max(jnp.abs(out)))
         return (time.perf_counter() - t0) / iters * 1e3
 
     flash_ms = timeit(flash)
@@ -115,7 +120,10 @@ def _measure() -> None:
          f"kind={devices[0].device_kind}")
     mesh = Mesh(np.asarray(devices), ("hvd",))
 
-    batch_per_chip = 64
+    # 256/chip measured fastest on v5e (64→2263, 128→2350, 256→2502,
+    # 512→2413 img/s); the reference benchmarks use 64/GPU but per-chip
+    # batch is a free knob on TPU HBM.
+    batch_per_chip = 256
     batch = batch_per_chip * n_dev
     # bn_axis_name: cross-replica BN stats (and replica-invariant
     # batch_stats, required by the P() out_spec under shard_map).
@@ -169,15 +177,17 @@ def _measure() -> None:
     for _ in range(3):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
-    _log("warmup done; measuring")
+    # Scalar host readback: the steps chain through donated params, so
+    # pulling the latest loss bounds every enqueued step.  (block_until_ready
+    # does not synchronize over the sandbox's remote-TPU tunnel.)
+    _log(f"warmup done (loss={float(loss):.3f}); measuring")
 
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * n_steps / dt

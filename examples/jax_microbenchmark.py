@@ -41,11 +41,13 @@ def bench_jit(mb: float, iters: int):
     ]:
         f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("hvd"),
                               out_specs=P("hvd")))
-        jax.block_until_ready(f(x))
+        float(jnp.sum(f(x)))  # warmup + real sync
         t0 = time.perf_counter()
         for _ in range(iters):
             out = f(x)
-        jax.block_until_ready(out)
+        # Device programs run in order; reading back the last one bounds
+        # them all (works even where block_until_ready is a no-op).
+        float(jnp.sum(out))
         dt = time.perf_counter() - t0
         results[name] = (mb * iters) / dt
     return results

@@ -30,10 +30,13 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                 causal: bool, block_q: int, block_k: int, valid_len: int):
     iq = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * sm_scale          # [Bq, D]
+    # Dots run on the MXU in the input dtype (bf16 native rate, 2x the f32
+    # path) with f32 accumulation; softmax math stays f32.  The sm_scale is
+    # folded in after the QK dot so it happens in f32.
+    q = q_ref[:]                                         # [Bq, D]
     seq_len = k_ref.shape[0]
     d = q_ref.shape[-1]
 
@@ -45,11 +48,11 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
 
     def body(j, carry):
         acc, m, l = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(                          # [Bq, Bk] on MXU
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32) * sm_scale
         if causal or padded:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -66,19 +69,22 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # Log-sum-exp per query row, the residual the backward pass needs to
+    # re-materialize P = exp(S - lse) blockwise without storing [S, S].
+    lse_ref[:] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
-                valid_len):
-    """Kernel entry over [BH, S, D] (S already padded to the block size)."""
+def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                    interpret, valid_len):
+    """Forward kernel over [BH, S, D] (S already padded): out + row lse."""
     bh, s, d = qb.shape
     grid = (bh, s // block_q)
     kernel = functools.partial(_mha_kernel, sm_scale=sm_scale, causal=causal,
@@ -92,10 +98,166 @@ def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
         interpret=interpret,
     )(qb, kb, vb)
+
+
+def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, *, sm_scale: float, causal: bool,
+                       block_q: int, block_k: int, valid_len: int):
+    """dQ for one query block: loop over key blocks, re-materialize P."""
+    iq = pl.program_id(1)
+    q = q_ref[:]                                           # [Bq, D] bf16/f32
+    do = do_ref[:].astype(jnp.float32)                     # [Bq, D]
+    lse = lse_ref[:][:, None]                              # [Bq, 1] f32
+    delta = delta_ref[:][:, None]                          # [Bq, 1] f32
+    seq_len = k_ref.shape[0]
+    n_blocks = (iq + 1) if causal else seq_len // block_k
+    padded = valid_len < seq_len
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal or padded:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            else:
+                s = jnp.where(kpos < valid_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale                   # [Bq, Bk]
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, n_blocks, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                        block_q: int, block_k: int, valid_len: int):
+    """dK/dV for one key block: loop over query blocks."""
+    jk = pl.program_id(1)
+    k = k_ref[:]                                           # [Bk, D]
+    v = v_ref[:]                                           # [Bk, D]
+    seq_len = q_ref.shape[0]
+    n_q_blocks = seq_len // block_q
+    start = jk * block_k // block_q if causal else 0       # skip above diag
+    padded = valid_len < seq_len
+    d = q_ref.shape[-1]
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal or padded:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            if causal:
+                s = jnp.where(qpos >= kpos, s, NEG_INF)
+            else:
+                s = jnp.where(kpos < valid_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [Bq, Bk]
+        dv = dv + jax.lax.dot_general(                     # P^T @ dO
+            p.astype(do_ref.dtype), do.astype(do_ref.dtype),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(                     # dS^T @ Q
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
+                    block_k, interpret, valid_len):
+    bh, s, d = qb.shape
+    # delta_i = rowsum(dO_i * O_i) — the standard backward residual.
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1)                               # [BH, S]
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, valid_len=valid_len)
+    qspec = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
+    kspec = pl.BlockSpec((None, block_k, d), lambda b, i: (b, i, 0))
+    full = pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0))
+    row_q = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
+    row_full = pl.BlockSpec((None, s), lambda b, i: (b, 0))
+    dq = pl.pallas_call(
+        functools.partial(_mha_bwd_dq_kernel, **common),
+        grid=(bh, s // block_q),
+        in_specs=[qspec, full, full, qspec, row_q, row_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_mha_bwd_dkv_kernel, **common),
+        grid=(bh, s // block_k),
+        in_specs=[full, kspec, kspec, full, row_full, row_full],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), vb.dtype)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
+                valid_len):
+    """Differentiable kernel entry over [BH, S, D] (S already padded)."""
+    out, _ = _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                             interpret, valid_len)
+    return out
+
+
+def _flash_bhsd_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                    interpret, valid_len):
+    out, lse = _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q,
+                               block_k, interpret, valid_len)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _flash_bhsd_bwd(sm_scale, causal, block_q, block_k, interpret, valid_len,
+                    res, dob):
+    qb, kb, vb, ob, lse = res
+    dq, dk, dv = _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal,
+                                 block_q, block_k, interpret, valid_len)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def dense_attention(q, k, v, causal: bool = False,
@@ -128,6 +290,16 @@ def flash_attention(q, k, v, causal: bool = False,
             return dense_attention(q, k, v, causal, scale)
         interpret = False
     sm_scale = d ** -0.5 if scale is None else scale
+    # K and V live whole in VMEM (bandwidth-optimal: fetched once, not once
+    # per query block).  That caps the per-device sequence length; beyond it,
+    # shard the sequence instead (parallel.ring_attention over an sp axis).
+    kv_bytes = 2 * s * d * jnp.dtype(k.dtype).itemsize
+    if kv_bytes > 64 * 1024 * 1024:
+        raise ValueError(
+            f"flash_attention: K+V for seq_len={s}, head_dim={d} need "
+            f"{kv_bytes / 2**20:.0f} MiB of VMEM (>64 MiB budget). Shard "
+            "the sequence across devices with "
+            "horovod_tpu.parallel.ring_attention instead.")
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if causal and block_q != block_k:
@@ -150,6 +322,6 @@ def flash_attention(q, k, v, causal: bool = False,
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
     out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal,
-                      block_q, block_k, bool(interpret), valid_len=s)
+                      block_q, block_k, bool(interpret), s)
     out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
     return out[:, :s]

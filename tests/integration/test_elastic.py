@@ -76,7 +76,7 @@ def test_elastic_basic_completion():
     assert "epoch=6" in proc.stdout
     # Regression: registrations racing the first formation used to leave a
     # stale poke that re-formed (and restarted training) once per run.
-    assert proc.stderr.count("formed") == 1, proc.stderr
+    assert proc.stderr.count(" formed with ") == 1, proc.stderr
 
 
 def test_elastic_worker_failure_recovers():

@@ -26,11 +26,13 @@ import time
 
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 235.0  # Horovod paper, ResNet-50 on P100
 _CHILD_FLAG = "_HVD_TPU_BENCH_CHILD"
-_ATTEMPTS = 3
-# Healthy runs finish in ~2 min; a wedged TPU tunnel does not recover in
-# 25, so cap each attempt at 10 min and keep budget for the retries.
-_ATTEMPT_TIMEOUT_S = 600
-_BACKOFFS_S = (30, 60)
+_ATTEMPTS = 2
+# Healthy runs finish in ~4 min.  A wedged tunnel (single-tenant claim
+# held by a previously killed client) can take many minutes to free — and
+# killing a child mid-claim re-wedges it, so FEW, LONG attempts beat many
+# short ones.
+_ATTEMPT_TIMEOUT_S = 900
+_BACKOFFS_S = (120,)
 
 # Published per-chip peak bf16 matmul throughput, by device_kind prefix.
 _PEAK_BF16_FLOPS = (

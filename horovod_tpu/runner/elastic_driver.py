@@ -30,7 +30,8 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-from .util import (find_free_port, local_hostnames, make_secret,
+from .util import (FORWARD_ENV_PREFIXES, pin_tpu_chip,
+                   find_free_port, local_hostnames, make_secret,
                    signed_dumps, verified_loads)
 
 BLACKLIST_FAILURES = 2          # consecutive fast failures before blacklisting
@@ -198,7 +199,8 @@ class ElasticDriver:
         self._reset_required.set()
 
     # -- worker spawning -----------------------------------------------------
-    def _spawn(self, host: str, slot: int, gen: int) -> _Worker:
+    def _spawn(self, host: str, slot: int, gen: int,
+               host_slots: int = 1) -> _Worker:
         wid = f"{host}:{slot}:{uuid.uuid4().hex[:8]}"
         env = dict(self.base_env)
         env.update({
@@ -209,6 +211,10 @@ class ElasticDriver:
             "HOROVOD_ELASTIC_SECRET": self._secret,
             "HOROVOD_HOSTNAME": host,
         })
+        # host_slots counts the slots assigned on this host in THIS
+        # generation (a max_np-capped lone worker stays unpinned with all
+        # chips visible, like the non-elastic launcher).
+        pin_tpu_chip(env, slot, host_slots)
         if host in local_hostnames():
             proc = subprocess.Popen(
                 self.command, env=env, stdout=subprocess.PIPE,
@@ -219,8 +225,7 @@ class ElasticDriver:
             env_str = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in env.items()
                 if k != "HOROVOD_ELASTIC_SECRET"
-                and k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "JAX_",
-                                  "XLA_")))
+                and k.startswith(FORWARD_ENV_PREFIXES))
             remote = ("read -r HOROVOD_ELASTIC_SECRET; "
                       "export HOROVOD_ELASTIC_SECRET; "
                       f"cd {shlex.quote(os.getcwd())} && env {env_str} " +
@@ -346,9 +351,12 @@ class ElasticDriver:
         with self._lock:
             occupied = {(w.host, w.slot) for w in self._workers.values()
                         if not w.dead and w.host in target}
+        slots_per_host: Dict[str, int] = {}
+        for h, _ in slots:
+            slots_per_host[h] = slots_per_host.get(h, 0) + 1
         for (h, i) in slots:
             if (h, i) not in occupied:
-                self._spawn(h, i, gen)
+                self._spawn(h, i, gen, slots_per_host[h])
 
         # Wait for every expected worker to be ready (registered + torn
         # down), with a deadline.

@@ -23,7 +23,8 @@ import sys
 import threading
 from typing import Dict, List, Optional
 
-from .util import assign_ranks, find_free_port, local_hostnames, parse_hosts
+from .util import (FORWARD_ENV_PREFIXES, assign_ranks, find_free_port,
+                   local_hostnames, parse_hosts, pin_tpu_chip)
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -205,6 +206,7 @@ class WorkerProcesses:
                 "HOROVOD_GLOO_RENDEZVOUS_ADDR": rendezvous_addr,
                 "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
             })
+            pin_tpu_chip(env, a["local_rank"], a["local_size"])
             if a["hostname"] in local_hostnames():
                 proc = subprocess.Popen(
                     command, env=env, stdout=subprocess.PIPE,
@@ -212,8 +214,7 @@ class WorkerProcesses:
             else:  # remote launch over ssh with env forwarding
                 env_str = " ".join(
                     f"{k}={shlex.quote(v)}" for k, v in env.items()
-                    if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH",
-                                     "JAX_", "XLA_")))
+                    if k.startswith(FORWARD_ENV_PREFIXES))
                 ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
                 if ssh_port:
                     ssh_cmd += ["-p", str(ssh_port)]

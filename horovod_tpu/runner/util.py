@@ -127,3 +127,33 @@ def verified_loads(line: str, secret):
     if not verify_message(secret, msg["p"], msg["sig"]):
         return None
     return json.loads(msg["p"])
+
+
+# Env prefixes both launchers forward to remote (ssh) workers.
+FORWARD_ENV_PREFIXES = ("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_",
+                        "TPU_")
+
+
+def pin_tpu_chip(env: dict, local_rank: int, local_size: int) -> None:
+    """Pin a co-located worker to its own TPU chip (libtpu is single-owner
+    per chip — the GPU analog is the local-rank device pinning the
+    reference's launcher relies on).
+
+    With one worker on the host nothing is touched (the worker may use all
+    chips, and an explicit user pin is honored).  With several co-located
+    workers a single inherited ``TPU_VISIBLE_CHIPS`` would hand every
+    worker the same chip and crash all but the first claim, so it is
+    overridden per worker.
+    """
+    if local_size <= 1:
+        return
+    if "TPU_VISIBLE_CHIPS" in env or "TPU_VISIBLE_DEVICES" in env:
+        import sys
+
+        print(f"horovod_tpu: overriding inherited TPU chip pin for "
+              f"local_rank {local_rank} ({local_size} workers share this "
+              "host; a single global pin cannot be per-worker correct)",
+              file=sys.stderr)
+        env.pop("TPU_VISIBLE_DEVICES", None)
+    env["TPU_VISIBLE_CHIPS"] = str(local_rank)
+    env.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")

@@ -27,6 +27,7 @@ WORKER_SCRIPT = textwrap.dedent("""
 
     KILL_EPOCH = int(os.environ.get("TEST_KILL_EPOCH", "-1"))
     KILL_FLAG = os.environ.get("TEST_KILL_FLAG", "")
+    PRE_KILL_TOUCH = os.environ.get("TEST_PRE_KILL_TOUCH", "")
 
     @hvd.elastic.run
     def train(state):
@@ -34,6 +35,8 @@ WORKER_SCRIPT = textwrap.dedent("""
             if (KILL_EPOCH >= 0 and state.epoch == KILL_EPOCH
                     and hvd.rank() == hvd.size() - 1 and hvd.size() > 1
                     and KILL_FLAG and not os.path.exists(KILL_FLAG)):
+                if PRE_KILL_TOUCH:
+                    open(PRE_KILL_TOUCH, "w").write("x")
                 open(KILL_FLAG, "w").write("died")
                 os.kill(os.getpid(), 9)
             val = hvd.allreduce(np.ones(4, np.float32),
@@ -106,3 +109,26 @@ def test_elastic_discovery_script():
              f"cat {hosts_file}", "--verbose"])
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "epoch=6" in proc.stdout
+
+
+def test_elastic_discovery_blip_reuses_last_hosts():
+    """A transient discovery failure during a re-formation must not tear
+    down the job: the driver reuses the last good host set.  The dying
+    worker flips the discovery script into failure mode right before
+    SIGKILLing itself, so the respawn round's discovery call fails."""
+    with tempfile.TemporaryDirectory() as td:
+        fail_flag = os.path.join(td, "fail.flag")
+        kill_flag = os.path.join(td, "killed.flag")
+        script = os.path.join(td, "discover.sh")
+        with open(script, "w") as f:
+            f.write(f"#!/bin/sh\nif [ -e {fail_flag} ]; then exit 1; fi\n"
+                    "echo localhost:2\n")
+        os.chmod(script, 0o755)
+        proc = _run_launcher(
+            ["--min-np", "1", "--host-discovery-script", script,
+             "--verbose"],
+            env_extra={"TEST_KILL_EPOCH": "2", "TEST_KILL_FLAG": kill_flag,
+                       "TEST_PRE_KILL_TOUCH": fail_flag})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "epoch=6" in proc.stdout
+        assert "reusing previous host set" in proc.stderr, proc.stderr

@@ -114,3 +114,60 @@ def test_config_file_yaml(tmp_path):
     assert args.num_proc == 2
     assert args.cycle_time_ms == 9.0
     assert args.fusion_threshold_mb == 32  # still from file
+
+
+def test_launcher_pins_one_chip_per_colocated_worker(tmp_path):
+    """Multi-worker-per-host launches must pin each worker to its own TPU
+    chip (libtpu is single-owner per chip); single-worker hosts and user
+    overrides are left alone."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        "print('PIN', os.environ.get('HOROVOD_LOCAL_RANK'),\n"
+        "      os.environ.get('TPU_VISIBLE_CHIPS'),\n"
+        "      os.environ.get('TPU_CHIPS_PER_PROCESS_BOUNDS'))\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("TPU_VISIBLE_CHIPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def pins_from(stdout):
+        # Worker lines stream as "[rank]<stdout>: PIN <lr> <chips> <bounds>".
+        return sorted(ln.split("PIN", 1)[1].split()
+                      for ln in stdout.splitlines() if "PIN" in ln)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "-H", "localhost:2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    pins = pins_from(proc.stdout)
+    assert [p[1] for p in pins] == ["0", "1"], proc.stdout
+    assert all(p[2] == "1,1,1" for p in pins), proc.stdout
+
+    # np=1: no pinning injected.
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert pins_from(proc.stdout)[0][1] == "None", proc.stdout
+
+    # An inherited global pin would hand every co-located worker the same
+    # chip: it must be overridden per worker (with a warning).
+    env_pinned = dict(env)
+    env_pinned["TPU_VISIBLE_CHIPS"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "-H", "localhost:2", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env_pinned)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert [p[1] for p in pins_from(proc.stdout)] == ["0", "1"], proc.stdout
+    assert "overriding inherited TPU chip pin" in (proc.stderr + proc.stdout)

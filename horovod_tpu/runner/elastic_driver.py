@@ -18,6 +18,7 @@ walk the same path as the reference's GPU host failures.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import shlex
@@ -30,13 +31,18 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-from .util import (FORWARD_ENV_PREFIXES, pin_tpu_chip,
+from .util import (forwardable_env, pin_tpu_chip,
                    find_free_port, local_hostnames, make_secret,
                    signed_dumps, verified_loads)
 
-BLACKLIST_FAILURES = 2          # consecutive fast failures before blacklisting
-DISCOVERY_INTERVAL_S = 1.0
-FAST_FAILURE_S = 15.0
+# Defaults; overridable per job via HOROVOD_ELASTIC_* (reference analog:
+# the elastic settings object carried from launch.py into the driver).
+BLACKLIST_FAILURES = int(os.environ.get(
+    "HOROVOD_ELASTIC_BLACKLIST_FAILURES", "2"))
+DISCOVERY_INTERVAL_S = float(os.environ.get(
+    "HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
+FAST_FAILURE_S = float(os.environ.get(
+    "HOROVOD_ELASTIC_FAST_FAILURE_SECS", "15.0"))
 
 
 class HostDiscovery:
@@ -212,9 +218,10 @@ class ElasticDriver:
             "HOROVOD_HOSTNAME": host,
         })
         # host_slots counts the slots assigned on this host in THIS
-        # generation (a max_np-capped lone worker stays unpinned with all
-        # chips visible, like the non-elastic launcher).
-        pin_tpu_chip(env, slot, host_slots)
+        # generation.  force=True: even a lone elastic worker is pinned to
+        # its slot's chip — one that claimed the whole host would collide
+        # with workers a later scale-up co-locates.
+        pin_tpu_chip(env, slot, host_slots, force=True)
         if host in local_hostnames():
             proc = subprocess.Popen(
                 self.command, env=env, stdout=subprocess.PIPE,
@@ -225,7 +232,7 @@ class ElasticDriver:
             env_str = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in env.items()
                 if k != "HOROVOD_ELASTIC_SECRET"
-                and k.startswith(FORWARD_ENV_PREFIXES))
+                and forwardable_env(k))
             remote = ("read -r HOROVOD_ELASTIC_SECRET; "
                       "export HOROVOD_ELASTIC_SECRET; "
                       f"cd {shlex.quote(os.getcwd())} && env {env_str} " +
@@ -351,9 +358,7 @@ class ElasticDriver:
         with self._lock:
             occupied = {(w.host, w.slot) for w in self._workers.values()
                         if not w.dead and w.host in target}
-        slots_per_host: Dict[str, int] = {}
-        for h, _ in slots:
-            slots_per_host[h] = slots_per_host.get(h, 0) + 1
+        slots_per_host = collections.Counter(h for h, _ in slots)
         for (h, i) in slots:
             if (h, i) not in occupied:
                 self._spawn(h, i, gen, slots_per_host[h])

@@ -23,7 +23,7 @@ import sys
 import threading
 from typing import Dict, List, Optional
 
-from .util import (FORWARD_ENV_PREFIXES, assign_ranks, find_free_port,
+from .util import (assign_ranks, find_free_port, forwardable_env,
                    local_hostnames, parse_hosts, pin_tpu_chip)
 
 
@@ -214,7 +214,7 @@ class WorkerProcesses:
             else:  # remote launch over ssh with env forwarding
                 env_str = " ".join(
                     f"{k}={shlex.quote(v)}" for k, v in env.items()
-                    if k.startswith(FORWARD_ENV_PREFIXES))
+                    if forwardable_env(k))
                 ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
                 if ssh_port:
                     ssh_cmd += ["-p", str(ssh_port)]

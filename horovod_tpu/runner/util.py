@@ -129,25 +129,38 @@ def verified_loads(line: str, secret):
     return json.loads(msg["p"])
 
 
-# Env prefixes both launchers forward to remote (ssh) workers.
-FORWARD_ENV_PREFIXES = ("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_",
-                        "TPU_")
+# Env prefixes both launchers forward to remote (ssh) workers.  The TPU_
+# namespace is deliberately NOT a prefix here: a TPU-VM's environment
+# carries instance-specific runtime vars (TPU_WORKER_ID, TPU_WORKER_
+# HOSTNAMES, ...) that must not clobber the remote VM's own; only the
+# pinning vars the launcher itself sets travel, by exact name.
+FORWARD_ENV_PREFIXES = ("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_")
+FORWARD_ENV_NAMES = ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS")
 
 
-def pin_tpu_chip(env: dict, local_rank: int, local_size: int) -> None:
+def forwardable_env(k: str) -> bool:
+    return k.startswith(FORWARD_ENV_PREFIXES) or k in FORWARD_ENV_NAMES
+
+
+def pin_tpu_chip(env: dict, local_rank: int, local_size: int,
+                 force: bool = False) -> None:
     """Pin a co-located worker to its own TPU chip (libtpu is single-owner
     per chip — the GPU analog is the local-rank device pinning the
     reference's launcher relies on).
 
     With one worker on the host nothing is touched (the worker may use all
-    chips, and an explicit user pin is honored).  With several co-located
-    workers a single inherited ``TPU_VISIBLE_CHIPS`` would hand every
-    worker the same chip and crash all but the first claim, so it is
-    overridden per worker.
+    chips, and an explicit user pin is honored) unless ``force`` is set —
+    the elastic driver always pins, because a lone worker that claimed the
+    whole host would collide with workers spawned by a later scale-up.
+    With several co-located workers a single inherited ``TPU_VISIBLE_CHIPS``
+    would hand every worker the same chip and crash all but the first
+    claim, so it is overridden per worker.
     """
-    if local_size <= 1:
+    if local_size <= 1 and not force:
         return
     if "TPU_VISIBLE_CHIPS" in env or "TPU_VISIBLE_DEVICES" in env:
+        if local_size <= 1:
+            return  # a single worker's explicit pin can be correct: honor it
         import sys
 
         print(f"horovod_tpu: overriding inherited TPU chip pin for "
@@ -155,5 +168,6 @@ def pin_tpu_chip(env: dict, local_rank: int, local_size: int) -> None:
               "host; a single global pin cannot be per-worker correct)",
               file=sys.stderr)
         env.pop("TPU_VISIBLE_DEVICES", None)
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
     env["TPU_VISIBLE_CHIPS"] = str(local_rank)
     env.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "1,1,1")

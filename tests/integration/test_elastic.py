@@ -25,20 +25,29 @@ WORKER_SCRIPT = textwrap.dedent("""
     hvd.init()
     state = hvd.elastic.ObjectState(epoch=0, total=0.0)
 
-    KILL_EPOCH = int(os.environ.get("TEST_KILL_EPOCH", "-1"))
-    KILL_FLAG = os.environ.get("TEST_KILL_FLAG", "")
     PRE_KILL_TOUCH = os.environ.get("TEST_PRE_KILL_TOUCH", "")
+    # One or more scripted self-kills: "epoch:flagfile" pairs; each fires
+    # once (the flag file records that the death already happened).
+    KILLS = []
+    if os.environ.get("TEST_KILL_EPOCH", "-1") != "-1":
+        KILLS.append((int(os.environ["TEST_KILL_EPOCH"]),
+                      os.environ.get("TEST_KILL_FLAG", "")))
+    for spec in os.environ.get("TEST_KILLS", "").split(","):
+        if spec:
+            ep, flag = spec.split(":", 1)
+            KILLS.append((int(ep), flag))
 
     @hvd.elastic.run
     def train(state):
         while state.epoch < 6:
-            if (KILL_EPOCH >= 0 and state.epoch == KILL_EPOCH
-                    and hvd.rank() == hvd.size() - 1 and hvd.size() > 1
-                    and KILL_FLAG and not os.path.exists(KILL_FLAG)):
-                if PRE_KILL_TOUCH:
-                    open(PRE_KILL_TOUCH, "w").write("x")
-                open(KILL_FLAG, "w").write("died")
-                os.kill(os.getpid(), 9)
+            for ep, flag in KILLS:
+                if (state.epoch == ep and hvd.rank() == hvd.size() - 1
+                        and hvd.size() > 1 and flag
+                        and not os.path.exists(flag)):
+                    if PRE_KILL_TOUCH:
+                        open(PRE_KILL_TOUCH, "w").write("x")
+                    open(flag, "w").write("died")
+                    os.kill(os.getpid(), 9)
             val = hvd.allreduce(np.ones(4, np.float32),
                                 name=f"step.{state.epoch}")
             state.total += float(val.sum())
@@ -132,3 +141,21 @@ def test_elastic_discovery_blip_reuses_last_hosts():
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "epoch=6" in proc.stdout
         assert "reusing previous host set" in proc.stderr, proc.stderr
+
+
+def test_elastic_survives_repeated_kills():
+    """Chaos: the highest rank dies at epoch 1 AND the (respawned) highest
+    rank dies again at epoch 3.  With the blacklist threshold raised via
+    env, the driver re-forms twice and training still completes."""
+    with tempfile.TemporaryDirectory() as td:
+        f1 = os.path.join(td, "k1.flag")
+        f2 = os.path.join(td, "k2.flag")
+        proc = _run_launcher(
+            ["--min-np", "1", "-np", "2", "-H", "localhost:2", "--verbose"],
+            env_extra={"TEST_KILLS": f"1:{f1},3:{f2}",
+                       "HOROVOD_ELASTIC_BLACKLIST_FAILURES": "10"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "epoch=6" in proc.stdout
+        assert os.path.exists(f1) and os.path.exists(f2), proc.stderr
+        # Two deaths -> at least three formations.
+        assert proc.stderr.count(" formed with ") >= 3, proc.stderr

@@ -19,6 +19,14 @@ from .parallel import mesh as _mesh
 
 log = get_logger()
 
+# jax.distributed runtime state owned by this module.  The runtime is
+# process-level: across hvd shutdown/init cycles with unchanged
+# (coordinator, size, rank) it is simply reused; an elastic round that
+# reassigns any of them tears it down and re-initializes (clearing XLA
+# backends first — jax refuses to re-initialize once a backend exists).
+_jax_distributed_up = False
+_jax_dist_params = None
+
 
 def init(comm=None, process_sets: Optional[Sequence] = None,
          config: Optional[Config] = None, build_mesh: bool = True) -> None:
@@ -59,11 +67,35 @@ def init(comm=None, process_sets: Optional[Sequence] = None,
                 jax.config.update("jax_cpu_collectives_implementation", "gloo")
             except Exception:
                 pass
-        jax.distributed.initialize(
-            coordinator_address=os.environ.get("HOROVOD_JAX_COORDINATOR"),
-            num_processes=cfg.size,
-            process_id=cfg.rank,
-        )
+        global _jax_distributed_up, _jax_dist_params
+        # The elastic generation epoch participates so every process of a
+        # new generation re-initializes together (a survivor must not keep
+        # a runtime whose coordination service already saw a peer die).
+        params = (os.environ.get("HOROVOD_JAX_COORDINATOR"), cfg.size,
+                  cfg.rank, os.environ.get("HOROVOD_ELASTIC_GENERATION"))
+        if not (_jax_distributed_up and _jax_dist_params == params):
+            if _jax_distributed_up:
+                try:
+                    jax.distributed.shutdown()
+                except Exception as exc:
+                    log.warning("jax.distributed shutdown failed: %s", exc)
+                _jax_distributed_up = False
+                try:
+                    # Public alias removed in newer jax; the impl lives in
+                    # jax._src.api.  Cleared backends let initialize() pass
+                    # its backends_are_initialized() guard.
+                    from jax._src import api as _jax_api
+
+                    _jax_api.clear_backends()
+                except Exception as exc:
+                    log.warning("clear_backends failed: %s", exc)
+            jax.distributed.initialize(
+                coordinator_address=params[0],
+                num_processes=cfg.size,
+                process_id=cfg.rank,
+            )
+            _jax_distributed_up = True
+            _jax_dist_params = params
 
     if build_mesh:
         try:
@@ -85,6 +117,9 @@ def init(comm=None, process_sets: Optional[Sequence] = None,
 
 
 def shutdown() -> None:
+    # The jax.distributed runtime deliberately survives shutdown: it is
+    # process-level, and the next init reuses it when (coordinator, size,
+    # rank) are unchanged or re-initializes when they differ (elastic).
     HorovodContext.shutdown()
     _mesh.reset()
 

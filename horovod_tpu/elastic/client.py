@@ -141,6 +141,12 @@ class ElasticCoordinatorClient:
         os.environ["HOROVOD_CONTROLLER"] = "socket"
         os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = a["rendezvous_addr"]
         os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(a["rendezvous_port"])
+        # Generation epoch: forces EVERY process (survivor or respawn) to
+        # make the same jax.distributed reuse-vs-reinit decision — a
+        # survivor reusing a stale runtime while a replacement freshly
+        # initializes against it would hang the pod.
+        os.environ["HOROVOD_ELASTIC_GENERATION"] = str(
+            a.get("generation", 0))
         return a
 
     def mark_ready(self) -> None:

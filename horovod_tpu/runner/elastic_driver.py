@@ -37,12 +37,26 @@ from .util import (forwardable_env, pin_tpu_chip,
 
 # Defaults; overridable per job via HOROVOD_ELASTIC_* (reference analog:
 # the elastic settings object carried from launch.py into the driver).
-BLACKLIST_FAILURES = int(os.environ.get(
-    "HOROVOD_ELASTIC_BLACKLIST_FAILURES", "2"))
-DISCOVERY_INTERVAL_S = float(os.environ.get(
-    "HOROVOD_ELASTIC_DISCOVERY_INTERVAL", "1.0"))
-FAST_FAILURE_S = float(os.environ.get(
-    "HOROVOD_ELASTIC_FAST_FAILURE_SECS", "15.0"))
+
+
+def _env_number(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        print(f"horovod_tpu: ignoring malformed {name}={raw!r} "
+              f"(using {default})", file=sys.stderr)
+        return default
+
+
+BLACKLIST_FAILURES = _env_number(
+    "HOROVOD_ELASTIC_BLACKLIST_FAILURES", 2, int)
+DISCOVERY_INTERVAL_S = _env_number(
+    "HOROVOD_ELASTIC_DISCOVERY_INTERVAL", 1.0, float)
+FAST_FAILURE_S = _env_number(
+    "HOROVOD_ELASTIC_FAST_FAILURE_SECS", 15.0, float)
 
 
 class HostDiscovery:
@@ -399,9 +413,7 @@ class ElasticDriver:
             else rdv_host
         rdv_port = find_free_port("0.0.0.0" if rdv_addr != "127.0.0.1"
                                   else "127.0.0.1")
-        local_sizes: Dict[str, int] = {}
-        for w in expected:
-            local_sizes[w.host] = local_sizes.get(w.host, 0) + 1
+        local_sizes = collections.Counter(w.host for w in expected)
         local_seen: Dict[str, int] = {}
         hosts_order = list(dict.fromkeys(w.host for w in expected))
         for rank, w in enumerate(expected):

@@ -135,7 +135,8 @@ def verified_loads(line: str, secret):
 # HOSTNAMES, ...) that must not clobber the remote VM's own; only the
 # pinning vars the launcher itself sets travel, by exact name.
 FORWARD_ENV_PREFIXES = ("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_")
-FORWARD_ENV_NAMES = ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS")
+FORWARD_ENV_NAMES = ("TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES",
+                     "TPU_CHIPS_PER_PROCESS_BOUNDS")
 
 
 def forwardable_env(k: str) -> bool:
@@ -159,13 +160,13 @@ def pin_tpu_chip(env: dict, local_rank: int, local_size: int,
     if local_size <= 1 and not force:
         return
     if "TPU_VISIBLE_CHIPS" in env or "TPU_VISIBLE_DEVICES" in env:
-        if local_size <= 1:
+        if local_size <= 1 and not force:
             return  # a single worker's explicit pin can be correct: honor it
         import sys
 
         print(f"horovod_tpu: overriding inherited TPU chip pin for "
-              f"local_rank {local_rank} ({local_size} workers share this "
-              "host; a single global pin cannot be per-worker correct)",
+              f"local_rank {local_rank} (per-slot pinning is required "
+              "here; an inherited global pin cannot be per-worker correct)",
               file=sys.stderr)
         env.pop("TPU_VISIBLE_DEVICES", None)
         env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"

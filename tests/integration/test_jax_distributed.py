@@ -43,6 +43,33 @@ WORKER = textwrap.dedent("""
     assert np.allclose(np.asarray(r), 2.0), r
     print(f"MULTIHOST OK rank={hvd.rank()}")
     hvd.shutdown()
+
+    # Elastic-reset shape 1: same (coordinator, size, rank) — the
+    # process-level jax.distributed runtime is reused across the cycle.
+    # Real elastic generations get a FRESH rendezvous port from the driver
+    # (back-to-back cycles on one fixed port race each other's teardown);
+    # derive one deterministically the same way on both workers.
+    base_port = int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"])
+    os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(base_port + 1)
+    hvd.init()
+    assert jax.process_count() == 2
+    r = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="mh2")
+    assert np.allclose(np.asarray(r), 2.0), r
+    print(f"REINIT OK rank={hvd.rank()}")
+    hvd.shutdown()
+
+    # Elastic-reset shape 2: rank reassignment (0 <-> 1) forces a full
+    # jax.distributed teardown + re-initialize in the same process.
+    old_rank = int(os.environ["HOROVOD_RANK"])
+    os.environ["HOROVOD_RANK"] = str(1 - old_rank)
+    os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(base_port + 2)
+    hvd.init()
+    assert jax.process_count() == 2
+    assert hvd.rank() == 1 - old_rank
+    r = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="mh3")
+    assert np.allclose(np.asarray(r), 2.0), r
+    print(f"RERANK OK rank={hvd.rank()}")
+    hvd.shutdown()
 """)
 
 
@@ -65,3 +92,5 @@ def test_multihost_mesh_np2():
             capture_output=True, text=True, timeout=180, env=env, cwd=td)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert proc.stdout.count("MULTIHOST OK") >= 2, proc.stdout
+        assert proc.stdout.count("REINIT OK") >= 2, proc.stdout
+        assert proc.stdout.count("RERANK OK") >= 2, proc.stdout

@@ -105,6 +105,80 @@ def _flash_attention_entry() -> dict:
     }
 
 
+def _bert_entry(mesh, deadline_s: float) -> dict:
+    """Secondary headline: BERT pretraining step throughput (BASELINE.md
+    config 3 is BERT-Large fp16 allreduce scaling; this records the
+    single-chip tokens/sec for a BERT-Base-shaped model in bf16 through
+    the same DistributedOptimizer data plane).  Skipped when the attempt
+    is running out of time — the ResNet headline must never be at risk."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    import horovod_tpu as hvd
+    from horovod_tpu import models
+
+    if time.monotonic() > deadline_s:
+        return {"bert_skipped": "time budget"}
+    n_dev = mesh.devices.size
+    if os.environ.get("_HVD_TPU_BENCH_TINY") == "1":  # CPU smoke in tests
+        batch, seq = 4 * n_dev, 32
+        cfg = models.BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                                num_heads=2, intermediate_size=128,
+                                max_position_embeddings=64,
+                                dtype=jnp.float32)
+    else:
+        batch, seq = 32 * n_dev, 128
+        cfg = models.BertConfig(vocab_size=30522, hidden_size=768,
+                                num_layers=12, num_heads=12,
+                                intermediate_size=3072,
+                                max_position_embeddings=512,
+                                dtype=jnp.bfloat16)
+    model = models.BertForPreTraining(cfg)
+    ids = jnp.ones((batch, seq), jnp.int32)
+    labels = jnp.zeros((batch, seq), jnp.int32)
+    weights = jnp.ones((batch, seq), jnp.float32)
+    params = jax.jit(
+        lambda: model.init(jax.random.PRNGKey(0), ids[:2]))()["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-4), axis_name="hvd")
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, ids, labels, weights):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, ids)
+            return models.mlm_loss(logits, labels, weights)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state,
+                hvd.allreduce(loss, axis_name="hvd"))
+
+    step = jax.jit(shard_map(train_step, mesh=mesh,
+                             in_specs=(P(), P(), P("hvd"), P("hvd"),
+                                       P("hvd")),
+                             out_specs=(P(), P(), P())),
+                   donate_argnums=(0, 1))
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, ids, labels,
+                                       weights)
+    float(loss)
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, ids, labels,
+                                       weights)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return {
+        "bert_base_tokens_per_sec_per_chip": round(
+            batch * seq * n_steps / dt / n_dev, 1),
+        "bert_base_step_ms": round(dt / n_steps * 1e3, 2),
+    }
+
+
 def _measure() -> None:
     import numpy as np
     import jax
@@ -116,6 +190,9 @@ def _measure() -> None:
     import horovod_tpu as hvd
     from horovod_tpu import models
 
+    # Secondary entries only start while at least ~5 min of the attempt
+    # remains (compile time included); the headline must never be at risk.
+    bert_deadline = time.monotonic() + _ATTEMPT_TIMEOUT_S - 300
     devices = jax.devices()
     n_dev = len(devices)
     _log(f"backend={jax.default_backend()} devices={n_dev} "
@@ -218,6 +295,12 @@ def _measure() -> None:
         result.update(_flash_attention_entry())
     except Exception as exc:  # never let the extra entry kill the headline
         result["flash_attn_error"] = str(exc)[:200]
+
+    try:
+        _log("bert pretraining micro-bench")
+        result.update(_bert_entry(mesh, bert_deadline))
+    except Exception as exc:
+        result["bert_error"] = str(exc)[:200]
 
     print(json.dumps(result), flush=True)
 

@@ -147,6 +147,12 @@ class ElasticCoordinatorClient:
         # initializes against it would hang the pod.
         os.environ["HOROVOD_ELASTIC_GENERATION"] = str(
             a.get("generation", 0))
+        # Per-generation jax.distributed coordinator (hosted by the new
+        # rank 0) — applied only for jax-distributed jobs; a launch-time
+        # static coordinator could live on a preempted host.
+        if (a.get("jax_coordinator")
+                and os.environ.get("HOROVOD_JAX_DISTRIBUTED") == "1"):
+            os.environ["HOROVOD_JAX_COORDINATOR"] = a["jax_coordinator"]
         return a
 
     def mark_ready(self) -> None:

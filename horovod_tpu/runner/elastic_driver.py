@@ -413,6 +413,13 @@ class ElasticDriver:
             else rdv_host
         rdv_port = find_free_port("0.0.0.0" if rdv_addr != "127.0.0.1"
                                   else "127.0.0.1")
+        # Fresh jax.distributed coordinator per generation, hosted by the
+        # new rank 0: a static launch-time coordinator would (a) live on a
+        # possibly-preempted host and (b) race the old coordinator's port
+        # release on rank reassignment.  Workers apply it only when the job
+        # runs with HOROVOD_JAX_DISTRIBUTED=1.
+        jax_coord = "%s:%d" % (rdv_addr, find_free_port(
+            "0.0.0.0" if rdv_addr != "127.0.0.1" else "127.0.0.1"))
         local_sizes = collections.Counter(w.host for w in expected)
         local_seen: Dict[str, int] = {}
         hosts_order = list(dict.fromkeys(w.host for w in expected))
@@ -429,6 +436,7 @@ class ElasticDriver:
                 "cross_size": len(hosts_order),
                 "rendezvous_addr": rdv_addr,
                 "rendezvous_port": rdv_port,
+                "jax_coordinator": jax_coord,
             })
         self._generation = gen
         self._formed_size = size

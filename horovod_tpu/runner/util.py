@@ -135,8 +135,10 @@ def verified_loads(line: str, secret):
 # HOSTNAMES, ...) that must not clobber the remote VM's own; only the
 # pinning vars the launcher itself sets travel, by exact name.
 FORWARD_ENV_PREFIXES = ("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_")
-FORWARD_ENV_NAMES = ("TPU_VISIBLE_CHIPS", "TPU_VISIBLE_DEVICES",
-                     "TPU_CHIPS_PER_PROCESS_BOUNDS")
+# TPU_VISIBLE_DEVICES is deliberately NOT forwarded: the launcher never
+# sets it, so forwarding would impose the launcher host's own local pin on
+# every remote VM.  Pin remote single-worker hosts on the host itself.
+FORWARD_ENV_NAMES = ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS")
 
 
 def forwardable_env(k: str) -> bool:
@@ -158,10 +160,10 @@ def pin_tpu_chip(env: dict, local_rank: int, local_size: int,
     claim, so it is overridden per worker.
     """
     if local_size <= 1 and not force:
+        # A lone worker keeps all chips; its explicit pin (if any) is
+        # honored as-is.
         return
     if "TPU_VISIBLE_CHIPS" in env or "TPU_VISIBLE_DEVICES" in env:
-        if local_size <= 1 and not force:
-            return  # a single worker's explicit pin can be correct: honor it
         import sys
 
         print(f"horovod_tpu: overriding inherited TPU chip pin for "

@@ -30,6 +30,20 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct for a pallas_call output, carrying the varying-
+    manual-axes type of ``like`` so the kernel can run inside shard_map
+    (check_vma requires outputs to declare their mesh-axis variance)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax without the vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                 causal: bool, block_q: int, block_k: int, valid_len: int):
     iq = pl.program_id(1)
@@ -72,9 +86,12 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    # Carries derive from q (not fresh constants) so they inherit its
+    # varying-manual-axes type when the kernel runs in interpret mode
+    # inside shard_map; on real TPU these are the same zeros.
+    acc0 = (q * 0).astype(jnp.float32)
+    m0 = (q[:, :1] * 0).astype(jnp.float32) + NEG_INF
+    l0 = (q[:, :1] * 0).astype(jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Log-sum-exp per query row, the residual the backward pass needs to
@@ -103,8 +120,8 @@ def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            _out_struct((bh, s, d), qb.dtype, qb),
+            _out_struct((bh, s), jnp.float32, qb),
         ],
         interpret=interpret,
     )(qb, kb, vb)
@@ -146,7 +163,7 @@ def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(
-        0, n_blocks, body, jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32))
+        0, n_blocks, body, (q * 0).astype(jnp.float32))
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
@@ -193,19 +210,22 @@ def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         return dk, dv
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk0 = (k * 0).astype(jnp.float32)
+    dv0 = (v * 0).astype(jnp.float32)
     dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (dk0, dv0))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
-                    block_k, interpret, valid_len):
+                    block_k, interpret, valid_len, dlse=None):
     bh, s, d = qb.shape
-    # delta_i = rowsum(dO_i * O_i) — the standard backward residual.
+    # delta_i = rowsum(dO_i * O_i) — the standard backward residual.  An
+    # lse cotangent (pair-valued VJP) folds in as delta - dlse.
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
                     axis=-1)                               # [BH, S]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                   block_k=block_k, valid_len=valid_len)
     qspec = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
@@ -218,7 +238,7 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
         grid=(bh, s // block_q),
         in_specs=[qspec, full, full, qspec, row_q, row_q],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), qb.dtype),
+        out_shape=_out_struct((bh, s, d), qb.dtype, qb),
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
     dk, dv = pl.pallas_call(
@@ -226,43 +246,75 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
         grid=(bh, s // block_k),
         in_specs=[full, kspec, kspec, full, row_full, row_full],
         out_specs=[kspec, kspec],
-        out_shape=[jax.ShapeDtypeStruct((bh, s, d), kb.dtype),
-                   jax.ShapeDtypeStruct((bh, s, d), vb.dtype)],
+        out_shape=[_out_struct((bh, s, d), kb.dtype, qb),
+                   _out_struct((bh, s, d), vb.dtype, qb)],
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
     return dq, dk, dv
 
 
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k, interpret,
-                valid_len):
-    """Differentiable kernel entry over [BH, S, D] (S already padded)."""
-    out, _ = _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
-                             interpret, valid_len)
-    return out
-
-
-def _flash_bhsd_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k,
+def _flash_bhsd_lse(qb, kb, vb, sm_scale, causal, block_q, block_k,
                     interpret, valid_len):
+    """Like ``_flash_bhsd`` but also returns the per-row log-sum-exp —
+    the pair (out, lse) is what ring attention needs to merge chunks.
+
+    The backward for the pair is the standard flash backward with one
+    twist: dL/dS_ij gains a ``+ dlse_i * p_ij`` term, which folds into the
+    existing kernels as ``delta_i -> delta_i - dlse_i`` (both enter as
+    ``ds = p * (dp - delta)``) — no separate kernels needed.
+    """
+    return _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                           interpret, valid_len)
+
+
+def _flash_bhsd_lse_fwd(qb, kb, vb, sm_scale, causal, block_q, block_k,
+                        interpret, valid_len):
     out, lse = _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q,
                                block_k, interpret, valid_len)
-    return out, (qb, kb, vb, out, lse)
+    return (out, lse), (qb, kb, vb, out, lse)
 
 
-def _flash_bhsd_bwd(sm_scale, causal, block_q, block_k, interpret, valid_len,
-                    res, dob):
+def _flash_bhsd_lse_bwd(sm_scale, causal, block_q, block_k, interpret,
+                        valid_len, res, cotangents):
     qb, kb, vb, ob, lse = res
+    dob, dlse = cotangents
     dq, dk, dv = _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal,
-                                 block_q, block_k, interpret, valid_len)
+                                 block_q, block_k, interpret, valid_len,
+                                 dlse=dlse)
     return dq, dk, dv
 
 
-_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+_flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
+
+
+def _check_kv_vmem(s: int, d: int, dtype) -> None:
+    # K and V live whole in VMEM (bandwidth-optimal: fetched once, not once
+    # per query block).  That caps the per-device sequence length; beyond
+    # it, shard the sequence instead (parallel.ring_attention on an sp
+    # axis, whose per-hop chunks come back under the cap).
+    kv_bytes = 2 * s * d * jnp.dtype(dtype).itemsize
+    if kv_bytes > 64 * 1024 * 1024:
+        raise ValueError(
+            f"flash_attention: K+V for seq_len={s}, head_dim={d} need "
+            f"{kv_bytes / 2**20:.0f} MiB of VMEM (>64 MiB budget). Shard "
+            "the sequence across devices with "
+            "horovod_tpu.parallel.ring_attention instead.")
 
 
 def dense_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
     """Reference-math dense attention over [B, S, H, D] (fp32 softmax)."""
+    out, _ = dense_attention_with_lse(q, k, v, causal, scale)
+    return out
+
+
+def dense_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None):
+    """Dense attention that also returns log-sum-exp [B, H, S] (the chunk
+    statistic ring attention merges across hops)."""
     scale = q.shape[-1] ** -0.5 if scale is None else scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -270,44 +322,30 @@ def dense_attention(q, k, v, causal: bool = False,
         s = q.shape[1]
         mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)      # [B, H, S]
+    probs = jnp.exp(logits - lse[..., None]).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out, lse
 
 
-def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """Attention over [batch, seq, heads, head_dim].
-
-    On TPU this is the Pallas kernel; elsewhere it falls back to the dense
-    implementation (identical math) unless ``interpret=True`` forces the
-    kernel through the Pallas interpreter (tests).
-    """
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Pallas attention over [B, S, H, D] returning ``(out, lse)`` with
+    lse shaped [B, H, S].  Same dispatch rules as :func:`flash_attention`;
+    off-TPU it falls back to :func:`dense_attention_with_lse`."""
     b, s, h, d = q.shape
     if interpret is None:
         if jax.default_backend() not in ("tpu", "axon"):
-            return dense_attention(q, k, v, causal, scale)
+            return dense_attention_with_lse(q, k, v, causal, scale)
         interpret = False
     sm_scale = d ** -0.5 if scale is None else scale
-    # K and V live whole in VMEM (bandwidth-optimal: fetched once, not once
-    # per query block).  That caps the per-device sequence length; beyond it,
-    # shard the sequence instead (parallel.ring_attention over an sp axis).
-    kv_bytes = 2 * s * d * jnp.dtype(k.dtype).itemsize
-    if kv_bytes > 64 * 1024 * 1024:
-        raise ValueError(
-            f"flash_attention: K+V for seq_len={s}, head_dim={d} need "
-            f"{kv_bytes / 2**20:.0f} MiB of VMEM (>64 MiB budget). Shard "
-            "the sequence across devices with "
-            "horovod_tpu.parallel.ring_attention instead.")
+    _check_kv_vmem(s, d, k.dtype)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if causal and block_q != block_k:
         block_q = block_k = min(block_q, block_k)
-    # Pad the sequence up to a multiple of BOTH block sizes (the q grid and
-    # the kv loop must each tile s_pad exactly), masking tail keys
-    # in-kernel; a dense fallback here would materialize the [S, S] scores
-    # this kernel exists to avoid.
     import math
 
     block = math.lcm(block_q, block_k)
@@ -321,7 +359,23 @@ def flash_attention(q, k, v, causal: bool = False,
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
-    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal,
-                      block_q, block_k, bool(interpret), s)
-    out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)
-    return out[:, :s]
+    out, lse = _flash_bhsd_lse(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale,
+                               causal, block_q, block_k, bool(interpret), s)
+    out = out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
+    lse = lse.reshape(b, h, s_pad)[:, :, :s]
+    return out, lse
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Attention over [batch, seq, heads, head_dim].
+
+    On TPU this is the Pallas kernel; elsewhere it falls back to the dense
+    implementation (identical math) unless ``interpret=True`` forces the
+    kernel through the Pallas interpreter (tests).
+    """
+    out, _ = flash_attention_with_lse(q, k, v, causal, scale, block_q,
+                                      block_k, interpret)
+    return out

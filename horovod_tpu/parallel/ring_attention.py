@@ -41,7 +41,10 @@ def _chunk_attention(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None,
+                   block_size: int = 128,
+                   interpret: bool = False):
     """Exact attention with the sequence dimension sharded over ``axis_name``.
 
     Args:
@@ -51,6 +54,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
       axis_name: mesh axis carrying the sequence shards (the SP axis).
       causal: apply a causal mask over *global* positions.
       scale: logit scale; defaults to head_dim ** -0.5.
+      use_flash: compute each hop's local chunk with the Pallas flash
+        kernel (linear memory in seq_local) instead of the dense
+        [Sq, Sk] einsum.  Opt-in for now (defaults off): semantics are
+        fully covered by interpret-mode tests, but the compiled
+        pallas-inside-switch-inside-scan composition has not yet been
+        validated on hardware, and flipping every sp-model silently onto
+        it would be reckless.  Flip the default after a hardware run.
+      block_size: flash kernel block size (use_flash only).
+      interpret: run the flash kernel in the Pallas interpreter (tests).
 
     Returns [batch, seq_local, heads, head_dim] in q.dtype.
     """
@@ -59,6 +71,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     seq_local = q.shape[1]
     head_dim = q.shape[-1]
     scale = head_dim ** -0.5 if scale is None else scale
+    if use_flash is None:
+        use_flash = False
     # Rotate K/V "upstream" so that at step i we hold chunk (my_idx - i) % n.
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -69,16 +83,53 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     q_pos = my_idx * seq_local + jnp.arange(seq_local)  # global q positions
 
+    def _flash_chunk(q, kc, vc, chunk_causal: bool):
+        """Chunk stats via the Pallas kernel: (out, lse) is equivalent to
+        the (ctx, m, l) triple with m := lse, l := 1 (sum of
+        exp(logits - lse) is 1 by construction)."""
+        from ..ops.flash_attention import flash_attention_with_lse
+
+        out, lse = flash_attention_with_lse(
+            q, kc, vc, causal=chunk_causal, scale=scale,
+            block_q=block_size, block_k=block_size,
+            interpret=interpret or None)
+        return (out.astype(jnp.float32), lse, lse * 0 + 1.0)
+
+    def _flash_cases(q, kc, vc):
+        """Relative to this rank's chunk, a hop's K/V chunk is entirely in
+        the past (full attention), the diagonal (causal within chunk), or
+        entirely in the future (no contribution).  The branch index is
+        data-dependent (src is traced), so lax.switch over three
+        statically-compiled kernels.  The zero branch derives from q so
+        all branches carry the same varying-manual-axes type."""
+        zrow = jnp.sum(q.astype(jnp.float32), axis=-1) * 0   # [B, S, H]
+        zrow = jnp.transpose(zrow, (0, 2, 1))                # [B, H, S]
+        zero = (q.astype(jnp.float32) * 0, zrow - jnp.inf, zrow)
+        return [
+            lambda _: _flash_chunk(q, kc, vc, False),   # src < my_idx
+            lambda _: _flash_chunk(q, kc, vc, True),    # src == my_idx
+            lambda _: zero,                             # src > my_idx
+        ]
+
     def body(i, carry):
         acc, m, l, kc, vc = carry
         src = (my_idx - i) % n  # whose chunk we currently hold
-        if causal:
-            k_pos = src * seq_local + jnp.arange(seq_local)
-            mask = q_pos[:, None] >= k_pos[None, :]        # [Sq, Sk]
-            mask = mask[None, None, :, :]
+        if use_flash:
+            if causal:
+                branch = jnp.where(
+                    src == my_idx, 1, jnp.where(src < my_idx, 0, 2))
+                ctx, m_c, l_c = lax.switch(branch, _flash_cases(q, kc, vc),
+                                           None)
+            else:
+                ctx, m_c, l_c = _flash_chunk(q, kc, vc, False)
         else:
-            mask = None
-        ctx, m_c, l_c = _chunk_attention(q, kc, vc, scale, mask)
+            if causal:
+                k_pos = src * seq_local + jnp.arange(seq_local)
+                mask = q_pos[:, None] >= k_pos[None, :]        # [Sq, Sk]
+                mask = mask[None, None, :, :]
+            else:
+                mask = None
+            ctx, m_c, l_c = _chunk_attention(q, kc, vc, scale, mask)
         # Online-softmax merge of (acc, m, l) with the new chunk's stats.
         m_new = jnp.maximum(m, m_c)
         # With a fully-masked chunk m_c = -inf; guard exp(-inf - -inf).

@@ -35,6 +35,7 @@ class BertConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     sp_axis_name: Optional[str] = None  # sequence-parallel mesh axis
+    sp_use_flash: bool = False          # flash kernel per ring hop
 
 
 BERT_BASE = BertConfig(hidden_size=768, num_layers=12, num_heads=12,
@@ -61,7 +62,8 @@ class SelfAttention(nn.Module):
             from ..parallel.ring_attention import ring_attention
 
             ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis_name,
-                                 causal=False)
+                                 causal=False,
+                                 use_flash=cfg.sp_use_flash)
         else:
             scale = head_dim ** -0.5
             # fp32 logits/softmax regardless of activation dtype.

@@ -27,6 +27,7 @@ class GPTConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     sp_axis_name: Optional[str] = None   # sequence-parallel mesh axis
+    sp_use_flash: bool = False           # flash kernel per ring hop
     use_flash: bool = True               # Pallas kernel on TPU
     remat: bool = False                  # jax.checkpoint each block
 
@@ -51,7 +52,8 @@ class CausalSelfAttention(nn.Module):
             from ..parallel.ring_attention import ring_attention
 
             ctx = ring_attention(q, k, v, axis_name=cfg.sp_axis_name,
-                                 causal=True)
+                                 causal=True,
+                                 use_flash=cfg.sp_use_flash)
         elif cfg.use_flash:
             from ..ops.flash_attention import flash_attention
 

@@ -246,8 +246,8 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
         grid=(bh, s // block_k),
         in_specs=[full, kspec, kspec, full, row_full, row_full],
         out_specs=[kspec, kspec],
-        out_shape=[_out_struct((bh, s, d), kb.dtype, qb),
-                   _out_struct((bh, s, d), vb.dtype, qb)],
+        out_shape=[_out_struct((bh, s, d), kb.dtype, kb),
+                   _out_struct((bh, s, d), vb.dtype, vb)],
         interpret=interpret,
     )(qb, kb, vb, dob, lse, delta)
     return dq, dk, dv
@@ -258,8 +258,9 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_bhsd_lse(qb, kb, vb, sm_scale, causal, block_q, block_k,
                     interpret, valid_len):
-    """Like ``_flash_bhsd`` but also returns the per-row log-sum-exp —
-    the pair (out, lse) is what ring attention needs to merge chunks.
+    """Differentiable kernel entry over [BH, S, D] (S already padded),
+    returning ``(out, lse)`` — the pair ring attention merges across hops
+    (the public ``flash_attention`` wrapper simply discards the lse).
 
     The backward for the pair is the standard flash backward with one
     twist: dL/dS_ij gains a ``+ dlse_i * p_ij`` term, which folds into the

@@ -42,7 +42,7 @@ def _chunk_attention(q, k, v, scale, mask):
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None,
-                   use_flash: Optional[bool] = None,
+                   use_flash: bool = False,
                    block_size: int = 128,
                    interpret: bool = False):
     """Exact attention with the sequence dimension sharded over ``axis_name``.
@@ -71,8 +71,6 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     seq_local = q.shape[1]
     head_dim = q.shape[-1]
     scale = head_dim ** -0.5 if scale is None else scale
-    if use_flash is None:
-        use_flash = False
     # Rotate K/V "upstream" so that at step i we hold chunk (my_idx - i) % n.
     perm = [(j, (j + 1) % n) for j in range(n)]
 

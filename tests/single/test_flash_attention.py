@@ -163,3 +163,30 @@ def test_flash_kernel_grads_padded_seq(causal):
     for a, b_ in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_flash_kernel_grads_bf16():
+    """bf16 inputs through the backward kernels (the dtype the models
+    train in): grads match dense within bf16 tolerance."""
+    b, s, h, d = 1, 32, 2, 16
+    key = jax.random.PRNGKey(13)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16,
+            interpret=True).astype(jnp.float32)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(dense_attention(
+            q, k, v, causal=True).astype(jnp.float32)))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                   np.asarray(b_, dtype=np.float32),
+                                   rtol=0.1, atol=0.05)

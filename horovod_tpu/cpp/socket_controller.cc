@@ -13,6 +13,12 @@ namespace hvdtpu {
 namespace {
 
 constexpr double kConnectTimeoutS = 60.0;
+// Rendezvous HELLO preamble.  The magic rejects stray/garbage connections;
+// the version must be bumped whenever the negotiation wire format changes
+// (requests, responses, cache frames) so mixed-build jobs fail with a
+// named error instead of desynchronized garbled frames.
+constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
+constexpr int32_t kProtocolVersion = 2;         // v2: handles on the wire
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -59,9 +65,36 @@ Status SocketController::Initialize() {
       }
       Socket s = listener_.Accept(1.0);
       if (!s.valid()) continue;
+      // Bound the HELLO read: a connect-and-stay-silent stray must not
+      // block the accept loop past the rendezvous deadline.
+      s.SetRecvTimeout(5.0);
       std::string hello;
-      if (!s.RecvFrame(&hello)) continue;
+      if (!s.RecvFrame(&hello)) {
+        HVD_LOG(WARNING) << "dropping silent/broken rendezvous connection "
+                         << "from " << s.PeerAddr();
+        continue;
+      }
       Reader r(hello);
+      int32_t magic = r.GetI32();
+      if (magic != kProtocolMagic) {
+        // Not one of ours (port scanner, stale client, or a pre-v2 build
+        // whose HELLO starts with its rank): drop and keep waiting rather
+        // than failing the whole rendezvous.
+        HVD_LOG(WARNING)
+            << "dropping rendezvous connection from " << s.PeerAddr()
+            << " with bad protocol magic (stray client, or a worker from "
+               "an older horovod_tpu build)";
+        continue;
+      }
+      int32_t version = r.GetI32();
+      if (version != kProtocolVersion) {
+        return Status::Error(
+            StatusCode::PRECONDITION_ERROR,
+            "protocol version mismatch: coordinator v" +
+                std::to_string(kProtocolVersion) + ", worker v" +
+                std::to_string(version) +
+                " — all ranks must run the same horovod_tpu build");
+      }
       int rank = r.GetI32();
       int data_port = r.GetI32();
       if (rank <= 0 || rank >= cfg_.size || ctrl_socks_[rank].valid()) {
@@ -70,6 +103,7 @@ Status SocketController::Initialize() {
       }
       addrs[rank] = s.PeerAddr();
       ports[rank] = data_port;
+      s.SetRecvTimeout(0);  // ctrl-channel reads are blocking again
       ctrl_socks_[rank] = std::move(s);
       --needed;
     }
@@ -95,6 +129,8 @@ Status SocketController::Initialize() {
                                std::to_string(cfg_.rendezvous_port));
     }
     Writer hello;
+    hello.PutI32(kProtocolMagic);
+    hello.PutI32(kProtocolVersion);
     hello.PutI32(cfg_.rank);
     hello.PutI32(data_listener_.port());
     if (!coord_ctrl_.SendFrame(hello.data())) {

@@ -207,6 +207,13 @@ std::string Socket::PeerAddr() const {
   return buf;
 }
 
+void Socket::SetRecvTimeout(double seconds) {
+  timeval tv{};
+  tv.tv_sec = static_cast<time_t>(seconds);
+  tv.tv_usec = static_cast<suseconds_t>((seconds - tv.tv_sec) * 1e6);
+  ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 namespace {
 
 bool SetNonblocking(int fd, bool on) {

@@ -108,6 +108,10 @@ class Socket {
   bool RecvAll(void* p, size_t n);
   // Peer IPv4 address ("1.2.3.4") of a connected socket, "" on error.
   std::string PeerAddr() const;
+  // Kernel receive timeout; 0 restores blocking reads.  Used to bound the
+  // rendezvous HELLO read so a connect-and-stay-silent stray cannot wedge
+  // the accept loop.
+  void SetRecvTimeout(double seconds);
   void Close();
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }

@@ -642,3 +642,125 @@ def _early_exit_worker():
 
 def test_clean_early_exit_np2():
     assert run(_early_exit_worker, np=2) == [0, 1]
+
+
+def _rendezvous_worker_script(tmpdir):
+    import os
+    import textwrap
+    path = os.path.join(tmpdir, "rdv_worker.py")
+    with open(path, "w") as f:
+        f.write(textwrap.dedent("""
+            import os, sys
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init(build_mesh=False)
+            out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                name="rdv")
+            assert float(out.sum()) == 4.0, out
+            print(f"RDV OK rank={hvd.rank()}")
+            hvd.shutdown()
+        """))
+    return path
+
+
+def _spawn_rank(script, rank, port):
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": "2",
+        "HOROVOD_LOCAL_RANK": str(rank), "HOROVOD_LOCAL_SIZE": "2",
+        "HOROVOD_CONTROLLER": "socket",
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+    })
+    return subprocess.Popen([sys.executable, script],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True, env=env)
+
+
+def test_rendezvous_ignores_stray_connections():
+    """A garbage connection to the rendezvous port (port scanner, stale
+    client) must be dropped, not fail the job: the real worker still
+    rendezvouses and the collective completes."""
+    import os
+    import socket as socketlib
+    import struct
+    import tempfile
+    import time
+
+    from horovod_tpu.runner.util import find_free_port
+
+    with tempfile.TemporaryDirectory() as td:
+        script = _rendezvous_worker_script(td)
+        port = find_free_port()
+        p0 = _spawn_rank(script, 0, port)
+        # Two strays: one sends a wrong-magic frame, one connects and
+        # stays silent (must be dropped by the HELLO read timeout).
+        payload = struct.pack("<iiii", 0x600DF00D, 1, 1, 12345)
+        sent = False
+        silent = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not sent:
+            try:
+                s = socketlib.create_connection(("127.0.0.1", port),
+                                                timeout=2)
+                s.sendall(struct.pack("<I", len(payload)) + payload)
+                s.close()
+                silent = socketlib.create_connection(("127.0.0.1", port),
+                                                     timeout=2)
+                sent = True
+            except OSError:
+                time.sleep(0.2)
+        assert sent, "stray payload was never delivered"
+        p1 = _spawn_rank(script, 1, port)
+        out0, _ = p0.communicate(timeout=120)
+        out1, _ = p1.communicate(timeout=120)
+        if silent is not None:
+            silent.close()
+        assert p0.returncode == 0 and "RDV OK" in out0, out0
+        assert p1.returncode == 0 and "RDV OK" in out1, out1
+
+
+def test_rendezvous_rejects_version_mismatch():
+    """A worker speaking a different protocol version fails the job with a
+    named error (not garbled frames)."""
+    import os
+    import socket as socketlib
+    import struct
+    import tempfile
+    import time
+
+    from horovod_tpu.runner.util import find_free_port
+
+    with tempfile.TemporaryDirectory() as td:
+        script = _rendezvous_worker_script(td)
+        port = find_free_port()
+        p0 = _spawn_rank(script, 0, port)
+        payload = struct.pack("<iiii", 0x48565354, 999, 1, 12345)
+        deadline = time.monotonic() + 30
+        s = None
+        while time.monotonic() < deadline:
+            try:
+                s = socketlib.create_connection(("127.0.0.1", port),
+                                                timeout=2)
+                s.sendall(struct.pack("<I", len(payload)) + payload)
+                break
+            except OSError:
+                time.sleep(0.2)
+        assert s is not None, "version-mismatch payload was never delivered"
+        out0, _ = p0.communicate(timeout=120)
+        s.close()
+        assert p0.returncode != 0, out0
+        assert "protocol version mismatch" in out0, out0

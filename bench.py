@@ -360,6 +360,8 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
         "error": last_err[-800:],
+        "note": "TPU backend unreachable this run; PERF.md records the "
+                "last successful on-chip measurements and methodology",
     }), flush=True)
     sys.exit(1)
 

@@ -51,7 +51,7 @@ from .exceptions import (  # noqa: F401
 try:  # optimizer requires optax; keep the core importable without it
     from .optimizer import (  # noqa: F401
         DistributedOptimizer, DistributedGradientTransformation,
-        allreduce_gradients,
+        allreduce_gradients, clip_by_global_norm,
     )
 except ImportError:  # pragma: no cover
     pass

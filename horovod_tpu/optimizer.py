@@ -314,10 +314,13 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
     _axes = lambda: _resolve_axes(axis_name)  # noqa: E731
 
     def _flatten(tree):
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
-        vec = jnp.concatenate(
+        leaves = jax.tree_util.tree_leaves(tree)
+        if not leaves:
+            raise ValueError(
+                "shard_optimizer_states=True needs a non-empty parameter/"
+                "gradient pytree (nothing to shard)")
+        return jnp.concatenate(
             [jnp.ravel(x).astype(jnp.float32) for x in leaves])
-        return vec, leaves, treedef
 
     def _shard_geometry(total):
         from jax import lax
@@ -337,7 +340,7 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
     def _param_shard(params):
         from jax import lax
 
-        vec, pleaves, ptreedef = _flatten(params)
+        vec = _flatten(params)
         axes, shard_ax, n, chunk = _shard_geometry(vec.size)
         vec = jnp.pad(vec, (0, n * chunk - vec.size))
         idx = lax.axis_index(shard_ax)
@@ -374,7 +377,7 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
             return _jit_ops.ensure_varying(leaf, axes)
 
         grads = jax.tree_util.tree_map(normalize, grads)
-        gvec, _, _ = _flatten(grads)
+        gvec = _flatten(grads)
         pleaves, ptreedef = jax.tree_util.tree_flatten(params)
         total = gvec.size
         _, shard_ax, n, chunk = _shard_geometry(total)
@@ -418,6 +421,43 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
             offset += leaf.size
         return (jax.tree_util.tree_unflatten(ptreedef, updates),
                 ShardedOptState(inner_state=new_inner, master=new_master))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def clip_by_global_norm(max_norm: float, axis_name=None
+                        ) -> optax.GradientTransformation:
+    """Global-norm gradient clipping that can see across mesh ranks.
+
+    Without ``axis_name`` this is optax.clip_by_global_norm over whatever
+    tree it receives.  With ``axis_name`` the squared norm is additionally
+    psummed over those axes — required as the INNER transform of
+    ``shard_optimizer_states=True`` (each rank holds only its 1/n chunk,
+    so a local norm would misclip):
+
+        tx = hvd.DistributedOptimizer(
+            optax.chain(hvd.clip_by_global_norm(1.0, axis_name="dp"),
+                        optax.adam(1e-3)),
+            axis_name="dp", shard_optimizer_states=True)
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        from jax import lax
+
+        del params
+        leaves = jax.tree_util.tree_leaves(updates)
+        local = sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                    for l in leaves)
+        if axis_name is not None:
+            local = lax.psum(local, _resolve_axes(axis_name))
+        norm = jnp.sqrt(local)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return (jax.tree_util.tree_map(
+            lambda l: l * scale.astype(l.dtype), updates), state)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

@@ -283,3 +283,37 @@ def test_sharded_optimizer_master_weights_bf16():
                           out_specs=P()))(jnp.zeros(4))
     # 16 steps x 2^-11 = 2^-7 total: one full bf16 ulp below 1.0 at least.
     assert float(np.asarray(p["w"], np.float32)[0]) < 1.0, p
+
+
+def test_sharded_optimizer_with_cross_rank_clip():
+    """Global-norm clipping inside the sharded wrapper (norm psummed over
+    the axis) must match replicated optax.chain(clip, sgd)."""
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+    max_norm = 0.1
+    tx_rep = hvd.DistributedOptimizer(
+        optax.chain(optax.clip_by_global_norm(max_norm), optax.sgd(1.0)),
+        axis_name="dp")
+    tx_sh = hvd.DistributedOptimizer(
+        optax.chain(hvd.clip_by_global_norm(max_norm, axis_name="dp"),
+                    optax.sgd(1.0)),
+        axis_name="dp", shard_optimizer_states=True)
+    params = {"w": jnp.linspace(1.0, 2.0, 6, dtype=jnp.float32),
+              "b": jnp.ones((5,), jnp.float32)}   # total 11, chunk 3
+
+    def one_step(tx):
+        def fn(x):
+            x = x[0]
+            grads = {"w": params["w"] * x[:6], "b": params["b"] + x[:5]}
+            state = tx.init(params)
+            updates, _ = tx.update(grads, state, params)
+            return optax.apply_updates(params, updates)
+
+        return jax.jit(shard_map(fn, mesh=mesh, in_specs=P("dp"),
+                                 out_specs=P()))(
+            jnp.arange(4 * 8, dtype=jnp.float32).reshape(4, 8))
+
+    p_rep = one_step(tx_rep)
+    p_sh = one_step(tx_sh)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_sh[k]), np.asarray(p_rep[k]),
+                                   rtol=1e-5, atol=1e-5)

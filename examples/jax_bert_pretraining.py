@@ -33,6 +33,8 @@ def main():
     ap.add_argument("--batch-per-chip", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--sp-flash", action="store_true",
+                    help="Pallas flash kernel per ring-attention hop")
     args = ap.parse_args()
 
     hvd.init()
@@ -57,6 +59,7 @@ def main():
     import dataclasses
 
     cfg = dataclasses.replace(base, sp_axis_name=sp_axis,
+                              sp_use_flash=args.sp_flash,
                               max_position_embeddings=max(
                                   args.seq_len, base.max_position_embeddings))
     model = models.BertForPreTraining(cfg)

@@ -35,6 +35,9 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--remat", action="store_true",
                     help="checkpoint each block (HBM for FLOPs)")
+    ap.add_argument("--sp-flash", action="store_true",
+                    help="Pallas flash kernel per ring-attention hop "
+                         "(linear memory in the per-device chunk)")
     args = ap.parse_args()
 
     hvd.init()
@@ -45,6 +48,7 @@ def main():
 
     cfg = dataclasses.replace(
         models.GPT_TINY, sp_axis_name="sp" if sp > 1 else None,
+        sp_use_flash=args.sp_flash,
         max_seq_len=args.seq_len, remat=args.remat)
     model = models.GPT(cfg)
     cfg_init = dataclasses.replace(cfg, sp_axis_name=None)

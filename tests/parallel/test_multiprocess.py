@@ -764,3 +764,80 @@ def test_rendezvous_rejects_version_mismatch():
         s.close()
         assert p0.returncode != 0, out0
         assert "protocol version mismatch" in out0, out0
+
+
+def _soak_worker():
+    """Randomized differential soak: a seeded schedule of mixed collectives
+    (op type, dtype, shape, sync/async bursts) is identical on every rank;
+    payloads are rank-dependent; every result is checked against the numpy
+    ground truth.  Exercises negotiation, fusion, the response cache, and
+    arrival-order interleavings far beyond the hand-written cases."""
+    import random
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r, size = hvd.rank(), hvd.size()
+    sched = random.Random(0xC0FFEE)        # same schedule on all ranks
+    jitter = random.Random(1000 + r)       # rank-local timing jitter
+    dtypes = [np.float32, np.float64, np.int32, np.float16]
+
+    def payload(i, rank, dt, n):
+        return (np.arange(n) % 7 + rank + i % 5).astype(dt)
+
+    def flush(pending):
+        for h, j, dt2, n2 in pending:
+            want = sum(payload(j, rr, dt2, n2) for rr in range(size))
+            np.testing.assert_allclose(
+                np.asarray(hvd.synchronize(h), np.float64),
+                want.astype(np.float64),
+                rtol=1e-3 if dt2 == np.float16 else 1e-6)
+
+    pending = []
+    for i in range(120):
+        kind = sched.choice(["allreduce", "allreduce_async", "allgather",
+                             "broadcast", "barrier"])
+        dt = sched.choice(dtypes)
+        n = sched.choice([1, 3, 16, 257])
+        name = f"soak.{i}"
+        if jitter.random() < 0.1:
+            import time
+            time.sleep(jitter.random() * 0.002)
+        if kind == "allreduce":
+            out = hvd.allreduce(payload(i, r, dt, n), op=hvd.Sum, name=name)
+            want = sum(payload(i, rr, dt, n) for rr in range(size))
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), want.astype(np.float64),
+                rtol=1e-3 if dt == np.float16 else 1e-6)
+        elif kind == "allreduce_async":
+            h = hvd.allreduce_async(payload(i, r, dt, n), op=hvd.Sum,
+                                    name=name)
+            pending.append((h, i, dt, n))
+            if len(pending) >= sched.randint(2, 6):
+                flush(pending)
+                pending = []
+        elif kind == "allgather":
+            rows = (r % 2) + 1      # ragged first dim
+            data = np.full((rows, max(n % 5, 1)), float(r), dt)
+            out = np.asarray(hvd.allgather(data, name=name))
+            want = np.concatenate(
+                [np.full(((rr % 2) + 1, max(n % 5, 1)), float(rr), dt)
+                 for rr in range(size)])
+            np.testing.assert_allclose(out.astype(np.float64),
+                                       want.astype(np.float64))
+        elif kind == "broadcast":
+            root = sched.randrange(size)
+            out = hvd.broadcast(payload(i, r, dt, n), root_rank=root,
+                                name=name)
+            np.testing.assert_allclose(np.asarray(out, np.float64),
+                                       payload(i, root, dt, n)
+                                       .astype(np.float64))
+        else:
+            hvd.barrier()
+    flush(pending)
+    hvd.shutdown()
+    return r
+
+
+def test_soak_mixed_collectives_np3():
+    assert run(_soak_worker, np=3) == [0, 1, 2]

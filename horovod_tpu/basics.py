@@ -13,6 +13,7 @@ import os
 from typing import List, Optional, Sequence
 
 from .context import HorovodContext
+from .exceptions import HorovodInternalError
 from .utils.env import Config, get_bool
 from .utils.logging import get_logger
 from .parallel import mesh as _mesh
@@ -80,15 +81,35 @@ def init(comm=None, process_sets: Optional[Sequence] = None,
                 except Exception as exc:
                     log.warning("jax.distributed shutdown failed: %s", exc)
                 _jax_distributed_up = False
-                try:
-                    # Public alias removed in newer jax; the impl lives in
-                    # jax._src.api.  Cleared backends let initialize() pass
-                    # its backends_are_initialized() guard.
-                    from jax._src import api as _jax_api
+                # Cleared backends let initialize() pass its
+                # backends_are_initialized() guard.  Try the public API
+                # first; the private impl is a fallback for jax versions
+                # where the alias was removed.
+                cleared = False
+                public = getattr(jax, "clear_backends", None)
+                if public is not None:
+                    try:
+                        public()
+                        cleared = True
+                    except Exception as exc:
+                        log.warning("jax.clear_backends failed: %s", exc)
+                if not cleared:
+                    try:
+                        from jax._src import api as _jax_api
 
-                    _jax_api.clear_backends()
-                except Exception as exc:
-                    log.warning("clear_backends failed: %s", exc)
+                        _jax_api.clear_backends()
+                        cleared = True
+                    except Exception as exc:
+                        log.warning("clear_backends failed: %s", exc)
+                if not cleared:
+                    # Proceeding would hit initialize()'s backends-already-
+                    # initialized error anyway — degrade explicitly with a
+                    # named, actionable failure instead (ADVICE r2).
+                    raise HorovodInternalError(
+                        "elastic re-initialization could not clear jax "
+                        "backends on this jax version; this process cannot "
+                        "rejoin the new generation in-place and must be "
+                        "restarted (the elastic driver respawns it)")
             jax.distributed.initialize(
                 coordinator_address=params[0],
                 num_processes=cfg.size,

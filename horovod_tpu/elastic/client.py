@@ -157,8 +157,26 @@ class ElasticCoordinatorClient:
 
     def mark_ready(self) -> None:
         """Tell the driver this worker has torn down collectives and awaits
-        the next generation's assignment."""
-        self._send({"type": "ready"})
+        the next generation's assignment.
+
+        Includes freshly-probed free ports on THIS host: if this worker is
+        elected rank 0, the rendezvous server and the per-generation
+        jax.distributed coordinator bind here, and only a local probe
+        proves a port is actually free (the driver may be a different
+        machine)."""
+        socks = []
+        try:
+            for _ in range(2):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.bind(("0.0.0.0", 0))
+                socks.append(s)   # hold open so the two ports are distinct
+            ports = [s.getsockname()[1] for s in socks]
+        except OSError:
+            ports = []
+        finally:
+            for s in socks:
+                s.close()
+        self._send({"type": "ready", "ports": ports})
 
 
 _client: Optional[ElasticCoordinatorClient] = None

@@ -453,7 +453,22 @@ def clip_by_global_norm(max_norm: float, axis_name=None
         local = sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
                     for l in leaves)
         if axis_name is not None:
-            local = lax.psum(local, _resolve_axes(axis_name))
+            # Only psum over axes the squared norm actually VARIES over.
+            # Inside shard_optimizer_states the chunk was already psummed
+            # over every non-shard axis (it is invariant there), so a blind
+            # psum over all resolved axes would inflate the norm by
+            # prod(size(non-shard axes)) and over-clip.  With check_vma
+            # off every leaf reports an EMPTY vma, indistinguishable from
+            # all-invariant — the vma_tracked guard (same idiom as the
+            # reduce paths above) falls back to psumming all axes then,
+            # matching the previous behavior.
+            axes = _resolve_axes(axis_name)
+            vma_tracked = any((_leaf_vma(l) or ()) for l in leaves)
+            if vma_tracked:
+                vma = _leaf_vma(local) or ()
+                axes = tuple(a for a in axes if a in vma)
+            if axes:
+                local = lax.psum(local, axes)
         norm = jnp.sqrt(local)
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
         return (jax.tree_util.tree_map(

@@ -123,6 +123,12 @@ class _Worker:
         self.ready = threading.Event()    # ready for next generation
         self.rank: Optional[int] = None
         self.dead = False
+        # Free ports probed ON THE WORKER'S HOST, refreshed with each ready
+        # message: the rendezvous server and the per-generation
+        # jax.distributed coordinator bind on rank 0's host, so only ports
+        # probed there are meaningful (ADVICE r2: a driver-side
+        # find_free_port may be occupied on the worker host).
+        self.free_ports: List[int] = []
 
     def send(self, obj: dict) -> bool:
         if self.wfile is None:
@@ -187,6 +193,10 @@ class ElasticDriver:
                                 self.connection.makefile("w",
                                                          encoding="utf-8"))
                         elif t == "ready" and worker is not None:
+                            ports = msg.get("ports")
+                            if isinstance(ports, list):
+                                worker.free_ports = [
+                                    int(p) for p in ports[:4]]
                             worker.ready.set()
                             driver._poke()
                 except (OSError, ValueError):
@@ -411,15 +421,26 @@ class ElasticDriver:
         rdv_host = expected[0].host
         rdv_addr = "127.0.0.1" if rdv_host in local_hostnames() \
             else rdv_host
-        rdv_port = find_free_port("0.0.0.0" if rdv_addr != "127.0.0.1"
-                                  else "127.0.0.1")
+        # Both the rendezvous server and the per-generation jax.distributed
+        # coordinator bind on rank 0's HOST, so prefer ports the rank-0
+        # worker probed there (sent with its ready message); a driver-side
+        # probe only proves the port is free on the driver.  Fall back for
+        # the all-local case and for clients predating the ports field.
+        r0_ports = list(expected[0].free_ports)
+        if rdv_addr == "127.0.0.1":
+            r0_ports = []  # driver shares the host; its own probe is valid
+        rdv_port = (r0_ports.pop(0) if r0_ports else
+                    find_free_port("0.0.0.0" if rdv_addr != "127.0.0.1"
+                                   else "127.0.0.1"))
         # Fresh jax.distributed coordinator per generation, hosted by the
         # new rank 0: a static launch-time coordinator would (a) live on a
         # possibly-preempted host and (b) race the old coordinator's port
         # release on rank reassignment.  Workers apply it only when the job
         # runs with HOROVOD_JAX_DISTRIBUTED=1.
-        jax_coord = "%s:%d" % (rdv_addr, find_free_port(
-            "0.0.0.0" if rdv_addr != "127.0.0.1" else "127.0.0.1"))
+        jax_coord = "%s:%d" % (rdv_addr, r0_ports.pop(0) if r0_ports else
+                               find_free_port(
+                                   "0.0.0.0" if rdv_addr != "127.0.0.1"
+                                   else "127.0.0.1"))
         local_sizes = collections.Counter(w.host for w in expected)
         local_seen: Dict[str, int] = {}
         hosts_order = list(dict.fromkeys(w.host for w in expected))

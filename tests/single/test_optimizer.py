@@ -317,3 +317,41 @@ def test_sharded_optimizer_with_cross_rank_clip():
     for k in params:
         np.testing.assert_allclose(np.asarray(p_sh[k]), np.asarray(p_rep[k]),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_optimizer_clip_multi_axis_mesh():
+    """ADVICE r2: on a multi-axis mesh the sharded chunk is INVARIANT over
+    every non-shard axis (already psummed before the reduce-scatter), so
+    clip_by_global_norm must not psum the squared norm over those axes too
+    — that inflated the norm by prod(size(other axes)) and over-clipped."""
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    max_norm = 0.1
+    tx_rep = hvd.DistributedOptimizer(
+        optax.chain(optax.clip_by_global_norm(max_norm), optax.sgd(1.0)),
+        axis_name=("dp", "sp"))
+    tx_sh = hvd.DistributedOptimizer(
+        optax.chain(hvd.clip_by_global_norm(max_norm,
+                                            axis_name=("dp", "sp")),
+                    optax.sgd(1.0)),
+        axis_name=("dp", "sp"), shard_optimizer_states=True)
+    params = {"w": jnp.linspace(1.0, 2.0, 6, dtype=jnp.float32),
+              "b": jnp.ones((5,), jnp.float32)}   # total 11, chunk 6 (n=2)
+
+    def one_step(tx):
+        def fn(x):
+            x = x[0, 0]
+            grads = {"w": params["w"] * x[:6], "b": params["b"] + x[:5]}
+            state = tx.init(params)
+            updates, _ = tx.update(grads, state, params)
+            return optax.apply_updates(params, updates)
+
+        return jax.jit(shard_map(fn, mesh=mesh,
+                                 in_specs=P("dp", "sp"),
+                                 out_specs=P()))(
+            jnp.arange(2 * 2 * 8, dtype=jnp.float32).reshape(2, 2, 8))
+
+    p_rep = one_step(tx_rep)
+    p_sh = one_step(tx_sh)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_sh[k]), np.asarray(p_rep[k]),
+                                   rtol=1e-5, atol=1e-5)

@@ -42,12 +42,25 @@ def _axis(axis_name: Optional[str]) -> str:
     return axis_name if axis_name is not None else _mesh.mesh_axis_name()
 
 
-def _check_traced_args(process_set) -> None:
-    if process_set is not None:
-        raise ValueError(
-            "process_set is not supported in traced mode; run the collective "
-            "over a sub-mesh axis (axis_name=...) instead"
-        )
+def _traced_members(process_set) -> Optional[tuple]:
+    """ProcessSet -> member axis indices for the traced (in-jit) path.
+
+    The bridge: a ProcessSet's global ranks ARE axis indices over the
+    reduction axis (the global mesh is built rank-ordered —
+    parallel.mesh.build_global_mesh), so the traced collective masks its
+    full-axis lowering to the member subset (ops.collectives._Subset).
+    The global set (id 0) and None mean the whole axis.  Registration is
+    only required for the eager spine; traced mode needs just the ranks,
+    so unregistered sets work inside pure SPMD programs without hvd.init().
+    """
+    if process_set is None:
+        return None
+    from .process_sets import _GlobalProcessSet
+
+    if isinstance(process_set, _GlobalProcessSet) \
+            or process_set.process_set_id == 0:
+        return None
+    return tuple(process_set.ranks)
 
 
 def _check_eager_args(axis_name) -> None:
@@ -78,9 +91,9 @@ def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None
     """Average (default) or otherwise reduce ``tensor`` across ranks."""
     rop = _resolve_op(op, average)
     if _is_traced(tensor):
-        _check_traced_args(process_set)
         return _jit_ops.allreduce(tensor, _axis(axis_name), rop,
-                                  prescale_factor, postscale_factor)
+                                  prescale_factor, postscale_factor,
+                                  member_ranks=_traced_members(process_set))
     _check_eager_args(axis_name)
     from .compression import NoneCompressor
 
@@ -120,9 +133,10 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
     (reference: group_table.cc grouped_allreduce)."""
     rop = _resolve_op(op, average)
     if tensors and _is_traced(tensors[0]):
-        _check_traced_args(process_set)
         ax = _axis(axis_name)
-        return [_jit_ops.allreduce(t, ax, rop, prescale_factor, postscale_factor)
+        members = _traced_members(process_set)
+        return [_jit_ops.allreduce(t, ax, rop, prescale_factor,
+                                   postscale_factor, member_ranks=members)
                 for t in tensors]
     _check_eager_args(axis_name)
     handles = grouped_allreduce_async(
@@ -163,8 +177,8 @@ def allgather(tensor, name: Optional[str] = None,
     """Concatenate each rank's tensor along dim 0 (ranks may differ in dim 0
     in eager mode; traced mode requires equal shapes — an XLA constraint)."""
     if _is_traced(tensor):
-        _check_traced_args(process_set)
-        return _jit_ops.allgather(tensor, _axis(axis_name))
+        return _jit_ops.allgather(tensor, _axis(axis_name),
+                                  member_ranks=_traced_members(process_set))
     _check_eager_args(axis_name)
     return synchronize(allgather_async(tensor, name=name, process_set=process_set))
 
@@ -185,8 +199,8 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None,
               axis_name: Optional[str] = None):
     if _is_traced(tensor):
-        _check_traced_args(process_set)
-        return _jit_ops.broadcast(tensor, root_rank, _axis(axis_name))
+        return _jit_ops.broadcast(tensor, root_rank, _axis(axis_name),
+                                  member_ranks=_traced_members(process_set))
     _check_eager_args(axis_name)
     return synchronize(
         broadcast_async(tensor, root_rank, name=name, process_set=process_set))
@@ -218,13 +232,13 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     shapes) and returns just the tensor.
     """
     if _is_traced(tensor):
-        _check_traced_args(process_set)
         if splits is not None:
             raise ValueError(
                 "in-jit alltoall requires equal splits (XLA static shapes); "
                 "omit the splits argument"
             )
-        return _jit_ops.alltoall(tensor, _axis(axis_name))
+        return _jit_ops.alltoall(tensor, _axis(axis_name),
+                                 member_ranks=_traced_members(process_set))
     _check_eager_args(axis_name)
     return HorovodContext.instance().synchronize(
         alltoall_async(tensor, splits=splits, name=name, process_set=process_set))
@@ -248,9 +262,9 @@ def reducescatter(tensor, op: ReduceOp = ReduceOp.AVERAGE,
                   process_set: Optional[ProcessSet] = None,
                   axis_name: Optional[str] = None):
     if _is_traced(tensor):
-        _check_traced_args(process_set)
-        return _jit_ops.reducescatter(tensor, _axis(axis_name), op,
-                                      prescale_factor, postscale_factor)
+        return _jit_ops.reducescatter(
+            tensor, _axis(axis_name), op, prescale_factor, postscale_factor,
+            member_ranks=_traced_members(process_set))
     _check_eager_args(axis_name)
     return synchronize(reducescatter_async(
         tensor, op=op, name=name, prescale_factor=prescale_factor,

@@ -52,9 +52,65 @@ def ensure_varying(x, axis_name: AxisName):
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
+class _Subset:
+    """Static geometry of a rank subset over ONE mesh axis — the traced
+    process-set bridge (reference: process_set.cc communicator subsetting;
+    SURVEY.md §2.1).
+
+    XLA exposes no subgroup collectives through shard_map in current jax
+    (``axis_index_groups`` raises NotImplementedError), so subset
+    collectives lower onto FULL-axis collectives with identity-masked
+    contributions — on ICI the full-axis psum is bandwidth-optimal anyway,
+    and every rank of the mesh executes the same SPMD program as shard_map
+    requires.  Semantics: member ranks get the set's result; non-member
+    ranks pass through unchanged where shapes allow (allreduce, broadcast,
+    alltoall, reducescatter) and receive the set's result where they don't
+    (allgather).
+    """
+
+    def __init__(self, axis_name: AxisName, member_ranks: Sequence[int]):
+        if not isinstance(axis_name, str):
+            raise ValueError(
+                "process_set collectives run over a single mesh axis; got "
+                f"axis_name={axis_name!r}")
+        self.axis = axis_name
+        self.n = lax.axis_size(axis_name)
+        self.members = sorted(set(int(r) for r in member_ranks))
+        if not self.members:
+            raise ValueError("process set has no members")
+        if self.members[0] < 0 or self.members[-1] >= self.n:
+            raise ValueError(
+                f"process set ranks {self.members} out of range for axis "
+                f"{axis_name!r} of size {self.n} (ranks map to axis indices)")
+        self.k = len(self.members)
+        idx = lax.axis_index(axis_name)
+        mset = set(self.members)
+        self.is_member = jnp.asarray(
+            [i in mset for i in range(self.n)])[idx]
+        # Position of this rank within the set (0 for non-members — only
+        # ever used behind an is_member select).
+        self.pos = jnp.asarray(
+            [self.members.index(i) if i in mset else 0
+             for i in range(self.n)])[idx]
+
+    def masked(self, x, identity):
+        """This rank's contribution: x for members, the op identity else."""
+        return jnp.where(self.is_member, x, identity)
+
+    def passthrough(self, result, x):
+        """Set result for members; x unchanged for non-members."""
+        return jnp.where(self.is_member, result, x)
+
+
 def allreduce(x, axis_name: AxisName, op: ReduceOp = ReduceOp.AVERAGE,
-              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              member_ranks: Optional[Sequence[int]] = None):
     x = ensure_varying(x, axis_name)
+    if member_ranks is not None:
+        # Scales apply to the set's result only; non-members pass through
+        # UNCHANGED (the documented subset semantics).
+        return _subset_allreduce(x, axis_name, op, member_ranks,
+                                 prescale_factor, postscale_factor)
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     if op == ReduceOp.AVERAGE:
@@ -76,38 +132,167 @@ def allreduce(x, axis_name: AxisName, op: ReduceOp = ReduceOp.AVERAGE,
     return out
 
 
-def allgather(x, axis_name: AxisName):
-    """Concatenate along dim 0 across the axis (Horovod allgather semantics)."""
-    return lax.all_gather(ensure_varying(x, axis_name), axis_name, axis=0,
-                          tiled=True)
+def _reduce_identity(x, op: ReduceOp):
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        return jnp.zeros_like(x)
+    if op == ReduceOp.PRODUCT:
+        return jnp.ones_like(x)
+    info = (jnp.finfo if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo)(x.dtype)
+    if op == ReduceOp.MIN:
+        return jnp.full_like(x, info.max)
+    if op == ReduceOp.MAX:
+        return jnp.full_like(x, info.min)
+    raise ValueError(f"unsupported reduce op {op}")
 
 
-def broadcast(x, root_rank: int, axis_name: AxisName):
-    """Every member receives root's value.
+def _subset_allreduce(x, axis_name: str, op: ReduceOp,
+                      member_ranks: Sequence[int],
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    sub = _Subset(axis_name, member_ranks)
+    xs = x
+    if prescale_factor != 1.0:
+        xs = xs * jnp.asarray(prescale_factor, dtype=x.dtype)
+    if op == ReduceOp.ADASUM:
+        out = adasum(xs, axis_name, member_ranks=sub.members)
+    else:
+        contrib = sub.masked(xs, _reduce_identity(xs, op))
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            out = lax.psum(contrib, axis_name)
+            if op == ReduceOp.AVERAGE:
+                out = out / sub.k
+        elif op == ReduceOp.MIN:
+            out = lax.pmin(contrib, axis_name)
+        elif op == ReduceOp.MAX:
+            out = lax.pmax(contrib, axis_name)
+        elif op == ReduceOp.PRODUCT:
+            out = jnp.prod(lax.all_gather(contrib, axis_name, axis=0),
+                           axis=0)
+        else:
+            raise ValueError(f"unsupported reduce op {op}")
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return sub.passthrough(out, x)
+
+
+def allgather(x, axis_name: AxisName,
+              member_ranks: Optional[Sequence[int]] = None):
+    """Concatenate along dim 0 across the axis (Horovod allgather semantics).
+
+    With ``member_ranks``, only the members' shards are concatenated (in
+    set order); every rank of the mesh receives that concatenation (the
+    output shape must be uniform across the SPMD program)."""
+    x = ensure_varying(x, axis_name)
+    if member_ranks is None:
+        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    sub = _Subset(axis_name, member_ranks)
+    # Every rank assembles the identical member concatenation, and the
+    # invariant gather lets the type system see that (out_specs expecting
+    # replication keep working); older jax falls back to the varying form.
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+        full = all_gather_invariant(x, axis_name)      # [n, s0, ...]
+    except ImportError:  # pragma: no cover - older jax
+        full = lax.all_gather(x, axis_name, axis=0)
+    rows = full[jnp.asarray(sub.members)]              # [k, s0, ...] static
+    return rows.reshape((sub.k * x.shape[0],) + x.shape[1:])
+
+
+def broadcast(x, root_rank: int, axis_name: AxisName,
+              member_ranks: Optional[Sequence[int]] = None):
+    """Every member receives root's value (``root_rank`` is the GLOBAL
+    rank / axis index, as in the reference's process-set broadcast —
+    socket_controller.cc resolves it within the member list).
 
     Implemented as a masked psum — one collective, no gather of the full
     axis — which XLA lowers to an ICI broadcast-like pattern.
     """
     x = ensure_varying(x, axis_name)
     idx = lax.axis_index(axis_name)
+    if member_ranks is not None:
+        sub = _Subset(axis_name, member_ranks)
+        if int(root_rank) not in sub.members:
+            raise ValueError(
+                f"broadcast root {root_rank} is not in the process set "
+                f"{sub.members}")
+        contribution = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+        return sub.passthrough(lax.psum(contribution, axis_name), x)
     # where() (not multiply-by-mask) so NaN/Inf in non-root shards are
     # discarded rather than propagated through the sum.
     contribution = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
     return lax.psum(contribution, axis_name)
 
 
-def alltoall(x, axis_name: AxisName):
+def alltoall(x, axis_name: AxisName,
+             member_ranks: Optional[Sequence[int]] = None):
     """Equal-splits alltoall: first dim is split across the axis and the
-    received chunks are concatenated along dim 0 (lax.all_to_all)."""
-    return lax.all_to_all(ensure_varying(x, axis_name), axis_name,
-                          split_axis=0, concat_axis=0, tiled=True)
+    received chunks are concatenated along dim 0 (lax.all_to_all).
+
+    With ``member_ranks``, dim 0 is split |set| ways and exchanged among
+    the members only; non-members pass through unchanged."""
+    x = ensure_varying(x, axis_name)
+    if member_ranks is None:
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+    sub = _Subset(axis_name, member_ranks)
+    s0 = x.shape[0]
+    if s0 % sub.k:
+        raise ValueError(
+            f"alltoall dim 0 ({s0}) must divide by the process-set size "
+            f"({sub.k})")
+    c = s0 // sub.k
+    n = sub.n
+    # k ppermute rounds of one [c, ...] chunk each — total bytes moved
+    # equal the baseline alltoall (a full-axis all_gather here would be an
+    # n-times memory blowup).  In round t, the member at set position p
+    # sends its chunk (p+t)%k to the member at position (p+t)%k, who
+    # stores it at slot p = (recv_pos - t) % k.  Non-members self-send
+    # and are patched through at the end.
+    out = jnp.zeros_like(x)
+    for t in range(sub.k):
+        send_start = ((sub.pos + t) % sub.k) * c
+        chunk = lax.dynamic_slice_in_dim(x, send_start, c, axis=0)
+        if t == 0:
+            moved = chunk
+        else:
+            pair = {sub.members[p]: sub.members[(p + t) % sub.k]
+                    for p in range(sub.k)}
+            perm = [(i, pair.get(i, i)) for i in range(n)]
+            moved = lax.ppermute(chunk, axis_name, perm)
+        recv_start = ((sub.pos - t) % sub.k) * c
+        out = lax.dynamic_update_slice_in_dim(out, moved, recv_start,
+                                              axis=0)
+    return sub.passthrough(out, x)
 
 
 def reducescatter(x, axis_name: AxisName, op: ReduceOp = ReduceOp.SUM,
-                  prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+                  prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+                  member_ranks: Optional[Sequence[int]] = None):
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("in-jit reducescatter supports Sum and Average")
     x = ensure_varying(x, axis_name)
+    if member_ranks is not None:
+        sub = _Subset(axis_name, member_ranks)
+        s0 = x.shape[0]
+        if s0 % sub.k:
+            raise ValueError(
+                f"reducescatter dim 0 ({s0}) must divide by the "
+                f"process-set size ({sub.k})")
+        c = s0 // sub.k
+        xs = x
+        if prescale_factor != 1.0:
+            xs = xs * jnp.asarray(prescale_factor, dtype=x.dtype)
+        summed = lax.psum(sub.masked(xs, jnp.zeros_like(xs)), axis_name)
+        out = lax.dynamic_slice_in_dim(summed, sub.pos * c, c, axis=0)
+        if op == ReduceOp.AVERAGE:
+            out = out / sub.k
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+        # Non-members keep their own leading chunk UNSCALED (shape-uniform
+        # pass-through analog).
+        return jnp.where(sub.is_member, out,
+                         lax.slice_in_dim(x, 0, c, axis=0))
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
@@ -118,7 +303,8 @@ def reducescatter(x, axis_name: AxisName, op: ReduceOp = ReduceOp.SUM,
     return out
 
 
-def adasum(x, axis_name: AxisName):
+def adasum(x, axis_name: AxisName,
+           member_ranks: Optional[Sequence[int]] = None):
     """Adasum scale-invariant reduction over a mesh axis.
 
     TPU-native version of the reference's recursive vector-halving/distance-
@@ -129,18 +315,28 @@ def adasum(x, axis_name: AxisName):
         adasum(a, b) = (1 - a.b / (2|a|^2)) a + (1 - a.b / (2|b|^2)) b
 
     Requires the axis size to be a power of two (as the reference does for
-    its pure Adasum path).
+    its pure Adasum path).  With ``member_ranks`` the pairwise rounds run
+    among the members only (|set| must be a power of two); non-members
+    ppermute to themselves, and adasum(a, a) = a leaves them unchanged.
     """
     n = lax.axis_size(axis_name)
-    if n & (n - 1) != 0:
-        raise ValueError(f"Adasum requires a power-of-two axis size, got {n}")
-    rounds = n.bit_length() - 1
+    if member_ranks is not None:
+        members = sorted(set(int(r) for r in member_ranks))
+    else:
+        members = list(range(n))
+    m = len(members)
+    if m & (m - 1) != 0:
+        raise ValueError(f"Adasum requires a power-of-two size, got {m}")
+    rounds = m.bit_length() - 1
     idx = lax.axis_index(axis_name)
     out = x
     for k in range(rounds):
         stride = 1 << k
         partner = idx ^ stride
-        perm = [(i, i ^ stride) for i in range(n)]
+        # Pair set-positions p <-> p^stride, mapped back to global axis
+        # indices; everyone else exchanges with itself.
+        pair = {members[p]: members[p ^ stride] for p in range(m)}
+        perm = [(i, pair.get(i, i)) for i in range(n)]
         other = lax.ppermute(out, axis_name, perm)
         a, b = out, other
         dot = jnp.vdot(a, b).astype(jnp.float32)

@@ -308,11 +308,14 @@ def _kv_vmem_budget() -> int:
     env = os.environ.get("HVD_TPU_FLASH_VMEM_BUDGET_MB")
     if env:
         try:
-            return int(env) << 20
-        except ValueError as exc:
+            budget = int(env)
+        except ValueError:
+            budget = 0
+        if budget <= 0:
             raise ValueError(
-                f"HVD_TPU_FLASH_VMEM_BUDGET_MB must be an integer MiB "
-                f"count, got {env!r}") from exc
+                f"HVD_TPU_FLASH_VMEM_BUDGET_MB must be a positive integer "
+                f"MiB count, got {env!r}")
+        return budget << 20
     try:
         kind = jax.devices()[0].device_kind
         for prefix, vmem in _VMEM_BYTES_BY_KIND:

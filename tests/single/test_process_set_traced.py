@@ -1,0 +1,177 @@
+"""Traced-mode process-set collectives (VERDICT r2 #4).
+
+The bridge: a ProcessSet's global ranks are axis indices over the traced
+reduction axis, and each collective lowers onto a full-axis XLA collective
+with identity-masked contributions (ops/collectives.py _Subset — the
+reference's process_set.cc communicator subsetting, SURVEY.md §2.1).
+Semantics under SPMD: member ranks get the set's result; non-members pass
+through unchanged where shapes allow (allreduce/broadcast/alltoall/
+reducescatter) and receive the set's result where they can't (allgather).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.process_sets import ProcessSet
+
+MEMBERS = [1, 3]
+
+
+def _mesh4():
+    return Mesh(np.asarray(jax.devices()[:4]), ("hvd",))
+
+
+def _rankwise(rows_per_rank=2, cols=3):
+    # rank r rows carry values 10*r + {0, 1, ...}
+    n = 4 * rows_per_rank
+    base = (np.arange(n) % rows_per_rank
+            + (np.arange(n) // rows_per_rank) * 10.0)
+    return jnp.asarray(np.repeat(base[:, None], cols, axis=1),
+                       dtype=jnp.float32)
+
+
+def _run(fn, x, out_specs=P("hvd")):
+    return np.asarray(jax.jit(shard_map(
+        fn, mesh=_mesh4(), in_specs=P("hvd"), out_specs=out_specs))(x))
+
+
+def test_allreduce_ops_members_and_passthrough():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+
+    for op, expect in [
+        (hvd.Sum, 10.0 + 30.0),
+        (hvd.Average, (10.0 + 30.0) / 2),
+        (hvd.Min, 10.0),
+        (hvd.Max, 30.0),
+        (hvd.Product, 10.0 * 30.0),
+    ]:
+        out = _run(lambda t: hvd.allreduce(t, op=op, process_set=ps,
+                                           axis_name="hvd"), x)
+        for r in range(4):
+            row0 = out[2 * r, 0]
+            if r in MEMBERS:
+                assert row0 == pytest.approx(expect), (op, out)
+            else:
+                assert row0 == pytest.approx(10.0 * r), (op, out)
+
+
+def test_allgather_concats_member_shards_everywhere():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+    out = _run(lambda t: hvd.allgather(t, process_set=ps, axis_name="hvd"),
+               x, out_specs=P(None))
+    # every rank receives [x_1; x_3] (set order), 2 rows each
+    np.testing.assert_allclose(out[:, 0], [10, 11, 30, 31])
+
+
+def test_broadcast_root_is_global_rank():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+    out = _run(lambda t: hvd.broadcast(t, root_rank=3, process_set=ps,
+                                       axis_name="hvd"), x)
+    np.testing.assert_allclose(out[:, 0], [0, 1, 30, 31, 20, 21, 30, 31])
+
+
+def test_broadcast_root_outside_set_raises():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+    with pytest.raises(ValueError, match="not in the process set"):
+        _run(lambda t: hvd.broadcast(t, root_rank=0, process_set=ps,
+                                     axis_name="hvd"), x)
+
+
+def test_alltoall_exchanges_among_members():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+    out = _run(lambda t: hvd.alltoall(t, process_set=ps, axis_name="hvd"), x)
+    # member at set position p receives chunk p of each member, set order;
+    # non-members pass through
+    np.testing.assert_allclose(out[:, 0], [0, 1, 10, 30, 20, 21, 11, 31])
+
+
+def test_reducescatter_scatters_set_sum():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+    out = _run(lambda t: hvd.reducescatter(t, op=hvd.Sum, process_set=ps,
+                                           axis_name="hvd"), x)
+    # per-rank output is one row (2 rows / 2 members); members get their
+    # chunk of the set sum (40, 42), non-members their own leading chunk
+    np.testing.assert_allclose(out[:, 0], [0, 40, 20, 42])
+
+
+def test_grouped_allreduce_with_set():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+
+    def fn(t):
+        a, b = hvd.grouped_allreduce([t, 2 * t], op=hvd.Sum, process_set=ps,
+                                     axis_name="hvd")
+        return a + b
+
+    out = _run(fn, x)
+    assert out[2, 0] == pytest.approx(3 * 40.0)
+    assert out[0, 0] == pytest.approx(0.0)  # passthrough: x + 2x at rank 0
+
+
+def test_scale_factors_do_not_touch_passthrough():
+    ps = ProcessSet(MEMBERS)
+    x = _rankwise()
+    out = _run(lambda t: hvd.allreduce(t, op=hvd.Sum, process_set=ps,
+                                       prescale_factor=0.5,
+                                       postscale_factor=3.0,
+                                       axis_name="hvd"), x)
+    # members: (10+30)*0.5*3; non-members: UNCHANGED (not scaled)
+    np.testing.assert_allclose(out[::2, 0], [0.0, 60.0, 20.0, 60.0])
+    rs = _run(lambda t: hvd.reducescatter(t, op=hvd.Sum, process_set=ps,
+                                          prescale_factor=0.5,
+                                          postscale_factor=3.0,
+                                          axis_name="hvd"), x)
+    np.testing.assert_allclose(rs[:, 0], [0.0, 60.0, 20.0, 63.0])
+
+
+def test_adasum_subset_identity_for_equal_vectors():
+    # adasum(a, a) = a, so a 2-member set with identical members returns
+    # the member value; non-members pass through.
+    ps = ProcessSet(MEMBERS)
+    base = np.zeros((4, 4), np.float32)
+    base[1] = base[3] = 7.0       # members identical
+    base[0], base[2] = 1.0, 2.0
+    x = jnp.asarray(base)
+    out = _run(lambda t: hvd.allreduce(t, op=hvd.Adasum, process_set=ps,
+                                       axis_name="hvd"), x)
+    np.testing.assert_allclose(out[:, 0], [1.0, 7.0, 2.0, 7.0])
+
+
+def test_global_set_means_full_axis():
+    x = _rankwise()
+    out = _run(lambda t: hvd.allreduce(t, op=hvd.Sum,
+                                       process_set=hvd.global_process_set,
+                                       axis_name="hvd"), x)
+    # row 0 of each rank sums to 0+10+20+30, row 1 to 1+11+21+31
+    np.testing.assert_allclose(out[::2, 0], np.full(4, 60.0))
+    np.testing.assert_allclose(out[1::2, 0], np.full(4, 64.0))
+
+
+def test_out_of_range_ranks_raise():
+    ps = ProcessSet([1, 9])
+    x = _rankwise()
+    with pytest.raises(ValueError, match="out of range"):
+        _run(lambda t: hvd.allreduce(t, process_set=ps, axis_name="hvd"), x)
+
+
+def test_multi_axis_rejected():
+    ps = ProcessSet(MEMBERS)
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("a", "b"))
+    x = jnp.ones((4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="single mesh axis"):
+        jax.jit(shard_map(
+            lambda t: hvd.allreduce(t, process_set=ps,
+                                    axis_name=("a", "b")),
+            mesh=mesh, in_specs=P("a", "b"), out_specs=P("a", "b")))(x)

@@ -64,8 +64,9 @@ class _Subset:
     and every rank of the mesh executes the same SPMD program as shard_map
     requires.  Semantics: member ranks get the set's result; non-member
     ranks pass through unchanged where shapes allow (allreduce, broadcast,
-    alltoall, reducescatter) and receive the set's result where they don't
-    (allgather).
+    alltoall), keep their own leading s0/k chunk where the output shape
+    shrinks (reducescatter), and receive the set's result where it must be
+    uniform (allgather).
     """
 
     def __init__(self, axis_name: AxisName, member_ranks: Sequence[int]):
@@ -137,6 +138,13 @@ def _reduce_identity(x, op: ReduceOp):
         return jnp.zeros_like(x)
     if op == ReduceOp.PRODUCT:
         return jnp.ones_like(x)
+    if x.dtype == jnp.bool_:
+        # bool Min == AND (identity True), bool Max == OR (identity False)
+        if op == ReduceOp.MIN:
+            return jnp.ones_like(x)
+        if op == ReduceOp.MAX:
+            return jnp.zeros_like(x)
+        raise ValueError(f"unsupported reduce op {op}")
     info = (jnp.finfo if jnp.issubdtype(x.dtype, jnp.floating)
             else jnp.iinfo)(x.dtype)
     if op == ReduceOp.MIN:
@@ -210,18 +218,18 @@ def broadcast(x, root_rank: int, axis_name: AxisName,
     """
     x = ensure_varying(x, axis_name)
     idx = lax.axis_index(axis_name)
+    sub = None
     if member_ranks is not None:
         sub = _Subset(axis_name, member_ranks)
         if int(root_rank) not in sub.members:
             raise ValueError(
                 f"broadcast root {root_rank} is not in the process set "
                 f"{sub.members}")
-        contribution = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
-        return sub.passthrough(lax.psum(contribution, axis_name), x)
     # where() (not multiply-by-mask) so NaN/Inf in non-root shards are
     # discarded rather than propagated through the sum.
     contribution = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
-    return lax.psum(contribution, axis_name)
+    out = lax.psum(contribution, axis_name)
+    return out if sub is None else sub.passthrough(out, x)
 
 
 def alltoall(x, axis_name: AxisName,
@@ -328,11 +336,9 @@ def adasum(x, axis_name: AxisName,
     if m & (m - 1) != 0:
         raise ValueError(f"Adasum requires a power-of-two size, got {m}")
     rounds = m.bit_length() - 1
-    idx = lax.axis_index(axis_name)
     out = x
     for k in range(rounds):
         stride = 1 << k
-        partner = idx ^ stride
         # Pair set-positions p <-> p^stride, mapped back to global axis
         # indices; everyone else exchanges with itself.
         pair = {members[p]: members[p ^ stride] for p in range(m)}
@@ -349,7 +355,6 @@ def adasum(x, axis_name: AxisName,
         # Both members of a pair compute the same combined vector (the
         # formula is symmetric), so no extra exchange is needed.
         out = combined
-        del partner
     return out
 
 

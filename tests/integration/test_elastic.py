@@ -37,9 +37,21 @@ WORKER_SCRIPT = textwrap.dedent("""
             ep, flag = spec.split(":", 1)
             KILLS.append((int(ep), flag))
 
+    # Scale-up hook: at TEST_GROW_EPOCH, rank 0 rewrites the discovery
+    # file with TEST_GROW_CONTENT (once — guarded by TEST_GROW_FLAG),
+    # mirroring the reference's "new hosts are new lines in the file"
+    # pattern (elastic_common.py, SURVEY.md §4.2).
+    GROW_EPOCH = int(os.environ.get("TEST_GROW_EPOCH", "-1"))
+    GROW_FILE = os.environ.get("TEST_GROW_FILE", "")
+    GROW_CONTENT = os.environ.get("TEST_GROW_CONTENT", "")
+    GROW_FLAG = os.environ.get("TEST_GROW_FLAG", "")
+    EPOCHS = int(os.environ.get("TEST_EPOCHS", "6"))
+    EPOCH_SLEEP = float(os.environ.get("TEST_EPOCH_SLEEP", "0"))
+
     @hvd.elastic.run
     def train(state):
-        while state.epoch < 6:
+        import time
+        while state.epoch < EPOCHS:
             for ep, flag in KILLS:
                 if (state.epoch == ep and hvd.rank() == hvd.size() - 1
                         and hvd.size() > 1 and flag
@@ -48,11 +60,18 @@ WORKER_SCRIPT = textwrap.dedent("""
                         open(PRE_KILL_TOUCH, "w").write("x")
                     open(flag, "w").write("died")
                     os.kill(os.getpid(), 9)
+            if (state.epoch >= GROW_EPOCH and GROW_EPOCH >= 0
+                    and hvd.rank() == 0 and GROW_FILE
+                    and not os.path.exists(GROW_FLAG)):
+                open(GROW_FLAG, "w").write("grown")
+                open(GROW_FILE, "w").write(GROW_CONTENT + "\\n")
             val = hvd.allreduce(np.ones(4, np.float32),
                                 name=f"step.{state.epoch}")
             state.total += float(val.sum())
             state.epoch += 1
             state.commit()
+            if EPOCH_SLEEP:
+                time.sleep(EPOCH_SLEEP)
         return state.total
 
     total = train(state)
@@ -141,6 +160,40 @@ def test_elastic_discovery_blip_reuses_last_hosts():
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "epoch=6" in proc.stdout
         assert "reusing previous host set" in proc.stderr, proc.stderr
+
+
+def test_elastic_scale_up_absorbs_new_slot():
+    """VERDICT r2 #5: the discovery file GROWS mid-training (2 -> 3 slots).
+    The driver must notice, push hosts_updated, spawn the extra worker,
+    and form the next generation with np+1, contiguous ranks, and state
+    synced from rank 0 (all workers report the same epoch/total)."""
+    with tempfile.TemporaryDirectory() as td:
+        hosts_file = os.path.join(td, "hosts.txt")
+        with open(hosts_file, "w") as f:
+            f.write("localhost:2\n")
+        grow_flag = os.path.join(td, "grown.flag")
+        proc = _run_launcher(
+            ["--min-np", "1", "--max-np", "3", "--host-discovery-script",
+             f"cat {hosts_file}", "--verbose"],
+            env_extra={"TEST_GROW_EPOCH": "1",
+                       "TEST_GROW_FILE": hosts_file,
+                       "TEST_GROW_CONTENT": "localhost:3",
+                       "TEST_GROW_FLAG": grow_flag,
+                       "TEST_EPOCHS": "8",
+                       "TEST_EPOCH_SLEEP": "0.5"},
+            timeout=240)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert os.path.exists(grow_flag), "grow hook never fired"
+        results = [ln for ln in proc.stdout.splitlines() if "RESULT" in ln]
+        assert len(results) == 3, proc.stdout + proc.stderr
+        ranks = sorted(int(ln.split("rank=")[1].split()[0])
+                       for ln in results)
+        assert ranks == [0, 1, 2], results          # contiguous ranks
+        assert all("size=3" in ln for ln in results), results  # np+1
+        assert all("epoch=8" in ln for ln in results), results
+        totals = {ln.split("total=")[1].strip() for ln in results}
+        assert len(totals) == 1, results  # state synced from rank 0
+        assert " formed with 3 " in proc.stderr, proc.stderr
 
 
 def test_elastic_survives_repeated_kills():

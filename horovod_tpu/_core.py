@@ -119,11 +119,14 @@ class NativeCore(CoreBackend):
     fusion buffers and runs device-side XLA programs."""
 
     name = "native"
+    # Per-process-set data channels exist in the socket controller, so
+    # responses for different sets may run on concurrent executor lanes.
+    parallel_lanes = True
 
     def __init__(self):
         self._lib = _load_library()
         self._cfg: Optional[Config] = None
-        self._current_seq = -1
+        self._seq_tls = threading.local()
         # Reused across pop_response calls (the executor polls every 50ms;
         # a fresh 1MB allocation per poll would churn ~20MB/s at idle).
         self._resp_cap = 1 << 16
@@ -198,7 +201,7 @@ class NativeCore(CoreBackend):
         if n <= 0:
             return None
         obj = json.loads(self._resp_buf.raw[:n].decode())
-        self._current_seq = obj.get("seq", -1)
+        self.set_current_seq(obj.get("seq", -1))
         return FusedResponse(
             op=OpType(obj["op"]),
             dtype=DataType(obj["dtype"]),
@@ -207,7 +210,17 @@ class NativeCore(CoreBackend):
             error=obj["error"] or None,
             counts=obj.get("counts"),
             last_joined=obj.get("last_joined", -1),
+            seq=obj.get("seq", -1),
         )
+
+    def set_current_seq(self, seq: int) -> None:
+        # thread-local: each executor lane tags its own collective's
+        # frames (the C++ side mirrors this with a thread_local).
+        self._seq_tls.seq = int(seq)
+
+    @property
+    def _current_seq(self) -> int:
+        return getattr(self._seq_tls, "seq", -1)
 
     # -- process sets -------------------------------------------------------
     def add_process_set(self, ranks: Sequence[int]) -> int:

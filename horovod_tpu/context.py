@@ -28,7 +28,7 @@ import numpy as np
 
 from .exceptions import HorovodInternalError
 from .runtime import CoreBackend, FusedResponse, PyLocalCore, TensorEntry
-from .utils.env import Config
+from .utils.env import Config, get_bool
 from .utils.logging import get_logger
 from .wire import DataType, OpType, ReduceOp, numpy_dtype, wire_dtype
 
@@ -105,6 +105,41 @@ def _select_backend(cfg: Config) -> CoreBackend:
     return PyLocalCore()
 
 
+class _ExecutorLane:
+    """One finalization lane per process set (reference analog:
+    thread_pool.cc + per-communicator NCCL streams).
+
+    Responses for the SAME process set finalize strictly in negotiated
+    order (single lane thread, FIFO queue); responses for different sets
+    proceed concurrently — safe because every registered set rides its own
+    data-channel sockets (socket_controller.cc EstablishChannel), so a
+    slow host collective on one set cannot head-of-line-block another."""
+
+    def __init__(self, ctx: "HorovodContext", psid: int):
+        import queue
+
+        self.psid = psid
+        self._ctx = ctx
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name=f"hvd-lane-{psid}", daemon=True)
+        self._thread.start()
+
+    def submit(self, resp: FusedResponse) -> None:
+        self._q.put(resp)
+
+    def stop(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while True:
+            resp = self._q.get()
+            if resp is None or self._ctx._shutdown.is_set():
+                return
+            self._ctx._process_response(resp)
+
+
 class HorovodContext:
     """Process-wide singleton created by ``hvd.init()``."""
 
@@ -122,15 +157,33 @@ class HorovodContext:
         self._handle_counter = itertools.count(1)
         self._noname_counter = itertools.count(0)
         self._shutdown = threading.Event()
-        # Only the executor thread touches the fusion buffer; responses are
-        # executed one at a time, so one buffer per process suffices
-        # (reference: FusionBufferManager::GetBuffer per device).
-        self._fusion = _FusionBuffer(min(cfg.fusion_threshold_bytes, 64 << 20))
+        # One fusion buffer PER EXECUTOR LANE (thread-local): lanes finalize
+        # different process sets' responses concurrently, each packing its
+        # own buffer (reference: FusionBufferManager::GetBuffer per device;
+        # thread_pool.cc's parallel finalization role).
+        self._fusion_tls = threading.local()
+        self._fusion_initial = min(cfg.fusion_threshold_bytes, 64 << 20)
         self.core.start(cfg)
+        # Parallel lanes: one finalization thread per process set, so an
+        # in-flight host collective on one set cannot head-of-line-block
+        # independent traffic on another.  Requires per-set data channels
+        # (NativeCore); the pure-Python fallback finalizes inline.
+        self._use_lanes = (
+            getattr(self.core, "parallel_lanes", False) and cfg.size > 1
+            and get_bool("HOROVOD_EXECUTOR_LANES", True))
+        self._lanes: Dict[int, "_ExecutorLane"] = {}
         self._executor = threading.Thread(
             target=self._executor_loop, name="hvd-executor", daemon=True
         )
         self._executor.start()
+
+    @property
+    def _fusion(self) -> _FusionBuffer:
+        buf = getattr(self._fusion_tls, "buf", None)
+        if buf is None:
+            buf = _FusionBuffer(self._fusion_initial)
+            self._fusion_tls.buf = buf
+        return buf
 
     # -- lifecycle ----------------------------------------------------------
     @classmethod
@@ -253,41 +306,83 @@ class HorovodContext:
 
     # -- executor / data plane ----------------------------------------------
     def _executor_loop(self) -> None:
+        """Dispatcher: pop negotiated responses and either finalize inline
+        (serial mode) or hand each to its process set's lane."""
         while not self._shutdown.is_set():
             resp = self.core.pop_response(timeout=0.05)
             if resp is None:
                 continue
-            entries = []
+            # Join-state transitions must follow the GLOBAL negotiated
+            # order, which only the dispatcher sees: stamp the current
+            # joined flag on each response, and clear it when the JOIN
+            # itself dispatches — a later lane finalizing an
+            # earlier-negotiated collective still zero-participates.
             with self._entries_lock:
-                for h in resp.handles:
-                    e = self._entries.get(h)
-                    if e is not None:
-                        entries.append(e)
-            if not entries:
-                # Joined rank (hvd.join): no local tensors, but ring
-                # collectives need every member — participate with zeros.
-                if self._joined and not resp.error:
-                    try:
-                        self._participate_absent(resp)
-                    except Exception as exc:  # noqa: BLE001
-                        log.warning("zero-participation failed: %s", exc)
-                continue
-            try:
-                if resp.error:
-                    raise HorovodInternalError(resp.error)
-                self._execute(resp, entries)
-                for e in entries:
-                    e.done.set()
-            except Exception as exc:  # noqa: BLE001 - propagate via handle
-                if resp.op == OpType.JOIN:
-                    # A failed join (e.g. a peer shut down mid-join) must
-                    # not leave this rank zero-participating forever.
-                    with self._entries_lock:
-                        self._joined = False
-                for e in entries:
-                    e.error = str(exc)
-                    e.done.set()
-            self._release_names(entries)
+                resp.joined_at_dispatch = self._joined
+                if resp.op == OpType.JOIN and not resp.error:
+                    self._joined = False
+            if self._use_lanes:
+                self._lane_for(resp.process_set_id).submit(resp)
+            else:
+                self._process_response(resp)
+        for lane in list(self._lanes.values()):
+            lane.stop()
+
+    def _lane_for(self, psid: int) -> "_ExecutorLane":
+        lane = self._lanes.get(psid)
+        if lane is None:
+            lane = _ExecutorLane(self, psid)
+            self._lanes[psid] = lane
+        return lane
+
+    def remove_process_set(self, psid: int) -> None:
+        """Remove a set from the core AND retire its executor lane (ids are
+        never reused, so a leaked lane thread would accumulate forever)."""
+        self.core.remove_process_set(psid)
+        lane = self._lanes.pop(psid, None)
+        if lane is not None:
+            lane.stop()
+
+    def _process_response(self, resp: FusedResponse) -> None:
+        """Finalize one response: collect entries, run the data plane, set
+        completion.  Runs on the dispatcher (serial mode) or a lane thread
+        (per-process-set lanes; ordering holds within each lane)."""
+        self.core.set_current_seq(resp.seq)
+        entries = []
+        with self._entries_lock:
+            for h in resp.handles:
+                e = self._entries.get(h)
+                if e is not None:
+                    entries.append(e)
+        if not entries:
+            # Joined rank (hvd.join): no local tensors, but ring
+            # collectives need every member — participate with zeros.
+            # The dispatch-time stamp (not the live flag) decides: the
+            # live flag may already be cleared by a JOIN that was
+            # negotiated AFTER this response but dispatched to a faster
+            # lane.
+            if resp.joined_at_dispatch and not resp.error:
+                try:
+                    self._participate_absent(resp)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("zero-participation failed: %s", exc)
+            return
+        try:
+            if resp.error:
+                raise HorovodInternalError(resp.error)
+            self._execute(resp, entries)
+            for e in entries:
+                e.done.set()
+        except Exception as exc:  # noqa: BLE001 - propagate via handle
+            if resp.op == OpType.JOIN:
+                # A failed join (e.g. a peer shut down mid-join) must
+                # not leave this rank zero-participating forever.
+                with self._entries_lock:
+                    self._joined = False
+            for e in entries:
+                e.error = str(exc)
+                e.done.set()
+        self._release_names(entries)
 
     def _release_names(self, entries: List[TensorEntry]) -> None:
         """After a name's instance completes, submit its next queued

@@ -79,6 +79,12 @@ class Controller {
   int size() const { return cfg_.size; }
   ProcessSetTable& process_sets() { return process_sets_; }
 
+  // Per-process-set data channel lifecycle (see SocketController): the
+  // default is a no-op — LocalController's data plane is identity and
+  // needs no sockets.
+  virtual Status EstablishChannel(int psid) { return Status::OK(); }
+  virtual void RemoveChannel(int psid) {}
+
   // Coordinator-side stall report: tensor -> ranks that have not announced
   // it yet (reference: stall_inspector.cc per-rank missing lists).
   virtual std::string StallReport(double older_than_s) { return ""; }

@@ -523,11 +523,21 @@ void hvd_free(void* p) { std::free(p); }
 int hvd_add_process_set(const int* ranks, int n) {
   if (g == nullptr) return -1;
   std::vector<int> v(ranks, ranks + n);
-  return g->controller->process_sets().Add(v);
+  int id = g->controller->process_sets().Add(v);
+  // Dedicated data channel (per-set socket mesh) so this set's collectives
+  // can run on their own executor lane, concurrent with other sets'.
+  Status s = g->controller->EstablishChannel(id);
+  if (!s.ok()) {
+    g->controller->process_sets().Remove(id);
+    SetLastError("process set channel establishment failed: " + s.reason);
+    return -4;
+  }
+  return id;
 }
 
 int hvd_remove_process_set(int id) {
   if (g == nullptr) return -1;
+  g->controller->RemoveChannel(id);
   g->controller->process_sets().Remove(id);
   return 0;
 }

@@ -18,7 +18,7 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-constexpr int32_t kProtocolVersion = 2;         // v2: handles on the wire
+constexpr int32_t kProtocolVersion = 3;         // v3: psid in mesh HELLOs
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -30,6 +30,8 @@ constexpr int32_t kTagAlltoall = 0x6000;
 constexpr int32_t kTagBarrier = 0x7000;
 
 }  // namespace
+
+thread_local int64_t SocketController::current_seq_ = -1;
 
 SocketController::SocketController(const CoreConfig& cfg)
     : Controller(cfg), cache_(cfg.cache_capacity) {}
@@ -150,41 +152,72 @@ Status SocketController::Initialize() {
     addrs[0] = cfg_.rendezvous_addr;
   }
 
-  Status s = ConnectMesh(addrs, ports);
+  // Keep the address book: per-process-set channel meshes dial through it
+  // later (EstablishChannel).
+  mesh_addrs_ = addrs;
+  mesh_ports_ = ports;
+  std::vector<int> all_ranks(cfg_.size);
+  for (int i = 0; i < cfg_.size; ++i) all_ranks[i] = i;
+  Status s = ConnectMesh(all_ranks, /*psid=*/0, &peer_socks_);
   if (!s.ok()) return s;
   initialized_ = true;
   return Status::OK();
 }
 
-Status SocketController::ConnectMesh(const std::vector<std::string>& addrs,
-                                     const std::vector<int>& ports) {
-  // Deterministic pairing: every rank dials all lower ranks, then accepts
-  // one connection from each higher rank (their dials queue in the
-  // listener backlog meanwhile, so the two phases cannot deadlock).
-  for (int rank = 0; rank < cfg_.rank; ++rank) {
+Status SocketController::ConnectMesh(const std::vector<int>& members,
+                                     int psid, std::vector<Socket>* out) {
+  // Deterministic pairing: every member dials all lower members, then
+  // accepts one connection from each higher member (their dials queue in
+  // the listener backlog meanwhile, so the two phases cannot deadlock).
+  // HELLO = [rank, psid]; psid 0 is the global init mesh, >0 a channel.
+  std::lock_guard<std::mutex> mesh_lock(mesh_mu_);
+  out->clear();
+  out->resize(cfg_.size);
+  std::set<int> member_set(members.begin(), members.end());
+  for (int rank : members) {
+    if (rank >= cfg_.rank) continue;
     Socket s;
-    if (!s.Connect(addrs[rank], ports[rank], kConnectTimeoutS)) {
+    if (!s.Connect(mesh_addrs_[rank], mesh_ports_[rank], kConnectTimeoutS)) {
       return Status::Error(StatusCode::PRECONDITION_ERROR,
                            "mesh connect to rank " + std::to_string(rank) +
-                               " at " + addrs[rank] + ":" +
-                               std::to_string(ports[rank]) + " failed");
+                               " at " + mesh_addrs_[rank] + ":" +
+                               std::to_string(mesh_ports_[rank]) +
+                               " (psid " + std::to_string(psid) + ") failed");
     }
     Writer hello;
     hello.PutI32(cfg_.rank);
+    hello.PutI32(psid);
     if (!s.SendFrame(hello.data())) {
       return Status::Error(StatusCode::PRECONDITION_ERROR,
                            "mesh HELLO to rank " + std::to_string(rank) +
                                " failed");
     }
-    peer_socks_[rank] = std::move(s);
+    (*out)[rank] = std::move(s);
   }
-  int needed = cfg_.size - cfg_.rank - 1;
+  int needed = 0;
+  for (int rank : members) {
+    if (rank <= cfg_.rank) continue;
+    // Channel HELLOs may have arrived while this rank was establishing a
+    // DIFFERENT channel (add_process_set call skew): drain the stash.
+    auto it = pending_channel_.find({rank, psid});
+    if (it != pending_channel_.end()) {
+      (*out)[rank] = std::move(it->second);
+      pending_channel_.erase(it);
+    } else {
+      ++needed;
+    }
+  }
   double deadline = MonotonicSeconds() + kConnectTimeoutS;
   while (needed > 0) {
+    if (aborted_) {
+      return Status::Error(StatusCode::ABORTED,
+                           "controller shut down during mesh establishment");
+    }
     if (MonotonicSeconds() > deadline) {
       return Status::Error(StatusCode::PRECONDITION_ERROR,
                            "mesh accept timeout on rank " +
-                               std::to_string(cfg_.rank));
+                               std::to_string(cfg_.rank) + " (psid " +
+                               std::to_string(psid) + ")");
     }
     Socket s = data_listener_.Accept(1.0);
     if (!s.valid()) continue;
@@ -192,15 +225,63 @@ Status SocketController::ConnectMesh(const std::vector<std::string>& addrs,
     if (!s.RecvFrame(&hello)) continue;
     Reader r(hello);
     int rank = r.GetI32();
-    if (rank <= cfg_.rank || rank >= cfg_.size || peer_socks_[rank].valid()) {
+    int got_psid = r.GetI32();
+    if (!r.ok() || rank <= cfg_.rank || rank >= cfg_.size) {
       return Status::Error(StatusCode::INVALID_ARGUMENT,
                            "bad mesh HELLO (claimed rank " +
                                std::to_string(rank) + ")");
     }
-    peer_socks_[rank] = std::move(s);
+    if (got_psid != psid || !member_set.count(rank)) {
+      // A dial for a channel this rank has not started establishing yet;
+      // stash it for that channel's ConnectMesh.
+      pending_channel_[{rank, got_psid}] = std::move(s);
+      continue;
+    }
+    if ((*out)[rank].valid()) {
+      return Status::Error(StatusCode::INVALID_ARGUMENT,
+                           "duplicate mesh HELLO from rank " +
+                               std::to_string(rank));
+    }
+    (*out)[rank] = std::move(s);
     --needed;
   }
   return Status::OK();
+}
+
+Status SocketController::EstablishChannel(int psid) {
+  if (psid == 0 || cfg_.size == 1 || !initialized_) return Status::OK();
+  std::vector<int> members;
+  if (!process_sets_.Ranks(psid, &members)) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "unknown process set " + std::to_string(psid));
+  }
+  if (std::find(members.begin(), members.end(), cfg_.rank) == members.end()) {
+    return Status::OK();  // non-members hold no channel sockets
+  }
+  if (members.size() <= 1) return Status::OK();
+  std::vector<Socket> socks;
+  Status s = ConnectMesh(members, psid, &socks);
+  if (!s.ok()) return s;
+  std::lock_guard<std::mutex> l(channels_mu_);
+  channel_socks_[psid] = std::move(socks);
+  return Status::OK();
+}
+
+void SocketController::RemoveChannel(int psid) {
+  std::lock_guard<std::mutex> l(channels_mu_);
+  auto it = channel_socks_.find(psid);
+  if (it == channel_socks_.end()) return;
+  for (auto& s : it->second) s.Close();
+  channel_socks_.erase(it);
+}
+
+std::vector<Socket>& SocketController::SocksFor(int psid) {
+  if (psid == 0) return peer_socks_;
+  std::lock_guard<std::mutex> l(channels_mu_);
+  auto it = channel_socks_.find(psid);
+  // Map nodes are pointer-stable; a channel is only erased by
+  // RemoveChannel, which the contract forbids while ops are in flight.
+  return it == channel_socks_.end() ? peer_socks_ : it->second;
 }
 
 void SocketController::Farewell() {
@@ -225,6 +306,19 @@ void SocketController::Shutdown() {
   coord_ctrl_.Close();
   for (auto& s : ctrl_socks_) s.Close();
   for (auto& s : peer_socks_) s.Close();
+  {
+    std::lock_guard<std::mutex> l(channels_mu_);
+    for (auto& kv : channel_socks_)
+      for (auto& s : kv.second) s.Close();
+    channel_socks_.clear();
+  }
+  {
+    // aborted_ is already set, so any in-flight ConnectMesh exits its
+    // accept loop promptly and releases mesh_mu_.
+    std::lock_guard<std::mutex> l(mesh_mu_);
+    for (auto& kv : pending_channel_) kv.second.Close();
+    pending_channel_.clear();
+  }
   listener_.Close();
   data_listener_.Close();
 }
@@ -666,10 +760,11 @@ Status SocketController::CheckFrameHeader(Reader* rd, int32_t tag,
   return Status::OK();
 }
 
-Status SocketController::ExchangeStep(int send_to, const std::string& frame,
+Status SocketController::ExchangeStep(std::vector<Socket>& socks, int send_to,
+                                      const std::string& frame,
                                       int recv_from, std::string* in) {
   if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
-  if (!DuplexExchange(peer_socks_[send_to], frame, peer_socks_[recv_from], in,
+  if (!DuplexExchange(socks[send_to], frame, socks[recv_from], in,
                       [this] { return aborted_.load(); })) {
     aborted_ = true;
     return Status::Error(StatusCode::ABORTED,
@@ -680,8 +775,9 @@ Status SocketController::ExchangeStep(int send_to, const std::string& frame,
   return Status::OK();
 }
 
-Status SocketController::RingAllreduce(void* buf, int64_t count,
-                                       DataType dtype, ReduceOp op,
+Status SocketController::RingAllreduce(std::vector<Socket>& socks, void* buf,
+                                       int64_t count, DataType dtype,
+                                       ReduceOp op,
                                        const std::vector<int>& members,
                                        int idx) {
   const int m = static_cast<int>(members.size());
@@ -703,7 +799,7 @@ Status SocketController::RingAllreduce(void* buf, int64_t count,
     PutFrameHeader(&w, current_seq_, kTagReduceScatter + s);
     w.PutRaw(base + start(send_c) * item, len(send_c) * item);
     std::string in;
-    Status st = ExchangeStep(next, w.data(), prev, &in);
+    Status st = ExchangeStep(socks, next, w.data(), prev, &in);
     if (!st.ok()) return st;
     Reader rd(in);
     st = CheckFrameHeader(&rd, kTagReduceScatter + s, "ring reduce-scatter");
@@ -724,7 +820,7 @@ Status SocketController::RingAllreduce(void* buf, int64_t count,
     PutFrameHeader(&w, current_seq_, kTagAllgatherPhase + s);
     w.PutRaw(base + start(send_c) * item, len(send_c) * item);
     std::string in;
-    Status st = ExchangeStep(next, w.data(), prev, &in);
+    Status st = ExchangeStep(socks, next, w.data(), prev, &in);
     if (!st.ok()) return st;
     Reader rd(in);
     st = CheckFrameHeader(&rd, kTagAllgatherPhase + s, "ring allgather");
@@ -747,7 +843,7 @@ Status SocketController::AllreduceBuffer(void* buf, int64_t count,
   int idx;
   Status st = Members(psid, &members, &idx);
   if (!st.ok()) return st;
-  return RingAllreduce(buf, count, dtype, op, members, idx);
+  return RingAllreduce(SocksFor(psid), buf, count, dtype, op, members, idx);
 }
 
 Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
@@ -764,6 +860,7 @@ Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
     per_rank->assign(1, nbytes);
     return Status::OK();
   }
+  std::vector<Socket>& socks = SocksFor(psid);
   const int next = members[(idx + 1) % m];
   const int prev = members[(idx - 1 + m) % m];
   // Ring allgather with per-rank sizes carried in-band: step s passes block
@@ -777,7 +874,7 @@ Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
     PutFrameHeader(&w, current_seq_, kTagAllgather + s);
     w.PutRaw(blocks[send_b].data(), blocks[send_b].size());
     std::string frame;
-    st = ExchangeStep(next, w.data(), prev, &frame);
+    st = ExchangeStep(socks, next, w.data(), prev, &frame);
     if (!st.ok()) return st;
     Reader rd(frame);
     st = CheckFrameHeader(&rd, kTagAllgather + s, "allgather");
@@ -802,6 +899,7 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
   if (!st.ok()) return st;
   const int m = static_cast<int>(members.size());
   if (m == 1) return Status::OK();
+  std::vector<Socket>& socks = SocksFor(psid);
   auto root_it = std::find(members.begin(), members.end(), root_rank);
   if (root_it == members.end()) {
     return Status::Error(StatusCode::INVALID_ARGUMENT,
@@ -816,7 +914,7 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
     if (vrank & mask) {
       const int src = members[(root_idx + vrank - mask) % m];
       std::string frame;
-      if (!peer_socks_[src].RecvFrame(&frame)) {
+      if (!socks[src].RecvFrame(&frame)) {
         aborted_ = true;
         return Status::Error(StatusCode::ABORTED,
                              "broadcast recv from rank " +
@@ -842,7 +940,7 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       Writer w;
       PutFrameHeader(&w, current_seq_, kTagBroadcast);
       w.PutRaw(buf, nbytes);
-      if (!peer_socks_[dst].SendFrame(w.data())) {
+      if (!socks[dst].SendFrame(w.data())) {
         aborted_ = true;
         return Status::Error(StatusCode::ABORTED,
                              "broadcast send to rank " + std::to_string(dst) +
@@ -870,6 +968,7 @@ Status SocketController::AlltoallBuffer(const void* in,
                          "alltoall splits length != process set size");
   }
   const char* base = static_cast<const char*>(in);
+  std::vector<Socket>& socks = SocksFor(psid);
   std::vector<int64_t> offs(m + 1, 0);
   for (int j = 0; j < m; ++j) offs[j + 1] = offs[j] + splits[j];
   std::vector<std::string> recv_bufs(m);
@@ -887,7 +986,8 @@ Status SocketController::AlltoallBuffer(const void* in,
     w.PutI64(splits[to_i]);
     w.PutRaw(base + offs[to_i] * row_bytes, splits[to_i] * row_bytes);
     std::string frame;
-    st = ExchangeStep(members[to_i], w.data(), members[from_i], &frame);
+    st = ExchangeStep(socks, members[to_i], w.data(), members[from_i],
+                      &frame);
     if (!st.ok()) return st;
     Reader rd(frame);
     st = CheckFrameHeader(&rd, kTagAlltoall + d, "alltoall");
@@ -914,6 +1014,7 @@ Status SocketController::Barrier(int psid) {
   Status st = Members(psid, &members, &idx);
   if (!st.ok()) return st;
   const int m = static_cast<int>(members.size());
+  std::vector<Socket>& socks = SocksFor(psid);
   // Dissemination barrier: ceil(log2(m)) duplex rounds.
   for (int k = 1; k < m; k <<= 1) {
     const int to = members[(idx + k) % m];
@@ -921,7 +1022,7 @@ Status SocketController::Barrier(int psid) {
     Writer w;
     PutFrameHeader(&w, current_seq_, kTagBarrier + k);
     std::string frame;
-    st = ExchangeStep(to, w.data(), from, &frame);
+    st = ExchangeStep(socks, to, w.data(), from, &frame);
     if (!st.ok()) return st;
     Reader rd(frame);
     st = CheckFrameHeader(&rd, kTagBarrier + k, "barrier");

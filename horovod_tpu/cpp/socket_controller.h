@@ -30,7 +30,9 @@
 #include <atomic>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <set>
+#include <utility>
 #include <vector>
 
 #include "common.h"
@@ -69,7 +71,17 @@ class SocketController : public Controller {
 
   std::string StallReport(double older_than_s) override;
 
-  // The executor calls this before each data-plane op to tag frames.
+  // Per-process-set data channels (the NCCL-communicator analog): a
+  // dedicated socket mesh among the set's members, so collectives on
+  // different process sets can run on CONCURRENT executor lanes without
+  // interleaving frames on shared sockets.  Called from add_process_set
+  // on every rank (symmetric registration is already the contract);
+  // non-members return immediately.
+  Status EstablishChannel(int psid) override;
+  void RemoveChannel(int psid) override;
+
+  // The executor lane calls this before each data-plane op to tag frames.
+  // thread_local: each lane thread tags its own collective's frames.
   void SetCurrentSeq(int64_t seq) { current_seq_ = seq; }
 
  private:
@@ -92,18 +104,26 @@ class SocketController : public Controller {
   // Resolve a process set into its sorted member ranks + this rank's index.
   Status Members(int psid, std::vector<int>* members, int* my_idx) const;
   // One collective step: send `frame` to rank `send_to` while receiving a
-  // frame from rank `recv_from` (deadlock-free duplex).
-  Status ExchangeStep(int send_to, const std::string& frame, int recv_from,
+  // frame from rank `recv_from` (deadlock-free duplex) over the given
+  // channel's sockets.
+  Status ExchangeStep(std::vector<Socket>& socks, int send_to,
+                      const std::string& frame, int recv_from,
                       std::string* in);
   // Frame helpers: every data frame is [i64 seq][i32 tag][raw payload];
   // seq/tag mismatches mean the mesh desynced and abort the job.
   static void PutFrameHeader(Writer* w, int64_t seq, int32_t tag);
   Status CheckFrameHeader(Reader* rd, int32_t tag, const char* what);
 
-  Status RingAllreduce(void* buf, int64_t count, DataType dtype, ReduceOp op,
+  Status RingAllreduce(std::vector<Socket>& socks, void* buf, int64_t count,
+                       DataType dtype, ReduceOp op,
                        const std::vector<int>& members, int idx);
-  Status ConnectMesh(const std::vector<std::string>& addrs,
-                     const std::vector<int>& ports);
+  // Build a socket mesh among `members` with HELLOs tagged by `psid`
+  // (lower member dials, higher accepts); init uses psid 0 over all ranks.
+  Status ConnectMesh(const std::vector<int>& members, int psid,
+                     std::vector<Socket>* out);
+  // The socket vector for a process set's data ops: the per-set channel
+  // if one exists, the global full mesh otherwise.
+  std::vector<Socket>& SocksFor(int psid);
 
   // -- wiring ---------------------------------------------------------------
   bool is_coordinator() const { return cfg_.rank == 0; }
@@ -116,6 +136,22 @@ class SocketController : public Controller {
   Socket coord_ctrl_;
   // full mesh: peer_socks_[r] is the data connection to rank r ([rank] unused)
   std::vector<Socket> peer_socks_;
+  // mesh address book from Initialize, kept for later channel dials
+  std::vector<std::string> mesh_addrs_;
+  std::vector<int> mesh_ports_;
+  // psid -> per-set socket mesh (indexed by GLOBAL rank, like peer_socks_)
+  std::map<int, std::vector<Socket>> channel_socks_;
+  // HELLOs that arrived for a channel this rank has not started
+  // establishing yet (skew between ranks' add_process_set calls):
+  // (peer rank, psid) -> accepted socket
+  std::map<std::pair<int, int>, Socket> pending_channel_;
+  std::mutex channels_mu_;  // guards channel_socks_ map shape
+  // Serializes ConnectMesh/EstablishChannel (and Shutdown's pending-stash
+  // cleanup): one establishment at a time, so a HELLO stashed for another
+  // channel is always found by that channel's later drain pass.  Held
+  // across the accept loop — never taken by data ops (SocksFor uses
+  // channels_mu_ only), so in-flight collectives are not blocked.
+  std::mutex mesh_mu_;
 
   ResponseCache cache_;
   std::map<std::string, Pending> pending_;  // coordinator only
@@ -140,7 +176,9 @@ class SocketController : public Controller {
   bool peer_shutdown_ = false;
   int64_t arrival_counter_ = 0;
   int64_t seq_counter_ = 0;   // global data-op sequence (all ranks agree)
-  int64_t current_seq_ = -1;  // seq for the next data op on this rank
+  // seq for the next data op on this lane thread (thread_local so
+  // concurrent per-process-set lanes tag their frames independently)
+  static thread_local int64_t current_seq_;
 
   bool initialized_ = false;
   std::atomic<bool> aborted_{false};

@@ -111,7 +111,7 @@ def add_process_set(process_set: Union[ProcessSet, Sequence[int]]) -> ProcessSet
 def remove_process_set(process_set: ProcessSet) -> bool:
     if process_set.process_set_id in (None, 0):
         return False
-    HorovodContext.instance().core.remove_process_set(process_set.process_set_id)
+    HorovodContext.instance().remove_process_set(process_set.process_set_id)
     process_set.process_set_id = None
     return True
 

@@ -84,6 +84,14 @@ class FusedResponse:
     # always SUM for the ops allowed past a join).
     counts: Optional[List[int]] = None
     last_joined: int = -1
+    # Global data-op sequence tagging this response's wire frames; the
+    # executor lane sets it (set_current_seq) before running the data op.
+    seq: int = -1
+    # Whether THIS rank was in the joined (zero-participation) state when
+    # the dispatcher saw this response.  Stamped at dispatch time — the
+    # dispatcher sees responses in global negotiated order, so the flag is
+    # order-correct even when finalization happens on concurrent lanes.
+    joined_at_dispatch: bool = False
 
 
 class CoreBackend:
@@ -97,9 +105,16 @@ class CoreBackend:
     """
 
     name = "base"
+    # True when responses for DIFFERENT process sets may be finalized on
+    # concurrent executor lanes (requires per-set data channels so frames
+    # never interleave on shared sockets — NativeCore's socket controller).
+    parallel_lanes = False
 
     def start(self, cfg: Config) -> None:
         raise NotImplementedError
+
+    def set_current_seq(self, seq: int) -> None:
+        """Tag the calling thread's next data-plane ops with ``seq``."""
 
     def shutdown(self) -> None:
         raise NotImplementedError

@@ -1,0 +1,155 @@
+"""Parallel response finalization (VERDICT r2 #6).
+
+Each registered process set rides its own data-channel socket mesh
+(socket_controller.cc EstablishChannel) and its own executor lane
+(context._ExecutorLane), so a slow eager host collective on one set cannot
+head-of-line-block independent traffic on another — the reference's
+thread_pool.cc + per-communicator-stream role.
+"""
+
+import numpy as np
+
+from horovod_tpu.runner import run
+
+
+def _overtake_worker():
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    assert hvd.size() == 2
+    ps = hvd.add_process_set([0, 1])
+
+    # A queue of big global-set broadcasts (~192 MB of socket traffic on
+    # lane 0)...
+    big = np.full((16 << 20) // 4, float(r), np.float32)
+    bh = [hvd.broadcast_async(big, root_rank=0, name=f"lane.bc.{i}")
+          for i in range(12)]
+    # ...must not delay a small process-set allreduce (its own channel +
+    # lane): it should complete while broadcasts are still in flight.
+    t0 = time.perf_counter()
+    out = hvd.allreduce(np.full(8, float(r + 1), np.float32), op=hvd.Sum,
+                        process_set=ps, name="lane.ar")
+    dt = time.perf_counter() - t0
+    np.testing.assert_allclose(out, 3.0)
+    still_pending = sum(0 if hvd.poll(h) else 1 for h in bh)
+
+    # The queue must still finish correctly behind it.
+    for h in bh:
+        res = hvd.synchronize(h)
+        np.testing.assert_allclose(res[:4], 0.0)
+        np.testing.assert_allclose(res[-4:], 0.0)
+    hvd.barrier()
+    hvd.shutdown()
+    return {"rank": r, "dt": dt, "pending": still_pending}
+
+
+def test_process_set_allreduce_overtakes_slow_broadcast_queue():
+    results = run(_overtake_worker, np=2)
+    for res in results:
+        # The allreduce completed while global-lane work was still queued:
+        # parallel finalization, not head-of-line blocking.
+        assert res["pending"] >= 1, results
+        assert res["dt"] < 5.0, results
+
+
+def _interleave_worker():
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    assert hvd.size() == 3
+    even = hvd.add_process_set([0, 2])
+    pair = hvd.add_process_set([0, 1])
+
+    # Mixed concurrent traffic across three channels (global + 2 subsets):
+    # every result must be exact — frames never cross channels.
+    for it in range(15):
+        handles = []
+        handles.append(("g", hvd.allreduce_async(
+            np.full(1024, float(r + it), np.float32), op=hvd.Sum,
+            name=f"mix.g.{it}")))
+        if r in (0, 2):
+            handles.append(("e", hvd.allreduce_async(
+                np.full(512, float(10 * r + it), np.float32), op=hvd.Sum,
+                process_set=even, name=f"mix.e.{it}")))
+        if r in (0, 1):
+            handles.append(("p", hvd.allreduce_async(
+                np.full(256, float(100 * r + it), np.float32), op=hvd.Sum,
+                process_set=pair, name=f"mix.p.{it}")))
+        for kind, h in handles:
+            out = np.asarray(hvd.synchronize(h))
+            if kind == "g":
+                np.testing.assert_allclose(out, 3 * it + 3.0)
+            elif kind == "e":
+                np.testing.assert_allclose(out, 2 * it + 20.0)
+            else:
+                np.testing.assert_allclose(out, 2 * it + 100.0)
+    hvd.barrier()
+    hvd.shutdown()
+    return r
+
+
+def test_interleaved_multi_set_traffic_is_exact():
+    assert run(_interleave_worker, np=3) == [0, 1, 2]
+
+
+def _join_with_lanes_worker():
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    assert hvd.size() == 2
+    ps = hvd.add_process_set([0, 1])
+
+    if r == 1:
+        # Joins immediately; must still zero-participate in rank 0's
+        # process-set allreduce on the set's own lane (the joined flag is
+        # stamped at dispatch in GLOBAL negotiated order, so the JOIN
+        # completing on lane 0 cannot erase it early).
+        last = hvd.join()
+    else:
+        out = hvd.allreduce(np.full(64, 5.0, np.float32), op=hvd.Sum,
+                            process_set=ps, name="join.ps.ar")
+        np.testing.assert_allclose(out, 5.0)  # only rank 0 contributed
+        last = hvd.join()
+    hvd.shutdown()
+    return {"rank": r, "last": last}
+
+
+def test_join_zero_participation_on_process_set_lane():
+    results = run(_join_with_lanes_worker, np=2)
+    assert {res["rank"] for res in results} == {0, 1}
+
+
+def _remove_set_worker():
+    import threading
+
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    before = threading.active_count()
+    for i in range(5):
+        ps = hvd.add_process_set([0, 1])
+        out = hvd.allreduce(np.full(16, float(r + 1), np.float32),
+                            op=hvd.Sum, process_set=ps, name=f"rm.{i}")
+        np.testing.assert_allclose(out, 3.0)
+        hvd.remove_process_set(ps)
+    hvd.barrier()
+    after = threading.active_count()
+    hvd.shutdown()
+    # Lanes retire with their sets: no unbounded thread growth.
+    return {"rank": r, "leak": after - before}
+
+
+def test_removed_sets_retire_their_lanes():
+    results = run(_remove_set_worker, np=2)
+    for res in results:
+        assert res["leak"] <= 1, results

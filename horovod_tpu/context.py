@@ -455,6 +455,27 @@ class HorovodContext:
         # MemcpyInFusionBuffer analog: pack members into one contiguous buffer.
         dtype = entries[0].array.dtype
         reduce_op = entries[0].reduce_op
+        if len(entries) == 1 and reduce_op != ReduceOp.ADASUM:
+            # Single-tensor fast path: the fusion pack/unpack would be two
+            # pure-overhead copies.  One owned copy (the user's input must
+            # not be mutated; the plane reduces in place) is all that's
+            # needed.
+            e = entries[0]
+            buf = np.array(e.array, dtype=dtype, copy=True, order="C")
+            flat = buf.reshape(-1)
+            if e.prescale_factor != 1.0:
+                flat = _scale(flat, e.prescale_factor)
+            wire_op = ReduceOp.SUM if reduce_op == ReduceOp.AVERAGE \
+                else reduce_op
+            flat = self.core.allreduce_buffer(flat, psid, wire_op)
+            if reduce_op == ReduceOp.AVERAGE:
+                n = self._ps_size(psid)
+                if n > 1:
+                    flat = _scale(flat, 1.0 / n)
+            if e.postscale_factor != 1.0:
+                flat = _scale(flat, e.postscale_factor)
+            e.result = flat.reshape(e.array.shape)
+            return
         # Pack into the preallocated fusion buffer — no per-cycle allocation.
         total = sum(e.array.size for e in entries)
         fused = self._fusion.view(dtype, total)

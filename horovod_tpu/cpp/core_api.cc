@@ -528,6 +528,9 @@ int hvd_add_process_set(const int* ranks, int n) {
   // can run on their own executor lane, concurrent with other sets'.
   Status s = g->controller->EstablishChannel(id);
   if (!s.ok()) {
+    // EstablishChannel can fail after the channel sockets were inserted
+    // (the shm handshake runs last): close them too.
+    g->controller->RemoveChannel(id);
     g->controller->process_sets().Remove(id);
     SetLastError("process set channel establishment failed: " + s.reason);
     return -4;

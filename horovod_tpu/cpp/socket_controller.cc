@@ -3,6 +3,7 @@
 #include <poll.h>
 
 #include <algorithm>
+#include <cstdlib>
 #include <cstring>
 #include <sstream>
 
@@ -28,6 +29,15 @@ constexpr int32_t kTagAllgather = 0x4000;
 constexpr int32_t kTagBroadcast = 0x5000;
 constexpr int32_t kTagAlltoall = 0x6000;
 constexpr int32_t kTagBarrier = 0x7000;
+// Shared-memory plane phase fences (shm_plane.h): size exchange, write
+// done, segments reduced, read done, region grow, open verdict.
+constexpr int32_t kTagShmSize = 0x8000;
+constexpr int32_t kTagShmWrite = 0x9000;
+constexpr int32_t kTagShmMid = 0xA000;
+constexpr int32_t kTagShmRead = 0xB000;
+constexpr int32_t kTagShmGrow = 0xC000;
+constexpr int32_t kTagShmOpen = 0xD000;
+constexpr int32_t kTagShmVerdict = 0xE000;
 
 }  // namespace
 
@@ -160,6 +170,8 @@ Status SocketController::Initialize() {
   for (int i = 0; i < cfg_.size; ++i) all_ranks[i] = i;
   Status s = ConnectMesh(all_ranks, /*psid=*/0, &peer_socks_);
   if (!s.ok()) return s;
+  s = MaybeOpenShm(0, all_ranks);
+  if (!s.ok()) return s;
   initialized_ = true;
   return Status::OK();
 }
@@ -262,13 +274,23 @@ Status SocketController::EstablishChannel(int psid) {
   std::vector<Socket> socks;
   Status s = ConnectMesh(members, psid, &socks);
   if (!s.ok()) return s;
-  std::lock_guard<std::mutex> l(channels_mu_);
-  channel_socks_[psid] = std::move(socks);
-  return Status::OK();
+  {
+    std::lock_guard<std::mutex> l(channels_mu_);
+    channel_socks_[psid] = std::move(socks);
+  }
+  return MaybeOpenShm(psid, members);
 }
 
 void SocketController::RemoveChannel(int psid) {
   std::lock_guard<std::mutex> l(channels_mu_);
+  auto sh = shm_.find(psid);
+  if (sh != shm_.end()) {
+    std::vector<int> members;
+    bool creator = process_sets_.Ranks(psid, &members) && !members.empty() &&
+                   members[0] == cfg_.rank;
+    sh->second->Close(creator);
+    shm_.erase(sh);
+  }
   auto it = channel_socks_.find(psid);
   if (it == channel_socks_.end()) return;
   for (auto& s : it->second) s.Close();
@@ -308,6 +330,13 @@ void SocketController::Shutdown() {
   for (auto& s : peer_socks_) s.Close();
   {
     std::lock_guard<std::mutex> l(channels_mu_);
+    for (auto& kv : shm_) {
+      std::vector<int> members;
+      bool creator = process_sets_.Ranks(kv.first, &members) &&
+                     !members.empty() && members[0] == cfg_.rank;
+      kv.second->Close(creator);
+    }
+    shm_.clear();
     for (auto& kv : channel_socks_)
       for (auto& s : kv.second) s.Close();
     channel_socks_.clear();
@@ -843,6 +872,12 @@ Status SocketController::AllreduceBuffer(void* buf, int64_t count,
   int idx;
   Status st = Members(psid, &members, &idx);
   if (!st.ok()) return st;
+  if (members.size() > 1) {
+    if (ShmRegion* shm = ShmFor(psid)) {
+      return ShmAllreduce(*shm, SocksFor(psid), members, idx, buf, count,
+                          dtype, op);
+    }
+  }
   return RingAllreduce(SocksFor(psid), buf, count, dtype, op, members, idx);
 }
 
@@ -861,6 +896,10 @@ Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
     return Status::OK();
   }
   std::vector<Socket>& socks = SocksFor(psid);
+  if (ShmRegion* shm = ShmFor(psid)) {
+    return ShmAllgather(*shm, socks, members, idx, in, nbytes, out,
+                        per_rank);
+  }
   const int next = members[(idx + 1) % m];
   const int prev = members[(idx - 1 + m) % m];
   // Ring allgather with per-rank sizes carried in-band: step s passes block
@@ -907,6 +946,9 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
                              " not in process set");
   }
   const int root_idx = static_cast<int>(root_it - members.begin());
+  if (ShmRegion* shm = ShmFor(psid)) {
+    return ShmBroadcast(*shm, socks, members, idx, root_idx, buf, nbytes);
+  }
   const int vrank = (idx - root_idx + m) % m;
   // Binomial tree: log2(m) rounds; parent sends after it has the payload.
   int mask = 1;
@@ -967,8 +1009,14 @@ Status SocketController::AlltoallBuffer(const void* in,
     return Status::Error(StatusCode::INVALID_ARGUMENT,
                          "alltoall splits length != process set size");
   }
-  const char* base = static_cast<const char*>(in);
   std::vector<Socket>& socks = SocksFor(psid);
+  if (m > 1) {
+    if (ShmRegion* shm = ShmFor(psid)) {
+      return ShmAlltoall(*shm, socks, members, idx, in, splits, row_bytes,
+                         out, recv_splits);
+    }
+  }
+  const char* base = static_cast<const char*>(in);
   std::vector<int64_t> offs(m + 1, 0);
   for (int j = 0; j < m; ++j) offs[j + 1] = offs[j] + splits[j];
   std::vector<std::string> recv_bufs(m);
@@ -1013,22 +1061,269 @@ Status SocketController::Barrier(int psid) {
   int idx;
   Status st = Members(psid, &members, &idx);
   if (!st.ok()) return st;
+  return SockBarrier(SocksFor(psid), members, idx, kTagBarrier);
+}
+
+Status SocketController::SockBarrier(std::vector<Socket>& socks,
+                                     const std::vector<int>& members,
+                                     int idx, int32_t tag_base) {
   const int m = static_cast<int>(members.size());
-  std::vector<Socket>& socks = SocksFor(psid);
   // Dissemination barrier: ceil(log2(m)) duplex rounds.
   for (int k = 1; k < m; k <<= 1) {
     const int to = members[(idx + k) % m];
     const int from = members[(idx - k + m) % m];
     Writer w;
-    PutFrameHeader(&w, current_seq_, kTagBarrier + k);
+    PutFrameHeader(&w, current_seq_, tag_base + k);
     std::string frame;
-    st = ExchangeStep(socks, to, w.data(), from, &frame);
+    Status st = ExchangeStep(socks, to, w.data(), from, &frame);
     if (!st.ok()) return st;
     Reader rd(frame);
-    st = CheckFrameHeader(&rd, kTagBarrier + k, "barrier");
+    st = CheckFrameHeader(&rd, tag_base + k, "barrier");
     if (!st.ok()) return st;
   }
   return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Shared-memory plane (same-host members; see shm_plane.h)
+// ---------------------------------------------------------------------------
+
+bool SocketController::MembersAllLocal(const std::vector<int>& members) const {
+  const char* disable = ::getenv("HOROVOD_SHM_DISABLE");
+  if (disable && disable[0] == '1') return false;
+  for (int r : members) {
+    if (r == cfg_.rank) continue;
+    const std::string& a = mesh_addrs_[r];
+    if (a.rfind("127.", 0) != 0 && a != "localhost" && a != "::1") {
+      return false;
+    }
+  }
+  return true;
+}
+
+Status SocketController::MaybeOpenShm(int psid,
+                                      const std::vector<int>& members) {
+  const int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  // The ATTEMPT decision itself must be agreed, not just the open result:
+  // per-rank env/address views can diverge (HOROVOD_SHM_DISABLE set on one
+  // worker only), and a rank that silently skips the handshake would
+  // deadlock the ranks that run it.  So every member always runs the
+  // handshake; a non-attempting member simply votes no.
+  bool attempt = MembersAllLocal(members) &&
+                 static_cast<int64_t>(m) * m * 8 <= ShmRegion::kHeaderBytes;
+  auto it = std::find(members.begin(), members.end(), cfg_.rank);
+  const int idx = static_cast<int>(it - members.begin());
+  const bool creator = idx == 0;
+  std::vector<Socket>& socks = SocksFor(psid);
+  auto region = std::make_unique<ShmRegion>();
+  std::string name =
+      "/hvd_" + std::to_string(cfg_.rendezvous_port) + "_" +
+      std::to_string(psid);
+  Status open_st = Status::OK();
+  if (creator && attempt) {
+    open_st = region->Open(name, true);
+  }
+  Status st = SockBarrier(socks, members, idx, kTagShmOpen);
+  if (!st.ok()) return st;
+  if (!creator && attempt) {
+    open_st = region->Open(name, false);
+  }
+  if (!attempt) {
+    open_st = Status::Error(StatusCode::PRECONDITION_ERROR, "not attempted");
+  }
+  // Agree on the verdict: members send their flag to the set root, which
+  // ANDs and broadcasts it back — either everyone uses the region or
+  // everyone falls back to the TCP ring (a split plane would deadlock).
+  uint8_t ok = open_st.ok() ? 1 : 0;
+  if (creator) {
+    uint8_t all_ok = ok;
+    for (int j = 1; j < m; ++j) {
+      std::string frame;
+      if (!socks[members[j]].RecvFrame(&frame)) all_ok = 0;
+      Reader rd(frame);
+      int64_t seq = rd.GetI64();
+      int32_t tag = rd.GetI32();
+      (void)seq;
+      if (!rd.ok() || tag != kTagShmVerdict || rd.remaining() < 1 ||
+          rd.cursor()[0] == 0) {
+        all_ok = 0;
+      }
+    }
+    for (int j = 1; j < m; ++j) {
+      Writer w;
+      PutFrameHeader(&w, current_seq_, kTagShmVerdict);
+      w.PutRaw(&all_ok, 1);
+      if (!socks[members[j]].SendFrame(w.data())) {
+        return Status::Error(StatusCode::ABORTED, "shm verdict send failed");
+      }
+    }
+    ok = all_ok;
+  } else {
+    Writer w;
+    PutFrameHeader(&w, current_seq_, kTagShmVerdict);
+    w.PutRaw(&ok, 1);
+    if (!socks[members[0]].SendFrame(w.data())) {
+      return Status::Error(StatusCode::ABORTED, "shm verdict send failed");
+    }
+    std::string frame;
+    if (!socks[members[0]].RecvFrame(&frame)) {
+      return Status::Error(StatusCode::ABORTED, "shm verdict recv failed");
+    }
+    Reader rd(frame);
+    rd.GetI64();
+    int32_t tag = rd.GetI32();
+    ok = (rd.ok() && tag == kTagShmVerdict && rd.remaining() >= 1)
+             ? static_cast<uint8_t>(rd.cursor()[0])
+             : 0;
+  }
+  if (!ok) {
+    region->Close(creator);
+    HVD_LOG(INFO) << "shm plane unavailable for psid " << psid
+                  << "; using the TCP ring";
+    return Status::OK();
+  }
+  std::lock_guard<std::mutex> l(channels_mu_);
+  shm_[psid] = std::move(region);
+  return Status::OK();
+}
+
+ShmRegion* SocketController::ShmFor(int psid) {
+  std::lock_guard<std::mutex> l(channels_mu_);
+  auto it = shm_.find(psid);
+  return it == shm_.end() ? nullptr : it->second.get();
+}
+
+Status SocketController::ShmAllreduce(ShmRegion& shm,
+                                      std::vector<Socket>& socks,
+                                      const std::vector<int>& members,
+                                      int idx, void* buf, int64_t count,
+                                      DataType dtype, ReduceOp op) {
+  const int m = static_cast<int>(members.size());
+  const int item = ItemSize(dtype);
+  const int64_t nbytes = count * item;
+  auto grow_barrier = [&] {
+    return SockBarrier(socks, members, idx, kTagShmGrow);
+  };
+  Status st = shm.EnsureCapacity((m + 1) * nbytes, idx == 0, grow_barrier);
+  if (!st.ok()) return st;
+  char* slots = shm.data();
+  char* result = slots + m * nbytes;
+  std::memcpy(slots + idx * nbytes, buf, nbytes);
+  st = SockBarrier(socks, members, idx, kTagShmWrite);
+  if (!st.ok()) return st;
+  // Each member reduces segment `idx` across all slots into the result
+  // area (same segmentation math as the TCP ring).
+  const int64_t chunk = count / m, rem = count % m;
+  auto start = [&](int c) { return c * chunk + std::min<int64_t>(c, rem); };
+  const int64_t seg_off = start(idx) * item;
+  const int64_t seg_len = (start(idx + 1) - start(idx));
+  if (seg_len > 0) {
+    std::memcpy(result + seg_off, slots + seg_off, seg_len * item);
+    for (int j = 1; j < m; ++j) {
+      ReduceInto(result + seg_off, slots + j * nbytes + seg_off, seg_len,
+                 dtype, op);
+    }
+  }
+  st = SockBarrier(socks, members, idx, kTagShmMid);
+  if (!st.ok()) return st;
+  std::memcpy(buf, result, nbytes);
+  // Trailing fence: the next op's writes must not land while a peer is
+  // still reading the result area.
+  return SockBarrier(socks, members, idx, kTagShmRead);
+}
+
+Status SocketController::ShmBroadcast(ShmRegion& shm,
+                                      std::vector<Socket>& socks,
+                                      const std::vector<int>& members,
+                                      int idx, int root_idx, void* buf,
+                                      int64_t nbytes) {
+  auto grow_barrier = [&] {
+    return SockBarrier(socks, members, idx, kTagShmGrow);
+  };
+  Status st = shm.EnsureCapacity(nbytes, idx == 0, grow_barrier);
+  if (!st.ok()) return st;
+  if (idx == root_idx) std::memcpy(shm.data(), buf, nbytes);
+  st = SockBarrier(socks, members, idx, kTagShmWrite);
+  if (!st.ok()) return st;
+  if (idx != root_idx) std::memcpy(buf, shm.data(), nbytes);
+  return SockBarrier(socks, members, idx, kTagShmRead);
+}
+
+Status SocketController::ShmAllgather(ShmRegion& shm,
+                                      std::vector<Socket>& socks,
+                                      const std::vector<int>& members,
+                                      int idx, const void* in, int64_t nbytes,
+                                      std::string* out,
+                                      std::vector<int64_t>* per_rank) {
+  const int m = static_cast<int>(members.size());
+  auto grow_barrier = [&] {
+    return SockBarrier(socks, members, idx, kTagShmGrow);
+  };
+  int64_t* hdr = reinterpret_cast<int64_t*>(shm.header());
+  hdr[idx] = nbytes;
+  Status st = SockBarrier(socks, members, idx, kTagShmSize);
+  if (!st.ok()) return st;
+  // Offsets snapshot the header before any growth remaps the region.
+  std::vector<int64_t> offs(m + 1, 0);
+  for (int j = 0; j < m; ++j) offs[j + 1] = offs[j] + hdr[j];
+  st = shm.EnsureCapacity(offs[m], idx == 0, grow_barrier);
+  if (!st.ok()) return st;
+  std::memcpy(shm.data() + offs[idx], in, nbytes);
+  st = SockBarrier(socks, members, idx, kTagShmWrite);
+  if (!st.ok()) return st;
+  out->clear();
+  per_rank->clear();
+  out->reserve(offs[m]);
+  for (int j = 0; j < m; ++j) {
+    per_rank->push_back(offs[j + 1] - offs[j]);
+    out->append(shm.data() + offs[j], offs[j + 1] - offs[j]);
+  }
+  return SockBarrier(socks, members, idx, kTagShmRead);
+}
+
+Status SocketController::ShmAlltoall(ShmRegion& shm,
+                                     std::vector<Socket>& socks,
+                                     const std::vector<int>& members, int idx,
+                                     const void* in,
+                                     const std::vector<int64_t>& splits,
+                                     int64_t row_bytes, std::string* out,
+                                     std::vector<int64_t>* recv_splits) {
+  const int m = static_cast<int>(members.size());
+  auto grow_barrier = [&] {
+    return SockBarrier(socks, members, idx, kTagShmGrow);
+  };
+  int64_t* hdr = reinterpret_cast<int64_t*>(shm.header());
+  for (int j = 0; j < m; ++j) hdr[idx * m + j] = splits[j];
+  Status st = SockBarrier(socks, members, idx, kTagShmSize);
+  if (!st.ok()) return st;
+  // Snapshot the geometry BEFORE any growth: EnsureCapacity remaps the
+  // region, so the header pointer must not be dereferenced after it.
+  std::vector<int64_t> rows(hdr, hdr + m * m);
+  // Row-major (src, dst) chunk offsets over the agreed geometry.
+  std::vector<int64_t> offs(m * m + 1, 0);
+  for (int k = 0; k < m * m; ++k) {
+    offs[k + 1] = offs[k] + rows[k] * row_bytes;
+  }
+  st = shm.EnsureCapacity(offs[m * m], idx == 0, grow_barrier);
+  if (!st.ok()) return st;
+  const char* base = static_cast<const char*>(in);
+  std::vector<int64_t> local_offs(m + 1, 0);
+  for (int j = 0; j < m; ++j) local_offs[j + 1] = local_offs[j] + splits[j];
+  for (int j = 0; j < m; ++j) {
+    std::memcpy(shm.data() + offs[idx * m + j],
+                base + local_offs[j] * row_bytes, splits[j] * row_bytes);
+  }
+  st = SockBarrier(socks, members, idx, kTagShmWrite);
+  if (!st.ok()) return st;
+  out->clear();
+  recv_splits->clear();
+  for (int i = 0; i < m; ++i) {
+    const int64_t k = i * m + idx;
+    recv_splits->push_back(rows[k]);
+    out->append(shm.data() + offs[k], rows[k] * row_bytes);
+  }
+  return SockBarrier(socks, members, idx, kTagShmRead);
 }
 
 }  // namespace hvdtpu

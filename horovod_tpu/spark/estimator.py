@@ -80,15 +80,23 @@ class JaxEstimator:
                                      partitions=4 * n)
         return self.fit_on_parquet(path)
 
-    def fit_on_parquet(self, train_path: str) -> "JaxModel":
+    def fit_on_parquet(self, train_path: str,
+                       filesystem="store") -> "JaxModel":
         """Train from a materialized Parquet dataset (each worker reads its
-        own row-group shard, streamed through the store's filesystem —
-        HDFS included; nothing is broadcast through the driver)."""
+        own row-group shard, streamed through ``filesystem`` — HDFS
+        included; nothing is broadcast through the driver).
+
+        ``filesystem``: the default ``"store"`` resolves the path against
+        this estimator's store (where :meth:`fit_on_dataframe`
+        materialized it); pass ``None`` for a path on the workers' local
+        mount even when checkpoints live in an HDFS store, or any pyarrow
+        FileSystem explicitly."""
+        if filesystem == "store":
+            filesystem = self.store.filesystem()
         worker_args = (self.model, self.loss, self.optimizer, None, None,
                        self.batch_size, self.epochs, self.seed,
                        train_path, tuple(self.feature_cols),
-                       tuple(self.label_cols),
-                       self.store.filesystem_spec())
+                       tuple(self.label_cols), filesystem)
         if self.backend == "spark":
             from . import run as spark_run
 

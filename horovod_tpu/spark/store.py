@@ -41,14 +41,8 @@ class Store:
     def filesystem(self):
         """pyarrow FileSystem for streaming reads/writes of train data
         (reference: store.py's fs handle consumed by Petastorm).  None
-        means plain local paths."""
-        return None
-
-    def filesystem_spec(self):
-        """Picklable description of :meth:`filesystem` so launcher-spawned
-        workers can rebuild the handle (filesystem objects themselves
-        don't cross process boundaries); resolved by
-        ``spark.data.open_filesystem``."""
+        means plain local paths.  pyarrow filesystems pickle (Hadoop
+        reconnects on unpickle), so the handle rides worker args as-is."""
         return None
 
     def get_train_data_url(self, run_id: str) -> str:
@@ -138,9 +132,7 @@ class HDFSStore(Store):
             # Injected filesystem (tests use a local pyarrow fs as the
             # HDFS stand-in; libhdfs isn't present in CI).
             self._fs = filesystem
-            self._injected = True
             return
-        self._injected = False
         try:
             from pyarrow import fs as pafs
 
@@ -154,13 +146,6 @@ class HDFSStore(Store):
 
     def filesystem(self):
         return self._fs
-
-    def filesystem_spec(self):
-        if self._injected:
-            # Not picklable across processes; in-process (local backend)
-            # workers receive the object itself.
-            return self._fs
-        return ("hdfs", self._host, self._port, self._user)
 
     def get_train_data_url(self, run_id: str) -> str:
         if self._host in (None, "", "default"):

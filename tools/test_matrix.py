@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Controller/config test matrix (reference analog: the docker-compose +
+Buildkite matrix exercising framework x controller x device combos,
+SURVEY.md §4.5).
+
+Runs a canonical collective-correctness workload across every supported
+combination of:
+
+- core:    native (LocalController at np=1, socket controller at np>1)
+           x pure-python (np=1 only — the fallback core's contract)
+- np:      1, 2, 3
+- fusion:  default threshold / disabled (HOROVOD_FUSION_THRESHOLD=0)
+- cache:   default capacity / disabled (HOROVOD_CACHE_CAPACITY=0)
+- plane:   shared-memory / TCP ring (HOROVOD_SHM_DISABLE=1), np>1 only
+
+Usage:
+    python tools/test_matrix.py              # full matrix
+    python tools/test_matrix.py --quick      # one combo per axis value
+
+Prints one PASS/FAIL line per combination and exits nonzero if any fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKLOAD = textwrap.dedent("""
+    import os
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+
+    # allreduce ops + dtypes
+    x = np.full(33, float(r + 1), np.float32)
+    total = s * (s + 1) / 2.0
+    np.testing.assert_allclose(hvd.allreduce(x, op=hvd.Sum, name="m.sum"),
+                               total)
+    np.testing.assert_allclose(hvd.allreduce(x, op=hvd.Average, name="m.avg"),
+                               total / s)
+    np.testing.assert_allclose(hvd.allreduce(x, op=hvd.Min, name="m.min"), 1.0)
+    np.testing.assert_allclose(hvd.allreduce(x, op=hvd.Max, name="m.max"),
+                               float(s))
+    v = (np.arange(6) + r).astype(np.int64)
+    expected = sum((np.arange(6) + rr) for rr in range(s))
+    np.testing.assert_array_equal(hvd.allreduce(v, op=hvd.Sum, name="m.i64"),
+                                  expected)
+
+    # fusion sweep: many small tensors in one window
+    handles = [hvd.allreduce_async(np.full(8, float(i + r), np.float32),
+                                   op=hvd.Sum, name=f"m.f.{i}")
+               for i in range(40)]
+    for i, h in enumerate(handles):
+        np.testing.assert_allclose(hvd.synchronize(h),
+                                   s * i + s * (s - 1) / 2.0)
+
+    # cache steady state: identical negotiation repeated
+    for it in range(20):
+        out = hvd.allreduce(np.full(16, float(r), np.float32), op=hvd.Sum,
+                            name="m.cached")
+        np.testing.assert_allclose(out, s * (s - 1) / 2.0)
+
+    # ragged allgather
+    g = np.asarray(hvd.allgather(np.full((r + 1, 2), float(r), np.float32),
+                                 name="m.ag"))
+    assert g.shape == (s * (s + 1) // 2, 2), g.shape
+
+    # broadcast from every root
+    for root in range(s):
+        out = hvd.broadcast(np.full(5, float(r), np.float64), root_rank=root,
+                            name=f"m.bc.{root}")
+        np.testing.assert_allclose(out, float(root))
+
+    # equal-splits alltoall
+    data = (np.arange(2 * s, dtype=np.float32) + 10 * r).reshape(2 * s, 1)
+    out, _ = hvd.alltoall(data, splits=[2] * s, name="m.a2a")
+    assert np.asarray(out).shape == (2 * s, 1)
+
+    # process set (channel + lane + per-set plane)
+    if s >= 2:
+        ps = hvd.add_process_set(list(range(s - 1)))
+        if r < s - 1:
+            out = hvd.allreduce(np.full(7, float(r + 1), np.float32),
+                                op=hvd.Sum, process_set=ps, name="m.ps")
+            np.testing.assert_allclose(out, (s - 1) * s / 2.0)
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"WORKLOAD-OK rank={r}", flush=True)
+""")
+
+
+def combos(quick: bool):
+    cores = ["native", "purepy"]
+    nps = [1, 2, 3]
+    fusion = ["on", "off"]
+    cache = ["on", "off"]
+    planes = ["shm", "tcp"]
+    if quick:
+        # One covering set instead of the full product.
+        yield ("native", 3, "on", "on", "shm")
+        yield ("native", 2, "off", "off", "tcp")
+        yield ("native", 1, "on", "off", "shm")
+        yield ("purepy", 1, "off", "on", "shm")
+        return
+    for core, np_, f, c, p in itertools.product(cores, nps, fusion, cache,
+                                                planes):
+        if core == "purepy" and np_ > 1:
+            continue  # pure-python core is single-process by contract
+        if np_ == 1 and p == "tcp":
+            continue  # no data plane at np=1; plane axis is meaningless
+        yield (core, np_, f, c, p)
+
+
+def run_combo(core: str, np_: int, fusion: str, cache: str, plane: str,
+              script: str, timeout: float) -> tuple:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if core == "purepy":
+        env["HVD_TPU_PURE_PY"] = "1"
+    if fusion == "off":
+        env["HOROVOD_FUSION_THRESHOLD"] = "0"
+    if cache == "off":
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    if plane == "tcp":
+        env["HOROVOD_SHM_DISABLE"] = "1"
+    if np_ == 1:
+        cmd = [sys.executable, script]
+    else:
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+               "-np", str(np_), sys.executable, script]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired as exc:
+        return False, time.monotonic() - t0, f"timeout: {exc}"
+    ok = proc.returncode == 0 and \
+        proc.stdout.count("WORKLOAD-OK") == np_
+    detail = "" if ok else (proc.stdout + proc.stderr)[-800:]
+    return ok, time.monotonic() - t0, detail
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="covering subset instead of the full product")
+    ap.add_argument("--timeout", type=float, default=180.0)
+    args = ap.parse_args()
+
+    failures = []
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "workload.py")
+        with open(script, "w") as f:
+            f.write(WORKLOAD)
+        for combo in combos(args.quick):
+            core, np_, fusion, cache, plane = combo
+            label = (f"core={core:<7} np={np_} fusion={fusion:<3} "
+                     f"cache={cache:<3} plane={plane}")
+            ok, dt, detail = run_combo(*combo, script=script,
+                                       timeout=args.timeout)
+            print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
+                  flush=True)
+            if not ok:
+                failures.append((label, detail))
+    for label, detail in failures:
+        print(f"\n--- {label} ---\n{detail}", file=sys.stderr)
+    print(f"\n{'ALL PASS' if not failures else f'{len(failures)} FAILED'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

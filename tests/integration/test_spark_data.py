@@ -114,7 +114,7 @@ def test_hdfs_materialize_and_stream_read(tmp_path):
     seen = 0
     for rank in range(2):
         reader = ParquetShardReader(path, rank=rank, size=2, batch_size=16,
-                                    filesystem=store.filesystem_spec())
+                                    filesystem=store.filesystem())
         rows = sum(len(b["label"]) for b in reader.batches())
         assert rows == len(reader) > 0
         seen += rows

@@ -47,6 +47,15 @@ _PROBE_TIMEOUT_S = float(os.environ.get("_HVD_TPU_BENCH_PROBE_S", "240"))
 # A crash this early (backend init raced the tunnel) is worth one retry as
 # long as most of the budget remains.
 _FAST_CRASH_S = 120.0
+# Last successful on-chip measurement, persisted so a dead tunnel at the
+# instant the driver happens to run us does not erase perf evidence gathered
+# while it was alive (VERDICT r3 #1: opportunistic benching).  Served on
+# live failure, clearly provenance-marked "source": "cached" — never
+# presented as a live number.
+_CACHE_PATH = os.environ.get(
+    "_HVD_TPU_BENCH_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "PERF_LAST_GOOD.json"))
 
 # Published per-chip peak bf16 matmul throughput, by device_kind prefix.
 _PEAK_BF16_FLOPS = (
@@ -450,6 +459,62 @@ class _ChildRun:
             pass
 
 
+def _save_last_good(result: dict) -> None:
+    """Persist a live on-chip headline as PERF_LAST_GOOD.json (atomic).
+
+    Only real-TPU measurements count as perf evidence — CPU smoke runs and
+    scripted test children carry no TPU device_kind and are never cached.
+    """
+    if not str(result.get("device_kind", "")).startswith("TPU"):
+        return
+    if not result.get("value"):
+        return
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:
+        sha = ""
+    payload = {
+        "result": result,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "recorded_at_unix": time.time(),
+        "git_sha": sha,
+        "source": "live",
+        "methodology": (
+            "readback-honest: timed iterations chain through donated train "
+            "state and end with a scalar host readback, which bounds the "
+            "enqueued device work (jax.block_until_ready does not "
+            "synchronize over this sandbox's remote-TPU tunnel)"),
+    }
+    try:
+        tmp = _CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, _CACHE_PATH)
+        _log(f"persisted live result to {_CACHE_PATH}")
+    except OSError as exc:
+        _log(f"could not persist last-good cache: {exc}")
+
+
+def _load_last_good() -> dict | None:
+    # Shape-validated and broadly excepted: a malformed cache must degrade
+    # to "no cache", never crash the parent's failure path (which still owes
+    # the driver its one JSON line).
+    try:
+        with open(_CACHE_PATH) as f:
+            payload = json.load(f)
+        if (isinstance(payload, dict)
+                and isinstance(payload.get("result"), dict)
+                and payload["result"].get("value")):
+            return payload
+    except Exception as exc:
+        _log(f"unusable last-good cache: {exc}")
+    return None
+
+
 def _finish(result: dict, errf) -> None:
     errf.seek(0)
     sys.stderr.write(errf.read()[-4000:])
@@ -514,6 +579,7 @@ def main() -> None:
                     run.result.setdefault(
                         "note", f"truncated: child exited rc={rc} during an "
                                 "appendix phase; headline is complete")
+                _save_last_good(run.result)
                 _finish(run.result, errf)
                 return
 
@@ -547,6 +613,39 @@ def main() -> None:
             tail = errf.read()[-400:].strip()
             if tail:
                 last_err = f"{last_err}; child log tail: {tail}"
+
+        # Live run failed: serve the last successful on-chip measurement if
+        # one is on disk, with its full provenance.  The values are real
+        # measurements of this framework on this hardware — just not from
+        # this invocation — and the line says so explicitly.
+        cached = _load_last_good()
+        if cached is not None:
+            # A malformed cache field must fall through to the value-0 line,
+            # not crash the parent before it prints its one JSON line.
+            try:
+                res = dict(cached["result"])
+                res["source"] = "cached"
+                res["cached_at"] = cached.get("recorded_at")
+                rec_unix = cached.get("recorded_at_unix")
+                if isinstance(rec_unix, (int, float)) and rec_unix > 0:
+                    res["cached_age_hours"] = round(
+                        (time.time() - rec_unix) / 3600.0, 1)
+                res["cached_git_sha"] = str(cached.get("git_sha") or "")[:12]
+                # "live" = written by _save_last_good from a real run;
+                # anything else (e.g. a seeded file) stays distinguishable.
+                res["cached_source"] = str(cached.get("source") or "unknown")
+                res["cached_methodology"] = str(
+                    cached.get("methodology") or "")
+                res["live_error"] = last_err[-400:]
+                res["note"] = ("live TPU run FAILED this invocation; values "
+                               "are the last successful on-chip measurement "
+                               "(see cached_* provenance), not live")
+            except Exception as exc:
+                _log(f"cache serve failed: {exc}")
+            else:
+                _finish(res, errf)
+                return
+
         _finish({
             "metric": "resnet50_train_images_per_sec_per_chip",
             "value": 0.0,

@@ -16,7 +16,7 @@ BENCH = os.path.join(os.path.dirname(__file__), "..", "..", "bench.py")
 
 
 def _run_parent(child_script: str, budget: str = "20", probe: str = "5",
-                timeout: float = 60.0):
+                timeout: float = 60.0, cache_path: str | None = None):
     import tempfile
 
     env = dict(os.environ)
@@ -27,13 +27,22 @@ def _run_parent(child_script: str, budget: str = "20", probe: str = "5",
                                      delete=False) as f:
         f.write(child_script)
         script_path = f.name
+    # Isolate PERF_LAST_GOOD.json: the repo-level cache must neither leak
+    # into these scripted runs nor be clobbered by them.
+    cache_td = None
     try:
+        if cache_path is None:
+            cache_td = tempfile.TemporaryDirectory()
+            cache_path = os.path.join(cache_td.name, "last_good.json")
+        env["_HVD_TPU_BENCH_CACHE"] = cache_path
         env["_HVD_TPU_BENCH_CHILD_CMD"] = f"{sys.executable} {script_path}"
         proc = subprocess.run(
-            [sys.executable, BENCH], env=env, capture_output=True, text=True,
-            timeout=timeout)
+            [sys.executable, BENCH], env=env, capture_output=True,
+            text=True, timeout=timeout)
     finally:
         os.unlink(script_path)
+        if cache_td is not None:
+            cache_td.cleanup()
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
     assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
     return proc.returncode, json.loads(lines[0])
@@ -133,10 +142,82 @@ def test_child_exit_zero_without_result_is_an_error():
     assert "without emitting a result" in result["error"]
 
 
+def test_live_failure_serves_cached_result_with_provenance():
+    # VERDICT r3 #1: a dead tunnel must serve the persisted last-good
+    # on-chip numbers, clearly marked "source": "cached" with age/sha —
+    # never the value-0 line — and exit 0 (usable evidence was produced).
+    import tempfile, time
+
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "last_good.json")
+        with open(cache, "w") as f:
+            json.dump({
+                "result": {"metric": "resnet50_train_images_per_sec_per_chip",
+                           "value": 2400.0, "unit": "images/sec/chip",
+                           "vs_baseline": 10.2,
+                           "device_kind": "TPU v5 lite"},
+                "recorded_at": "2026-07-30T05:00:00Z",
+                "recorded_at_unix": time.time() - 7200,
+                "git_sha": "abcdef1234567890",
+                "source": "live",
+                "methodology": "readback-honest",
+            }, f)
+        rc, result = _run_parent("import time; time.sleep(3600)",
+                                 cache_path=cache)
+    assert rc == 0
+    assert result["value"] == 2400.0
+    assert result["source"] == "cached"
+    assert result["cached_git_sha"] == "abcdef123456"
+    assert 1.5 < result["cached_age_hours"] < 3.0
+    assert "did not complete" in result["live_error"]
+    assert "not live" in result["note"]
+
+
+def test_live_tpu_result_is_persisted_to_cache():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "last_good.json")
+        rc, result = _run_parent(textwrap.dedent("""
+            import json
+            print(json.dumps({"phase": "probe"}), flush=True)
+            print(json.dumps({"metric": "m", "value": 2500.0, "unit": "u",
+                              "vs_baseline": 10.6,
+                              "device_kind": "TPU v5 lite"}), flush=True)
+        """), cache_path=cache)
+        assert rc == 0
+        with open(cache) as f:
+            payload = json.load(f)
+    assert payload["result"]["value"] == 2500.0
+    assert payload["source"] == "live"
+    assert payload["recorded_at_unix"] > 0
+    assert "readback" in payload["methodology"]
+
+
+def test_cpu_result_never_touches_cache():
+    # CPU smoke results are not on-chip perf evidence; the cache must not
+    # be written (device_kind is absent / non-TPU in the scripted child).
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "last_good.json")
+        rc, result = _run_parent(textwrap.dedent("""
+            import json
+            print(json.dumps({"phase": "probe"}), flush=True)
+            print(json.dumps({"metric": "m", "value": 50.0, "unit": "u",
+                              "vs_baseline": 0.2,
+                              "device_kind": "cpu"}), flush=True)
+        """), cache_path=cache)
+        assert rc == 0
+        assert not os.path.exists(cache)
+
+
 def test_end_to_end_tiny_cpu():
     # The REAL child (probe line, headline emit, flash appendix in interpret
     # mode) on the CPU backend with tiny shapes: covers the streaming
     # protocol the scripted-child tests replace.
+    import tempfile
+
     env = dict(os.environ)
     env.pop("_HVD_TPU_BENCH_CHILD", None)
     env.pop("_HVD_TPU_BENCH_CHILD_CMD", None)
@@ -145,9 +226,11 @@ def test_end_to_end_tiny_cpu():
     env["_HVD_TPU_BENCH_TINY"] = "1"
     env["_HVD_TPU_BENCH_BUDGET_S"] = "400"
     env["_HVD_TPU_BENCH_PROBE_S"] = "180"
-    proc = subprocess.run(
-        [sys.executable, BENCH], env=env, capture_output=True, text=True,
-        timeout=420)
+    with tempfile.TemporaryDirectory() as td:
+        env["_HVD_TPU_BENCH_CACHE"] = os.path.join(td, "last_good.json")
+        proc = subprocess.run(
+            [sys.executable, BENCH], env=env, capture_output=True, text=True,
+            timeout=420)
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
     assert proc.returncode == 0, (proc.stdout, proc.stderr[-1500:])
     assert len(lines) == 1

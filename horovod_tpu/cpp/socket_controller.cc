@@ -44,7 +44,18 @@ constexpr int32_t kTagShmVerdict = 0xE000;
 thread_local int64_t SocketController::current_seq_ = -1;
 
 SocketController::SocketController(const CoreConfig& cfg)
-    : Controller(cfg), cache_(cfg.cache_capacity) {}
+    : Controller(cfg), cache_(cfg.cache_capacity) {
+  // HOROVOD_RING_CHUNK_BYTES (0 disables pipelining; clamped to 1 GiB —
+  // the u32 chunk-frame length prefix cannot carry more).  Default lives
+  // on the member initializer in socket_controller.h.
+  if (const char* env = ::getenv("HOROVOD_RING_CHUNK_BYTES")) {
+    char* end = nullptr;
+    long long v = std::strtoll(env, &end, 10);
+    if (end && *end == '\0' && v >= 0) {
+      ring_chunk_bytes_ = std::min<long long>(v, 1LL << 30);
+    }
+  }
+}
 
 SocketController::~SocketController() { Shutdown(); }
 
@@ -804,6 +815,47 @@ Status SocketController::ExchangeStep(std::vector<Socket>& socks, int send_to,
   return Status::OK();
 }
 
+Status SocketController::ChunkedStep(
+    std::vector<Socket>& socks, int send_to, const char* send_base,
+    int64_t send_len, int recv_from, int64_t recv_len, char* recv_dest,
+    int32_t tag, int64_t chunk_bytes,
+    const std::function<void(int64_t, const char*, int64_t)>& consume) {
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  Writer w;
+  PutFrameHeader(&w, current_seq_, tag);
+  ChunkExchangeError err;
+  if (!ChunkedDuplexExchange(socks[send_to], send_base, send_len,
+                             socks[recv_from], recv_len, chunk_bytes,
+                             w.data(), recv_dest, consume,
+                             [this] { return aborted_.load(); }, &err)) {
+    aborted_ = true;
+    if (err.kind == ChunkExchangeError::kHeaderMismatch) {
+      Reader rd(err.got_header);
+      int64_t seq = rd.GetI64();
+      int32_t got = rd.GetI32();
+      return Status::Error(
+          StatusCode::ABORTED,
+          "data plane desync in pipelined ring: expected seq " +
+              std::to_string(current_seq_) + " tag " + std::to_string(tag) +
+              ", got seq " + std::to_string(seq) + " tag " +
+              std::to_string(got));
+    }
+    if (err.kind == ChunkExchangeError::kBadLength) {
+      return Status::Error(
+          StatusCode::ABORTED,
+          "data plane desync in pipelined ring: bad chunk length " +
+              std::to_string(err.bad_length) + " (seq " +
+              std::to_string(current_seq_) + " tag " + std::to_string(tag) +
+              ")");
+    }
+    return Status::Error(StatusCode::ABORTED,
+                         "pipelined ring exchange failed (send->" +
+                             std::to_string(send_to) + ", recv<-" +
+                             std::to_string(recv_from) + ")");
+  }
+  return Status::OK();
+}
+
 Status SocketController::RingAllreduce(std::vector<Socket>& socks, void* buf,
                                        int64_t count, DataType dtype,
                                        ReduceOp op,
@@ -819,6 +871,56 @@ Status SocketController::RingAllreduce(std::vector<Socket>& socks, void* buf,
   const int next = members[(idx + 1) % m];
   const int prev = members[(idx - 1 + m) % m];
 
+  if (ring_chunk_bytes_ > 0) {
+    // Pipelined (Gloo segmented-ring) path: each hop streams the segment
+    // in element-aligned chunks straight from/into the user buffer —
+    // no full-segment copies — and reduces each received chunk while the
+    // kernel keeps moving later chunks, so compute overlaps the wire.
+    const int64_t chunkb =
+        std::max<int64_t>(item, ring_chunk_bytes_ / item * item);
+    std::vector<char> scratch;
+    // Phase 1: ring reduce-scatter with in-flight reduction.
+    for (int s = 0; s < m - 1; ++s) {
+      const int send_c = ((idx - s) % m + m) % m;
+      const int recv_c = ((idx - s - 1) % m + m) % m;
+      const int64_t rbytes = len(recv_c) * item;
+      if (static_cast<int64_t>(scratch.size()) < rbytes) {
+        scratch.resize(static_cast<size_t>(rbytes));
+      }
+      char* seg = base + start(recv_c) * item;
+      int64_t reduced = 0;
+      auto consume = [&](int64_t off, const char* /*data*/, int64_t nb) {
+        // Reduce every fully-received element so far; the peer's chunking
+        // need not be element-aligned (its HOROVOD_RING_CHUNK_BYTES may
+        // differ), so carry any partial element to the next chunk.
+        const int64_t avail = (off + nb) / item * item;
+        if (avail > reduced) {
+          ReduceInto(seg + reduced, scratch.data() + reduced,
+                     (avail - reduced) / item, dtype, op);
+          reduced = avail;
+        }
+      };
+      Status st = ChunkedStep(socks, next, base + start(send_c) * item,
+                              len(send_c) * item, prev, rbytes,
+                              scratch.data(), kTagReduceScatter + s, chunkb,
+                              consume);
+      if (!st.ok()) return st;
+    }
+    // Phase 2: ring allgather, received straight into place (zero-copy in
+    // both directions).
+    for (int s = 0; s < m - 1; ++s) {
+      const int send_c = ((idx + 1 - s) % m + m) % m;
+      const int recv_c = ((idx - s) % m + m) % m;
+      Status st = ChunkedStep(socks, next, base + start(send_c) * item,
+                              len(send_c) * item, prev, len(recv_c) * item,
+                              base + start(recv_c) * item,
+                              kTagAllgatherPhase + s, chunkb, nullptr);
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+
+  // Legacy whole-segment path (HOROVOD_RING_CHUNK_BYTES=0).
   // Phase 1: ring reduce-scatter.  After m-1 steps this rank holds the
   // fully reduced chunk (idx+1)%m.
   for (int s = 0; s < m - 1; ++s) {

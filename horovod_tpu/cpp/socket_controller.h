@@ -110,6 +110,17 @@ class SocketController : public Controller {
   Status ExchangeStep(std::vector<Socket>& socks, int send_to,
                       const std::string& frame, int recv_from,
                       std::string* in);
+  // Chunk-pipelined ring step (Gloo segmented-ring analog): payload flows
+  // directly between the user buffer and the wire in `chunk_bytes` pieces,
+  // `consume` runs per completed chunk (overlapping reduce with transfer),
+  // and `recv_dest` receives the incoming segment in place.  Headers carry
+  // the same [seq|tag] as ExchangeStep frames; mismatches abort the job.
+  Status ChunkedStep(
+      std::vector<Socket>& socks, int send_to, const char* send_base,
+      int64_t send_len, int recv_from, int64_t recv_len, char* recv_dest,
+      int32_t tag, int64_t chunk_bytes,
+      const std::function<void(int64_t off, const char* data, int64_t len)>&
+          consume);
   // Frame helpers: every data frame is [i64 seq][i32 tag][raw payload];
   // seq/tag mismatches mean the mesh desynced and abort the job.
   static void PutFrameHeader(Writer* w, int64_t seq, int32_t tag);
@@ -155,6 +166,12 @@ class SocketController : public Controller {
 
   // -- wiring ---------------------------------------------------------------
   bool is_coordinator() const { return cfg_.rank == 0; }
+
+  // HOROVOD_RING_CHUNK_BYTES: ring-hop pipelining granularity (0 = legacy
+  // whole-segment frames).  512 KiB measured best on the loopback sweep
+  // (128k/256k/512k x socket-buffer sizes); the ctor only overrides this
+  // from the env.
+  int64_t ring_chunk_bytes_ = 1 << 19;
 
   Listener listener_;       // coordinator: rendezvous/ctrl accept
   Listener data_listener_;  // every rank: mesh peer accept (ephemeral port)

@@ -11,6 +11,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdlib>
 #include <thread>
 
 #include "logging.h"
@@ -94,6 +95,29 @@ Response DeserializeResponse(Reader* r) {
 
 // ---- Socket ---------------------------------------------------------------
 
+namespace {
+
+// HOROVOD_SOCKET_BUFFER_BYTES: kernel send/recv buffer size for data-plane
+// sockets (0 = leave the kernel default).  Oversized buffers hurt on
+// cache-constrained hosts (more cold in-flight bytes), so this stays a
+// deliberate knob rather than a hardcoded maximum.
+void TuneDataSocketBuffers(int fd) {
+  static const int bufsz = [] {
+    if (const char* env = ::getenv("HOROVOD_SOCKET_BUFFER_BYTES")) {
+      char* end = nullptr;
+      long v = std::strtol(env, &end, 10);
+      if (end && *end == '\0' && v >= 0) return static_cast<int>(v);
+    }
+    return 0;
+  }();
+  if (bufsz > 0) {
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
+  }
+}
+
+}  // namespace
+
 Socket::~Socket() { Close(); }
 
 Socket& Socket::operator=(Socket&& o) noexcept {
@@ -142,6 +166,7 @@ bool Socket::Connect(const std::string& addr, int port, double timeout_s) {
     if (fd < 0) return false;
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    TuneDataSocketBuffers(fd);
     sockaddr_in sa = resolved;
     if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
       fd_ = fd;
@@ -333,6 +358,209 @@ bool DuplexExchange(Socket& send_sock, const std::string& out,
   return ok;
 }
 
+bool ChunkedDuplexExchange(
+    Socket& send_sock, const char* send_base, int64_t send_len,
+    Socket& recv_sock, int64_t recv_total, int64_t chunk_bytes,
+    const std::string& header, char* recv_dest,
+    const std::function<void(int64_t off, const char* data, int64_t len)>&
+        on_chunk,
+    const std::function<bool()>& cancelled, ChunkExchangeError* err) {
+  const int sfd = send_sock.fd();
+  const int rfd = recv_sock.fd();
+  if (err) *err = ChunkExchangeError{ChunkExchangeError::kTransport, "", 0};
+  if (sfd < 0 || rfd < 0) return false;
+  if (chunk_bytes <= 0) chunk_bytes = 1 << 19;
+  const size_t hdr_n = header.size();
+
+  // Send state: per chunk, a small prefix+header scratch, then payload
+  // straight out of the caller's buffer (no segment-sized copies).
+  std::string shdr;
+  size_t shdr_sent = 0;
+  int64_t schunk_start = 0;  // payload offset of the current chunk
+  int64_t schunk_len = 0;
+  int64_t schunk_sent = 0;
+  bool schunk_active = false;
+  auto arm_send_chunk = [&](int64_t start) {
+    if (start >= send_len) {
+      schunk_active = false;
+      return;
+    }
+    schunk_start = start;
+    schunk_len = std::min<int64_t>(chunk_bytes, send_len - start);
+    uint32_t flen = static_cast<uint32_t>(hdr_n + schunk_len);
+    shdr.assign(reinterpret_cast<const char*>(&flen), 4);
+    shdr += header;
+    shdr_sent = 0;
+    schunk_sent = 0;
+    schunk_active = true;
+  };
+  arm_send_chunk(0);
+
+  // Recv state machine: frame length prefix -> header -> payload.  The
+  // payload length comes from the peer's framing, so the two ends may run
+  // different chunk sizes.
+  int64_t recv_done = 0;
+  uint32_t rlen = 0;
+  size_t rlen_got = 0;
+  std::string rhdr(hdr_n, '\0');
+  size_t rhdr_got = 0;
+  int64_t rchunk_len = 0;
+  int64_t rchunk_got = 0;
+  bool rframe_known = false;  // prefix + header fully read
+  std::vector<char> scratch;
+
+  if (!SetNonblocking(sfd, true)) return false;
+  if (rfd != sfd && !SetNonblocking(rfd, true)) {
+    SetNonblocking(sfd, false);
+    return false;
+  }
+  bool ok = true;
+  while (ok && (schunk_active || recv_done < recv_total)) {
+    if (cancelled && cancelled()) {
+      ok = false;
+      break;
+    }
+    pollfd pfds[2];
+    int n = 0;
+    const bool want_send = schunk_active;
+    const bool want_recv = recv_done < recv_total;
+    if (sfd == rfd) {
+      pfds[n++] = pollfd{
+          sfd,
+          static_cast<short>((want_send ? POLLOUT : 0) |
+                             (want_recv ? POLLIN : 0)),
+          0};
+    } else {
+      if (want_send) pfds[n++] = pollfd{sfd, POLLOUT, 0};
+      if (want_recv) pfds[n++] = pollfd{rfd, POLLIN, 0};
+    }
+    int rc = ::poll(pfds, n, 200);  // short: re-check cancellation
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      ok = false;
+      break;
+    }
+    if (rc == 0) continue;  // peer may still be computing toward this step
+    for (int i = 0; i < n && ok; ++i) {
+      if (pfds[i].revents & POLLNVAL) {
+        ok = false;
+        break;
+      }
+      if ((pfds[i].revents & (POLLOUT | POLLERR | POLLHUP)) && want_send &&
+          pfds[i].fd == sfd && schunk_active) {
+        if (shdr_sent < shdr.size()) {
+          ssize_t w = ::send(pfds[i].fd, shdr.data() + shdr_sent,
+                             shdr.size() - shdr_sent, MSG_NOSIGNAL);
+          if (w > 0) {
+            shdr_sent += static_cast<size_t>(w);
+          } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                     errno != EINTR) {
+            ok = false;
+            break;
+          }
+        }
+        if (shdr_sent == shdr.size() && schunk_sent < schunk_len) {
+          ssize_t w = ::send(
+              pfds[i].fd, send_base + schunk_start + schunk_sent,
+              static_cast<size_t>(schunk_len - schunk_sent), MSG_NOSIGNAL);
+          if (w > 0) {
+            schunk_sent += w;
+          } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                     errno != EINTR) {
+            ok = false;
+            break;
+          }
+        }
+        if (shdr_sent == shdr.size() && schunk_sent == schunk_len) {
+          arm_send_chunk(schunk_start + schunk_len);
+        }
+      }
+      if ((pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) && want_recv &&
+          pfds[i].fd == rfd) {
+        if (rlen_got < 4) {
+          ssize_t r = ::recv(pfds[i].fd,
+                             reinterpret_cast<char*>(&rlen) + rlen_got,
+                             4 - rlen_got, 0);
+          if (r > 0) {
+            rlen_got += static_cast<size_t>(r);
+          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
+                                errno != EINTR)) {
+            ok = false;
+            break;
+          }
+        } else if (rhdr_got < hdr_n) {
+          ssize_t r = ::recv(pfds[i].fd, &rhdr[rhdr_got], hdr_n - rhdr_got,
+                             0);
+          if (r > 0) {
+            rhdr_got += static_cast<size_t>(r);
+          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
+                                errno != EINTR)) {
+            ok = false;
+            break;
+          }
+        }
+        if (!rframe_known && rlen_got == 4 && rhdr_got == hdr_n) {
+          if (rhdr != header) {
+            if (err) {
+              err->kind = ChunkExchangeError::kHeaderMismatch;
+              err->got_header = rhdr;
+            }
+            ok = false;
+            break;
+          }
+          rchunk_len = static_cast<int64_t>(rlen) -
+                       static_cast<int64_t>(hdr_n);
+          if (rchunk_len <= 0 || rchunk_len > recv_total - recv_done) {
+            if (err) {
+              err->kind = ChunkExchangeError::kBadLength;
+              err->bad_length = rchunk_len;
+            }
+            ok = false;
+            break;
+          }
+          rchunk_got = 0;
+          rframe_known = true;
+          if (!recv_dest &&
+              static_cast<int64_t>(scratch.size()) < rchunk_len) {
+            scratch.resize(static_cast<size_t>(rchunk_len));
+          }
+        }
+        if (rframe_known && rchunk_got < rchunk_len) {
+          char* dest = recv_dest ? recv_dest + recv_done + rchunk_got
+                                 : scratch.data() + rchunk_got;
+          ssize_t r = ::recv(pfds[i].fd, dest,
+                             static_cast<size_t>(rchunk_len - rchunk_got),
+                             0);
+          if (r > 0) {
+            rchunk_got += r;
+          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
+                                errno != EINTR)) {
+            ok = false;
+            break;
+          }
+        }
+        if (rframe_known && rchunk_got == rchunk_len) {
+          // Chunk complete: consume it now, overlapping the reduce with
+          // whatever the kernel keeps receiving into socket buffers.
+          if (on_chunk) {
+            on_chunk(recv_done,
+                     recv_dest ? recv_dest + recv_done : scratch.data(),
+                     rchunk_len);
+          }
+          recv_done += rchunk_len;
+          rlen_got = 0;
+          rhdr_got = 0;
+          rframe_known = false;
+        }
+      }
+    }
+  }
+  SetNonblocking(sfd, false);
+  if (rfd != sfd) SetNonblocking(rfd, false);
+  if (ok && err) err->kind = ChunkExchangeError::kNone;
+  return ok;
+}
+
 // ---- Listener -------------------------------------------------------------
 
 Listener::~Listener() { Close(); }
@@ -371,6 +599,7 @@ Socket Listener::Accept(double timeout_s) {
   if (cfd < 0) return Socket();
   int one = 1;
   ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  TuneDataSocketBuffers(cfd);
   return Socket(cfd);
 }
 

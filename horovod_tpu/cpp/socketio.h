@@ -131,6 +131,41 @@ bool DuplexExchange(Socket& send_sock, const std::string& out,
                     Socket& recv_sock, std::string* in,
                     const std::function<bool()>& cancelled);
 
+// Chunk-pipelined duplex segment exchange: streams `send_len` payload bytes
+// from `send_base` to `send_sock` as a sequence of length-prefixed chunk
+// frames (u32 length | `header` bytes | payload), while receiving the
+// peer's equally-framed stream of `recv_total` payload bytes from
+// `recv_sock`.  This is the Gloo-style segmented ring step: because the
+// payload is sent directly from the caller's buffer and received directly
+// into `recv_dest` (or handed chunk-by-chunk to `on_chunk` for in-flight
+// reduction), a ring hop costs zero full-segment copies and the reduce
+// overlaps the wire transfer instead of waiting for the whole segment.
+//
+// - Each incoming chunk's header must byte-equal `header` (both ends of a
+//   ring step carry the same [seq|tag]); on mismatch `err` carries the
+//   got-header.  Bad frame lengths and transport failures are reported as
+//   their own error kinds so desync messages name the real cause.
+// - `recv_dest`, when non-null, receives payload bytes at their cumulative
+//   offset (zero-copy).  Otherwise chunks land in an internal scratch and
+//   `on_chunk(offset, data, len)` is invoked as each completes, in order.
+// - The peer's chunk size is discovered per-frame, so the two ends may use
+//   different HOROVOD_RING_CHUNK_BYTES settings.
+// - The two sockets may be the same object (2-member ring).
+struct ChunkExchangeError {
+  enum Kind { kNone, kTransport, kHeaderMismatch, kBadLength };
+  Kind kind = kNone;
+  std::string got_header;  // kHeaderMismatch: the peer's header bytes
+  int64_t bad_length = 0;  // kBadLength: the offending payload length
+};
+
+bool ChunkedDuplexExchange(
+    Socket& send_sock, const char* send_base, int64_t send_len,
+    Socket& recv_sock, int64_t recv_total, int64_t chunk_bytes,
+    const std::string& header, char* recv_dest,
+    const std::function<void(int64_t off, const char* data, int64_t len)>&
+        on_chunk,
+    const std::function<bool()>& cancelled, ChunkExchangeError* err);
+
 // Listening socket; Accept returns connected Sockets.
 class Listener {
  public:

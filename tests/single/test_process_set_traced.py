@@ -71,6 +71,18 @@ def test_allgather_concats_member_shards_everywhere():
     np.testing.assert_allclose(out[:, 0], [10, 11, 30, 31])
 
 
+def test_allgather_preserves_bool_dtype():
+    # The psum-based lowering must round-trip bools (psum itself would
+    # return ints).
+    ps = ProcessSet(MEMBERS)
+    x = jnp.asarray(np.arange(8) % 2 == 0).reshape(8, 1)
+    out = _run(lambda t: hvd.allgather(t, process_set=ps, axis_name="hvd"),
+               x, out_specs=P(None))
+    assert out.dtype == np.bool_
+    np.testing.assert_array_equal(
+        out.ravel(), [True, False, True, False])
+
+
 def test_broadcast_root_is_global_rank():
     ps = ProcessSet(MEMBERS)
     x = _rankwise()

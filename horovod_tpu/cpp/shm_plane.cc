@@ -19,10 +19,15 @@ int64_t RoundUp(int64_t n) {
 }
 }  // namespace
 
-// The destructor unlinks when this process created the region, so error
-// paths (a failed establishment handshake) cannot leave a stale file in
-// /dev/shm.
-ShmRegion::~ShmRegion() { Close(creator_); }
+// The destructor unlinks unconditionally (not only for the creator): if
+// the creating rank is SIGKILLed mid-job, the next elastic generation
+// opens a differently-named region (new rendezvous port), so nobody would
+// ever unlink the orphan — the survivors' teardown must.  Unlinking a
+// name other members still have mapped is safe (POSIX keeps the mapping),
+// and a later same-named incarnation re-creates after its own
+// stale-unlink, so a racing unlink at worst downgrades that set to the
+// TCP ring via the AND-voted open verdict.
+ShmRegion::~ShmRegion() { Close(true); }
 
 Status ShmRegion::Open(const std::string& name, bool creator) {
   name_ = name;

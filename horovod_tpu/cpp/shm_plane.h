@@ -50,14 +50,15 @@ class ShmRegion {
   bool valid() const { return map_ != nullptr; }
 
   void Close(bool unlink);
-  bool creator() const { return creator_; }
 
  private:
   std::string name_;
   int fd_ = -1;
   void* map_ = nullptr;
   int64_t cap_ = 0;  // total mapped bytes (header + data)
-  bool creator_ = false;  // this process created (and must unlink) it
+  bool creator_ = false;  // this process ran the O_CREAT|O_EXCL open
+                          // (teardown unlinks on EVERY member — see the
+                          // destructor comment in shm_plane.cc)
 };
 
 }  // namespace hvdtpu

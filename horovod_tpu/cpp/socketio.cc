@@ -105,8 +105,12 @@ void TuneDataSocketBuffers(int fd) {
   static const int bufsz = [] {
     if (const char* env = ::getenv("HOROVOD_SOCKET_BUFFER_BYTES")) {
       char* end = nullptr;
-      long v = std::strtol(env, &end, 10);
-      if (end && *end == '\0' && v >= 0) return static_cast<int>(v);
+      long long v = std::strtoll(env, &end, 10);
+      if (end && *end == '\0' && v >= 0) {
+        // Clamp: setsockopt takes int, and the kernel caps at
+        // net.core.{w,r}mem_max anyway.
+        return static_cast<int>(std::min<long long>(v, 1 << 30));
+      }
     }
     return 0;
   }();

@@ -26,7 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
-from .util import (local_hostnames, make_secret, signed_dumps,
+from .util import (local_hostnames, make_secret, signed_dumps, ssh_command,
                    verified_loads)
 
 
@@ -205,10 +205,7 @@ def _probe_command(host: str, driver_addrs: Sequence[str], port: int,
     ]
     if host in local_hostnames():
         return inner
-    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
-               "-o", "ConnectTimeout=10"]
-    if ssh_port:
-        ssh_cmd += ["-p", str(ssh_port)]
+    ssh_cmd = ssh_command(ssh_port=ssh_port, connect_timeout=10)
     env = f"HOROVOD_PROBE_SECRET={shlex.quote(secret)}"
     pypath = os.environ.get("PYTHONPATH", "")
     if pypath:

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 
 from .util import (forwardable_env, pin_tpu_chip,
                    find_free_port, local_hostnames, make_secret,
+                   ssh_command,
                    signed_dumps, verified_loads)
 
 # Defaults; overridable per job via HOROVOD_ELASTIC_* (reference analog:
@@ -147,7 +148,8 @@ class ElasticDriver:
     def __init__(self, discovery: HostDiscovery, command: List[str],
                  min_np: int, max_np: Optional[int],
                  base_env: Optional[Dict[str, str]] = None,
-                 start_timeout: float = 120.0, verbose: bool = False):
+                 start_timeout: float = 120.0, verbose: bool = False,
+                 ssh_port: Optional[int] = None):
         self.discovery = discovery
         self.command = command
         self.min_np = min_np
@@ -155,6 +157,7 @@ class ElasticDriver:
         self.base_env = dict(base_env or os.environ)
         self.start_timeout = start_timeout
         self.verbose = verbose
+        self.ssh_port = ssh_port
 
         self._lock = threading.Lock()
         self._workers: Dict[str, _Worker] = {}      # worker_id -> worker
@@ -262,7 +265,7 @@ class ElasticDriver:
                       f"cd {shlex.quote(os.getcwd())} && env {env_str} " +
                       " ".join(shlex.quote(c) for c in self.command))
             proc = subprocess.Popen(
-                ["ssh", "-o", "StrictHostKeyChecking=no", host, remote],
+                ssh_command(ssh_port=self.ssh_port) + [host, remote],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True)
             try:
@@ -540,5 +543,5 @@ def run_elastic(args, command: List[str]) -> int:
     base_env.update(_tuning_env(args))
     driver = ElasticDriver(discovery, command, min_np, max_np, base_env,
                            start_timeout=args.start_timeout,
-                           verbose=args.verbose)
+                           verbose=args.verbose, ssh_port=args.ssh_port)
     return driver.run()

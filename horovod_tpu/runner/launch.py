@@ -24,7 +24,8 @@ import threading
 from typing import Dict, List, Optional
 
 from .util import (assign_ranks, find_free_port, forwardable_env,
-                   local_hostnames, parse_hosts, pin_tpu_chip)
+                   local_hostnames, parse_hosts, pin_tpu_chip,
+                   ssh_command)
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -215,9 +216,7 @@ class WorkerProcesses:
                 env_str = " ".join(
                     f"{k}={shlex.quote(v)}" for k, v in env.items()
                     if forwardable_env(k))
-                ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
-                if ssh_port:
-                    ssh_cmd += ["-p", str(ssh_port)]
+                ssh_cmd = ssh_command(ssh_port=ssh_port)
                 remote = f"cd {shlex.quote(os.getcwd())} && env {env_str} " + \
                     " ".join(shlex.quote(c) for c in command)
                 proc = subprocess.Popen(

@@ -3,6 +3,8 @@
 from __future__ import annotations
 
 import dataclasses
+import os
+import shlex
 import socket
 from typing import List
 
@@ -143,6 +145,28 @@ FORWARD_ENV_NAMES = ("TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_PROCESS_BOUNDS")
 
 def forwardable_env(k: str) -> bool:
     return k.startswith(FORWARD_ENV_PREFIXES) or k in FORWARD_ENV_NAMES
+
+
+def ssh_command(ssh_port=None, connect_timeout=None) -> List[str]:
+    """Base argv used to exec on a remote host (invoked as
+    ``ssh_command() + [host, remote_shell_string]``).
+
+    ``HOROVOD_SSH_COMMAND`` replaces the ENTIRE base argv (shlex-split,
+    used verbatim — no extra options are appended, including -p), which
+    enables agent-less transports and lets integration tests exercise the
+    real remote-spawn path without an sshd (a fake-ssh script that runs
+    the command locally).  Default: ssh with host-key checking off, the
+    reference's gloo_run ssh contract (SURVEY.md §2.5).
+    """
+    override = os.environ.get("HOROVOD_SSH_COMMAND")
+    if override:
+        return shlex.split(override)
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if connect_timeout:
+        cmd += ["-o", f"ConnectTimeout={int(connect_timeout)}"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    return cmd
 
 
 def pin_tpu_chip(env: dict, local_rank: int, local_size: int,

@@ -206,11 +206,14 @@ def _probe_command(host: str, driver_addrs: Sequence[str], port: int,
     if host in local_hostnames():
         return inner
     ssh_cmd = ssh_command(ssh_port=ssh_port, connect_timeout=10)
-    env = f"HOROVOD_PROBE_SECRET={shlex.quote(secret)}"
+    # The probe secret must NOT ride the ssh argv (visible in `ps` on both
+    # ends); it ships over ssh stdin, same as the elastic spawn path.
+    env = ""
     pypath = os.environ.get("PYTHONPATH", "")
     if pypath:
-        env += f" PYTHONPATH={shlex.quote(pypath)}"
-    remote = (f"cd {shlex.quote(os.getcwd())} && env {env} "
+        env = f"PYTHONPATH={shlex.quote(pypath)} "
+    remote = ("read -r HOROVOD_PROBE_SECRET; export HOROVOD_PROBE_SECRET; "
+              f"cd {shlex.quote(os.getcwd())} && env {env}"
               + " ".join(shlex.quote(c) for c in inner))
     return ssh_cmd + [host, remote]
 
@@ -238,13 +241,23 @@ def preflight_probe(hosts: Sequence[object], ssh_port: Optional[int] = None,
                                  ssh_port)
             env = dict(os.environ)
             env["HOROVOD_PROBE_SECRET"] = secret
+            remote_probe = hostname not in local_hostnames()
             if exec_fn is not None:
                 procs.append(exec_fn(cmd, env))
             else:
                 proc = subprocess.Popen(
                     cmd, env=env, stdout=subprocess.DEVNULL,
-                    stderr=subprocess.PIPE, text=True)
+                    stderr=subprocess.PIPE, text=True,
+                    stdin=subprocess.PIPE if remote_probe else None)
                 procs.append(proc)
+                if remote_probe:
+                    # Matching `read -r HOROVOD_PROBE_SECRET` in the
+                    # remote command.
+                    try:
+                        proc.stdin.write(secret + "\n")
+                        proc.stdin.flush()
+                    except OSError:
+                        pass
                 # Drain stderr continuously: ssh banners/errors must neither
                 # fill the pipe (blocking the probe) nor vanish — they are
                 # the diagnosis when a host fails.

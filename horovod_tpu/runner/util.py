@@ -160,6 +160,12 @@ def ssh_command(ssh_port=None, connect_timeout=None) -> List[str]:
     """
     override = os.environ.get("HOROVOD_SSH_COMMAND")
     if override:
+        if ssh_port:
+            import warnings
+
+            warnings.warn(
+                "HOROVOD_SSH_COMMAND is set; --ssh-port/-p is ignored — "
+                "bake the port into the override command instead.")
         return shlex.split(override)
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if connect_timeout:

@@ -1,0 +1,102 @@
+"""Randomized differential soak for the chunk-pipelined TCP ring.
+
+The pipelined ring (ChunkedDuplexExchange; VERDICT r3 #5) is a new wire
+format on the hot data-plane path.  This soak drives it through the FULL
+public eager API with randomized shapes (including odd element counts that
+exercise remainder segments and sub-chunk tails), dtypes, ops, and a
+process-set subset, and checks every result against a numpy ground truth
+AND against the legacy whole-segment protocol (HOROVOD_RING_CHUNK_BYTES=0)
+computing the same schedule.  A tiny chunk size forces many chunks per
+segment; shm is disabled so everything rides TCP.
+"""
+
+import numpy as np
+
+from horovod_tpu.runner import run
+
+_SEED = 0xC0FFEE
+
+
+def _soak_worker():
+    import os
+
+    import numpy as np
+    import horovod_tpu as hvd
+
+    # Mixed-chunk interop mode: rank 1 runs a much larger chunk size than
+    # the others (must be set before init — the native core reads it once).
+    if (os.environ.get("TEST_MIXED_CHUNKS") == "1"
+            and os.environ.get("HOROVOD_RANK") == "1"):
+        os.environ["HOROVOD_RING_CHUNK_BYTES"] = "1048576"
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    rng = np.random.RandomState(_SEED)  # same schedule on every rank
+    checks = 0
+    for i in range(14):
+        dtype = rng.choice([np.float32, np.float64, np.int32, np.float16])
+        # Odd sizes: remainder ring segments + final sub-chunk tails.
+        n = int(rng.randint(1, 200_000))
+        op = rng.choice([0, 1, 2, 3])
+        # Deterministic per-rank values a closed form can verify.
+        base = np.arange(n) % 97
+        vals = [(base + rr + 1).astype(dtype) for rr in range(s)]
+        x = vals[r].copy()
+        name = f"soak.{i}"
+        if op == 0:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=name))
+            expect = sum(v.astype(np.float64) for v in vals)
+            np.testing.assert_allclose(out.astype(np.float64), expect,
+                                       rtol=1e-2 if dtype == np.float16
+                                       else 1e-6)
+        elif op == 1:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Max, name=name))
+            np.testing.assert_allclose(out, np.maximum.reduce(vals))
+        elif op == 2:
+            out = np.asarray(hvd.allgather(x, name=name))
+            np.testing.assert_allclose(out, np.concatenate(vals))
+        else:
+            root = int(rng.randint(0, s))
+            out = np.asarray(hvd.broadcast(x, root_rank=root, name=name))
+            np.testing.assert_allclose(out, vals[root])
+        checks += 1
+    # Subset collectives ride a dedicated channel over the same wire.
+    ps = hvd.add_process_set([0, s - 1])
+    if r in (0, s - 1):
+        x = np.full(12_345, float(r + 1), np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps,
+                                       name="soak.ps"))
+        np.testing.assert_allclose(out, float(1 + s))
+        checks += 1
+    hvd.barrier()
+    hvd.shutdown()
+    return checks
+
+
+def _totals(env):
+    base = {"HOROVOD_SHM_DISABLE": "1"}
+    base.update(env)
+    return run(_soak_worker, np=3, env=base)
+
+
+def test_pipelined_ring_soak_matches_ground_truth():
+    # 4 KiB chunks: a 200k-element f64 buffer crosses ~130 chunk frames
+    # per ring hop.
+    res = _totals({"HOROVOD_RING_CHUNK_BYTES": "4096"})
+    assert res == [15, 14, 15]
+
+
+def test_pipelined_and_legacy_rings_agree():
+    # Same seeded schedule through both wire formats; every assertion
+    # inside the worker is against closed-form numpy, so agreement means
+    # both protocols are exactly correct, not merely consistent.
+    piped = _totals({})                                # default 512 KiB
+    legacy = _totals({"HOROVOD_RING_CHUNK_BYTES": "0"})
+    assert piped == legacy == [15, 14, 15]
+
+
+def test_mixed_chunk_sizes_interoperate():
+    # The chunk size is per-process (discovered per-frame on the wire);
+    # rank 1 deliberately disagrees with the others.
+    res = _totals({"HOROVOD_RING_CHUNK_BYTES": "8192",
+                   "TEST_MIXED_CHUNKS": "1"})
+    assert res == [15, 14, 15]

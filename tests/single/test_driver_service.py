@@ -61,7 +61,10 @@ def test_probe_command_local_vs_ssh():
     assert "-p" in cmd_remote and "2222" in cmd_remote
     assert "nodeB" in cmd_remote
     joined = " ".join(cmd_remote)
-    assert "HOROVOD_PROBE_SECRET=s3cret" in joined
+    # The secret must NOT ride the ssh argv (`ps`-visible on both ends);
+    # it ships over stdin into the remote `read -r`.
+    assert "s3cret" not in joined
+    assert "read -r HOROVOD_PROBE_SECRET" in joined
     assert "--driver-addrs 10.0.0.1,10.0.0.2" in joined
 
 

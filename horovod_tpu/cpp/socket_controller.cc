@@ -27,6 +27,7 @@ constexpr int32_t kTagReduceScatter = 0x1000;
 constexpr int32_t kTagAllgatherPhase = 0x2000;
 constexpr int32_t kTagAllgather = 0x4000;
 constexpr int32_t kTagBroadcast = 0x5000;
+constexpr int32_t kTagBroadcastChain = 0x5800;
 constexpr int32_t kTagAlltoall = 0x6000;
 constexpr int32_t kTagBarrier = 0x7000;
 // Shared-memory plane phase fences (shm_plane.h): size exchange, write
@@ -38,6 +39,14 @@ constexpr int32_t kTagShmRead = 0xB000;
 constexpr int32_t kTagShmGrow = 0xC000;
 constexpr int32_t kTagShmOpen = 0xD000;
 constexpr int32_t kTagShmVerdict = 0xE000;
+
+// Broadcasts at least this large take the pipelined chain instead of the
+// binomial tree.  A protocol constant: the algorithm choice must agree on
+// every rank, so only nbytes/m and the pipelining-enabled switch may gate
+// it — per-rank CHUNK SIZES may differ (the chain is a raw byte stream),
+// but HOROVOD_RING_CHUNK_BYTES=0 (pipelining off) selects different wire
+// protocols and must be uniform across ranks, as documented in socketio.h.
+constexpr int64_t kBroadcastChainBytes = 1 << 20;
 
 }  // namespace
 
@@ -1052,6 +1061,74 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
     return ShmBroadcast(*shm, socks, members, idx, root_idx, buf, nbytes);
   }
   const int vrank = (idx - root_idx + m) % m;
+
+  // Large payloads: pipelined chain in vrank order.  Every member sends
+  // nbytes exactly once and chunks stream hop to hop through kernel
+  // socket buffers, so all hops overlap and wall time approaches one
+  // N/B transfer — the binomial tree costs the root N*log2(m) egress
+  // and serializes tree levels per whole buffer.  Payloads this large
+  // are the broadcast_parameters case this path exists for; small
+  // payloads keep the tree's fewer hop latencies.
+  if (ring_chunk_bytes_ > 0 && m > 2 && nbytes >= kBroadcastChainBytes) {
+    char* base = static_cast<char*>(buf);
+    const int src =
+        vrank > 0 ? members[(root_idx + vrank - 1) % m] : -1;
+    Socket* next_sock =
+        vrank + 1 < m ? &socks[members[(root_idx + vrank + 1) % m]] : nullptr;
+    // Geometry header: [seq|tag|nbytes] hops ahead of the raw chunk
+    // stream so a size mismatch aborts before any payload bytes land.
+    if (src >= 0) {
+      std::string frame;
+      if (!socks[src].RecvFrame(&frame)) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast chain recv from rank " +
+                                 std::to_string(src) + " failed");
+      }
+      Reader rd(frame);
+      st = CheckFrameHeader(&rd, kTagBroadcastChain, "broadcast chain");
+      if (!st.ok()) {
+        // Our upstream is mid-SendAll of the raw stream with no abort
+        // polling; closing the socket fails it fast instead of letting it
+        // block on full kernel buffers until process teardown.
+        socks[src].Close();
+        return st;
+      }
+      int64_t peer_bytes = rd.GetI64();
+      if (!rd.ok() || peer_bytes != nbytes) {
+        aborted_ = true;
+        socks[src].Close();
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast size mismatch across ranks");
+      }
+    }
+    if (next_sock) {
+      Writer w;
+      PutFrameHeader(&w, current_seq_, kTagBroadcastChain);
+      w.PutI64(nbytes);
+      if (!next_sock->SendFrame(w.data())) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast chain header send failed");
+      }
+    }
+    for (int64_t off = 0; off < nbytes; off += ring_chunk_bytes_) {
+      const int64_t n = std::min<int64_t>(ring_chunk_bytes_, nbytes - off);
+      if (src >= 0 && !socks[src].RecvAll(base + off, n)) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast chain recv from rank " +
+                                 std::to_string(src) + " failed");
+      }
+      if (next_sock && !next_sock->SendAll(base + off, n)) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "broadcast chain send failed");
+      }
+    }
+    return Status::OK();
+  }
+
   // Binomial tree: log2(m) rounds; parent sends after it has the payload.
   int mask = 1;
   while (mask < m) {

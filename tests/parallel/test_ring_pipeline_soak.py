@@ -59,6 +59,18 @@ def _soak_worker():
             out = np.asarray(hvd.broadcast(x, root_rank=root, name=name))
             np.testing.assert_allclose(out, vals[root])
         checks += 1
+    # Deterministic pipelined-chain broadcast coverage: 6 MB crosses the
+    # 1 MiB chain threshold, the odd element count hits the remainder
+    # chunk, the non-uniform payload + full-array compare catches any
+    # offset bug, and root=1 exercises a mid-ring root.
+    n = 1_500_001
+    chain_vals = [(np.arange(n) % 251 + rr).astype(np.float32)
+                  for rr in range(s)]
+    out = np.asarray(hvd.broadcast(chain_vals[r].copy(), root_rank=1,
+                                   name="soak.chain.bcast"))
+    np.testing.assert_array_equal(out, chain_vals[1])
+    checks += 1
+
     # Subset collectives ride a dedicated channel over the same wire.
     ps = hvd.add_process_set([0, s - 1])
     if r in (0, s - 1):
@@ -82,7 +94,7 @@ def test_pipelined_ring_soak_matches_ground_truth():
     # 4 KiB chunks: a 200k-element f64 buffer crosses ~130 chunk frames
     # per ring hop.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "4096"})
-    assert res == [15, 14, 15]
+    assert res == [16, 15, 16]
 
 
 def test_pipelined_and_legacy_rings_agree():
@@ -91,7 +103,7 @@ def test_pipelined_and_legacy_rings_agree():
     # both protocols are exactly correct, not merely consistent.
     piped = _totals({})                                # default 512 KiB
     legacy = _totals({"HOROVOD_RING_CHUNK_BYTES": "0"})
-    assert piped == legacy == [15, 14, 15]
+    assert piped == legacy == [16, 15, 16]
 
 
 def test_mixed_chunk_sizes_interoperate():
@@ -99,4 +111,4 @@ def test_mixed_chunk_sizes_interoperate():
     # rank 1 deliberately disagrees with the others.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "8192",
                    "TEST_MIXED_CHUNKS": "1"})
-    assert res == [15, 14, 15]
+    assert res == [16, 15, 16]

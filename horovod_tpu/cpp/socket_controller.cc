@@ -1122,6 +1122,10 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       }
       if (next_sock && !next_sock->SendAll(base + off, n)) {
         aborted_ = true;
+        // Same fail-fast rule as the header paths: our upstream has no
+        // abort polling inside SendAll, so cut its stream rather than
+        // letting it block on full kernel buffers.
+        if (src >= 0) socks[src].Close();
         return Status::Error(StatusCode::ABORTED,
                              "broadcast chain send failed");
       }

@@ -26,6 +26,7 @@ constexpr int32_t kProtocolVersion = 3;         // v3: psid in mesh HELLOs
 constexpr int32_t kTagReduceScatter = 0x1000;
 constexpr int32_t kTagAllgatherPhase = 0x2000;
 constexpr int32_t kTagAllgather = 0x4000;
+constexpr int32_t kTagAllgatherSize = 0x4800;
 constexpr int32_t kTagBroadcast = 0x5000;
 constexpr int32_t kTagBroadcastChain = 0x5800;
 constexpr int32_t kTagAlltoall = 0x6000;
@@ -1013,8 +1014,59 @@ Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,
   }
   const int next = members[(idx + 1) % m];
   const int prev = members[(idx - 1 + m) % m];
-  // Ring allgather with per-rank sizes carried in-band: step s passes block
-  // (idx - s) along the ring; after m-1 steps everyone holds all blocks.
+
+  if (ring_chunk_bytes_ > 0) {
+    // Pipelined path: a cheap size ring first (8-byte frames on the same
+    // schedule), then m-1 chunk-pipelined hops whose payloads stream
+    // straight between the output concatenation's block slots — zero
+    // block copies, reduce-free cousin of the pipelined ring allreduce.
+    //
+    // Tradeoff: the up-front size ring adds m-1 tiny serialized steps vs
+    // the legacy in-band path.  The ragged zero-copy layout needs every
+    // size before the output can be allocated, a payload-size switch
+    // would desync (nbytes legally differs per rank), and for small
+    // allgathers the negotiation round trip dominates those 8-byte hops
+    // anyway; large ones win back block-sized copies per hop.
+    std::vector<int64_t> sizes(m, 0);
+    sizes[idx] = nbytes;
+    for (int s2 = 0; s2 < m - 1; ++s2) {
+      const int send_b = ((idx - s2) % m + m) % m;
+      const int recv_b = ((idx - s2 - 1) % m + m) % m;
+      Writer w;
+      PutFrameHeader(&w, current_seq_, kTagAllgatherSize + s2);
+      w.PutI64(sizes[send_b]);
+      std::string in_frame;
+      st = ExchangeStep(socks, next, w.data(), prev, &in_frame);
+      if (!st.ok()) return st;
+      Reader rd(in_frame);
+      st = CheckFrameHeader(&rd, kTagAllgatherSize + s2, "allgather sizes");
+      if (!st.ok()) return st;
+      sizes[recv_b] = rd.GetI64();
+      if (!rd.ok() || sizes[recv_b] < 0) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "allgather size ring desync");
+      }
+    }
+    std::vector<int64_t> offs(m + 1, 0);
+    for (int b = 0; b < m; ++b) offs[b + 1] = offs[b] + sizes[b];
+    out->resize(static_cast<size_t>(offs[m]));
+    char* base = out->empty() ? nullptr : &(*out)[0];
+    if (nbytes > 0) std::memcpy(base + offs[idx], in, nbytes);
+    for (int s2 = 0; s2 < m - 1; ++s2) {
+      const int send_b = ((idx - s2) % m + m) % m;
+      const int recv_b = ((idx - s2 - 1) % m + m) % m;
+      st = ChunkedStep(socks, next, base + offs[send_b], sizes[send_b],
+                       prev, sizes[recv_b], base + offs[recv_b],
+                       kTagAllgather + s2, ring_chunk_bytes_, nullptr);
+      if (!st.ok()) return st;
+    }
+    per_rank->assign(sizes.begin(), sizes.end());
+    return Status::OK();
+  }
+
+  // Legacy whole-block path (HOROVOD_RING_CHUNK_BYTES=0): per-rank sizes
+  // carried in-band; step s passes block (idx - s) along the ring.
   std::vector<std::string> blocks(m);
   blocks[idx].assign(static_cast<const char*>(in), nbytes);
   for (int s = 0; s < m - 1; ++s) {

@@ -71,6 +71,17 @@ def _soak_worker():
     np.testing.assert_array_equal(out, chain_vals[1])
     checks += 1
 
+    # Ragged allgather across the pipelined path: per-rank sizes differ,
+    # so the size ring must agree before any payload moves.
+    g = np.asarray(hvd.allgather(
+        np.full((r + 1, 3), float(r), np.float32), name="soak.ragged.ag"))
+    assert g.shape == (sum(range(1, s + 1)), 3)
+    row = 0
+    for rr in range(s):
+        np.testing.assert_allclose(g[row:row + rr + 1], float(rr))
+        row += rr + 1
+    checks += 1
+
     # Subset collectives ride a dedicated channel over the same wire.
     ps = hvd.add_process_set([0, s - 1])
     if r in (0, s - 1):
@@ -94,7 +105,7 @@ def test_pipelined_ring_soak_matches_ground_truth():
     # 4 KiB chunks: a 200k-element f64 buffer crosses ~130 chunk frames
     # per ring hop.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "4096"})
-    assert res == [16, 15, 16]
+    assert res == [17, 16, 17]
 
 
 def test_pipelined_and_legacy_rings_agree():
@@ -103,7 +114,7 @@ def test_pipelined_and_legacy_rings_agree():
     # both protocols are exactly correct, not merely consistent.
     piped = _totals({})                                # default 512 KiB
     legacy = _totals({"HOROVOD_RING_CHUNK_BYTES": "0"})
-    assert piped == legacy == [16, 15, 16]
+    assert piped == legacy == [17, 16, 17]
 
 
 def test_mixed_chunk_sizes_interoperate():
@@ -111,4 +122,4 @@ def test_mixed_chunk_sizes_interoperate():
     # rank 1 deliberately disagrees with the others.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "8192",
                    "TEST_MIXED_CHUNKS": "1"})
-    assert res == [16, 15, 16]
+    assert res == [17, 16, 17]

@@ -123,6 +123,52 @@ def test_chain_broadcast_beats_binomial_tree():
         f"chain={chain_ms:.0f}ms")
 
 
+def _allgather_worker():
+    import os
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    ctx = HorovodContext.instance()
+    n = (8 << 20) // 4
+    x = np.full(n, float(r), np.float32)  # 8 MiB/rank
+    hvd.barrier()
+    ctx.core.allgather_buffer(x, 0)
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        out, counts = ctx.core.allgather_buffer(x, 0)
+    dt = (time.perf_counter() - t0) / iters
+    assert list(counts) == [n] * hvd.size()  # elements/rank
+    # The timing loop doubles as the at-size correctness check: each
+    # rank's slot must hold that rank's fill value at both block edges.
+    out = np.asarray(out).reshape(hvd.size(), n)
+    for rr in range(hvd.size()):
+        assert out[rr, 0] == float(rr) and out[rr, -1] == float(rr), out
+    hvd.barrier()
+    hvd.shutdown()
+    return {"rank": r, "ms": dt * 1e3,
+            "shm_disabled": os.environ.get("HOROVOD_SHM_DISABLE") == "1"}
+
+
+def test_pipelined_allgather_beats_whole_block_ring():
+    # Pipelined allgather (size ring + chunked hops straight into the
+    # output concat) vs legacy whole-block string frames.  Measured
+    # ~1.55-1.75x at 8 MiB/rank np=4; 1.2x margin for noise.
+    legacy_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1",
+                                 "HOROVOD_RING_CHUNK_BYTES": "0"},
+                         worker=_allgather_worker)
+    piped_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1"},
+                        worker=_allgather_worker)
+    assert legacy_ms > 1.2 * piped_ms, (
+        f"pipelined allgather not faster: legacy={legacy_ms:.0f}ms "
+        f"pipelined={piped_ms:.0f}ms")
+
+
 def _shm_correctness_worker():
     import numpy as np
     import horovod_tpu as hvd

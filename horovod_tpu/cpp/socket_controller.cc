@@ -30,6 +30,7 @@ constexpr int32_t kTagAllgatherSize = 0x4800;
 constexpr int32_t kTagBroadcast = 0x5000;
 constexpr int32_t kTagBroadcastChain = 0x5800;
 constexpr int32_t kTagAlltoall = 0x6000;
+constexpr int32_t kTagAlltoallSize = 0x6800;
 constexpr int32_t kTagBarrier = 0x7000;
 // Shared-memory plane phase fences (shm_plane.h): size exchange, write
 // done, segments reduced, read done, region grow, open verdict.
@@ -1254,6 +1255,58 @@ Status SocketController::AlltoallBuffer(const void* in,
   const char* base = static_cast<const char*>(in);
   std::vector<int64_t> offs(m + 1, 0);
   for (int j = 0; j < m; ++j) offs[j + 1] = offs[j] + splits[j];
+
+  if (ring_chunk_bytes_ > 0) {
+    // Pipelined path (same shape as the pipelined allgather): a pairwise
+    // row-count exchange first — the ragged output layout needs every
+    // count before it can be allocated — then chunk-pipelined pairwise
+    // hops that stream each peer's rows straight into the output
+    // concatenation's slot, with zero block copies.
+    std::vector<int64_t> rows_from(m, 0);
+    rows_from[idx] = splits[idx];
+    for (int d = 1; d < m; ++d) {
+      const int to_i = (idx + d) % m;
+      const int from_i = (idx - d + m) % m;
+      Writer w;
+      PutFrameHeader(&w, current_seq_, kTagAlltoallSize + d);
+      w.PutI64(splits[to_i]);
+      std::string frame;
+      st = ExchangeStep(socks, members[to_i], w.data(), members[from_i],
+                        &frame);
+      if (!st.ok()) return st;
+      Reader rd(frame);
+      st = CheckFrameHeader(&rd, kTagAlltoallSize + d, "alltoall sizes");
+      if (!st.ok()) return st;
+      rows_from[from_i] = rd.GetI64();
+      if (!rd.ok() || rows_from[from_i] < 0) {
+        aborted_ = true;
+        return Status::Error(StatusCode::ABORTED,
+                             "alltoall size exchange desync");
+      }
+    }
+    std::vector<int64_t> roffs(m + 1, 0);
+    for (int j = 0; j < m; ++j) roffs[j + 1] = roffs[j] + rows_from[j];
+    out->resize(static_cast<size_t>(roffs[m] * row_bytes));
+    char* obase = out->empty() ? nullptr : &(*out)[0];
+    if (splits[idx] > 0) {
+      std::memcpy(obase + roffs[idx] * row_bytes,
+                  base + offs[idx] * row_bytes, splits[idx] * row_bytes);
+    }
+    for (int d = 1; d < m; ++d) {
+      const int to_i = (idx + d) % m;
+      const int from_i = (idx - d + m) % m;
+      st = ChunkedStep(socks, members[to_i], base + offs[to_i] * row_bytes,
+                       splits[to_i] * row_bytes, members[from_i],
+                       rows_from[from_i] * row_bytes,
+                       obase + roffs[from_i] * row_bytes, kTagAlltoall + d,
+                       ring_chunk_bytes_, nullptr);
+      if (!st.ok()) return st;
+    }
+    recv_splits->assign(rows_from.begin(), rows_from.end());
+    return Status::OK();
+  }
+
+  // Legacy whole-block path (HOROVOD_RING_CHUNK_BYTES=0).
   std::vector<std::string> recv_bufs(m);
   std::vector<int64_t> rows_from(m, 0);
   recv_bufs[idx].assign(base + offs[idx] * row_bytes,

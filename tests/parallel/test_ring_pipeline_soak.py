@@ -82,6 +82,27 @@ def _soak_worker():
         row += rr + 1
     checks += 1
 
+    # Uneven alltoall on the TCP path: ragged splits exchange geometry
+    # before any payload moves; contents checked against closed form.
+    # Zero splits (incl. zero-to-self on every rank) cover the degenerate
+    # empty-hop case, and 4 KiB rows with a small chunk size make the
+    # larger hops span multiple chunk frames.
+    M = [[0, 3, 1], [2, 0, 2], [1, 2, 0]]  # M[q][j]: rows q sends to j
+    if s == 3:
+        W = 1024  # floats per row = 4 KiB
+        datas = [(np.arange(sum(M[q]) * W, dtype=np.float32)
+                  .reshape(-1, W) + 10_000 * q) for q in range(s)]
+        out2, rsplits = hvd.alltoall(datas[r], splits=M[r],
+                                     name="soak.a2a")
+        expect_rows = []
+        for q in range(s):
+            off = sum(M[q][:r])
+            expect_rows.append(datas[q][off:off + M[q][r]])
+        np.testing.assert_array_equal(np.asarray(out2),
+                                      np.concatenate(expect_rows))
+        assert list(np.asarray(rsplits)) == [M[q][r] for q in range(s)]
+        checks += 1
+
     # Subset collectives ride a dedicated channel over the same wire.
     ps = hvd.add_process_set([0, s - 1])
     if r in (0, s - 1):
@@ -105,7 +126,7 @@ def test_pipelined_ring_soak_matches_ground_truth():
     # 4 KiB chunks: a 200k-element f64 buffer crosses ~130 chunk frames
     # per ring hop.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "4096"})
-    assert res == [17, 16, 17]
+    assert res == [18, 17, 18]
 
 
 def test_pipelined_and_legacy_rings_agree():
@@ -114,7 +135,7 @@ def test_pipelined_and_legacy_rings_agree():
     # both protocols are exactly correct, not merely consistent.
     piped = _totals({})                                # default 512 KiB
     legacy = _totals({"HOROVOD_RING_CHUNK_BYTES": "0"})
-    assert piped == legacy == [17, 16, 17]
+    assert piped == legacy == [18, 17, 18]
 
 
 def test_mixed_chunk_sizes_interoperate():
@@ -122,4 +143,4 @@ def test_mixed_chunk_sizes_interoperate():
     # rank 1 deliberately disagrees with the others.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "8192",
                    "TEST_MIXED_CHUNKS": "1"})
-    assert res == [17, 16, 17]
+    assert res == [18, 17, 18]

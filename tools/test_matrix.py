@@ -11,7 +11,9 @@ combination of:
 - np:      1, 2, 3
 - fusion:  default threshold / disabled (HOROVOD_FUSION_THRESHOLD=0)
 - cache:   default capacity / disabled (HOROVOD_CACHE_CAPACITY=0)
-- plane:   shared-memory / TCP ring (HOROVOD_SHM_DISABLE=1), np>1 only
+- plane:   shared-memory / pipelined TCP ring (HOROVOD_SHM_DISABLE=1) /
+           legacy whole-segment TCP ring (+HOROVOD_RING_CHUNK_BYTES=0),
+           np>1 only
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -107,11 +109,12 @@ def combos(quick: bool):
     nps = [1, 2, 3]
     fusion = ["on", "off"]
     cache = ["on", "off"]
-    planes = ["shm", "tcp"]
+    planes = ["shm", "tcp", "tcp0"]
     if quick:
         # One covering set instead of the full product.
         yield ("native", 3, "on", "on", "shm")
         yield ("native", 2, "off", "off", "tcp")
+        yield ("native", 3, "on", "off", "tcp0")
         yield ("native", 1, "on", "off", "shm")
         yield ("purepy", 1, "off", "on", "shm")
         return
@@ -119,7 +122,7 @@ def combos(quick: bool):
                                                 planes):
         if core == "purepy" and np_ > 1:
             continue  # pure-python core is single-process by contract
-        if np_ == 1 and p == "tcp":
+        if np_ == 1 and p != "shm":
             continue  # no data plane at np=1; plane axis is meaningless
         yield (core, np_, f, c, p)
 
@@ -128,6 +131,9 @@ def run_combo(core: str, np_: int, fusion: str, cache: str, plane: str,
               script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # The plane axis must own this knob: an ambient setting would
+    # silently collapse the pipelined-vs-legacy distinction.
+    env.pop("HOROVOD_RING_CHUNK_BYTES", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -136,8 +142,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str, plane: str,
         env["HOROVOD_FUSION_THRESHOLD"] = "0"
     if cache == "off":
         env["HOROVOD_CACHE_CAPACITY"] = "0"
-    if plane == "tcp":
+    if plane in ("tcp", "tcp0"):
         env["HOROVOD_SHM_DISABLE"] = "1"
+    if plane == "tcp0":
+        env["HOROVOD_RING_CHUNK_BYTES"] = "0"  # legacy whole-segment frames
     if np_ == 1:
         cmd = [sys.executable, script]
     else:

@@ -1,0 +1,190 @@
+// Fuzz harness for ChunkedDuplexExchange, the chunk-pipelined duplex
+// primitive under the ring/chain data plane (socketio.cc).
+//
+// Two threads on a socketpair run randomized-geometry exchanges — payload
+// lengths from 0 to several MiB (remainder chunks, empty streams), chunk
+// sizes differing per side (mixed HOROVOD_RING_CHUNK_BYTES interop), both
+// recv modes (direct-dest and scratch + on_chunk) — and every received
+// byte is verified against the sender's pattern.  Error paths are driven
+// explicitly: header mismatch, and cancellation mid-stream (no hang).
+//
+// Reference analog (SURVEY.md §5, sanitizers/selftests): mechanical
+// validation of the wire primitive apart from the full controller.
+
+#include <sys/socket.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "socketio.h"
+
+namespace hvdtpu {
+int GetLogLevel() { return 4; }  // errors only
+void SetLogLevel(int) {}
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+namespace {
+
+std::atomic<int> failures{0};
+
+void Fail(const char* what, int round) {
+  std::fprintf(stderr, "FAIL round %d: %s\n", round, what);
+  failures.fetch_add(1);
+}
+
+// Deterministic per-(seed, offset) byte pattern both sides can compute.
+char PatternByte(unsigned seed, int64_t off) {
+  return static_cast<char>((seed * 131 + off * 7 + (off >> 9)) & 0xFF);
+}
+
+std::vector<char> MakePattern(unsigned seed, int64_t n) {
+  std::vector<char> v(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) v[static_cast<size_t>(i)] =
+      PatternByte(seed, i);
+  return v;
+}
+
+bool CheckPattern(const char* data, unsigned seed, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (data[i] != PatternByte(seed, i)) return false;
+  }
+  return true;
+}
+
+struct SidePlan {
+  int64_t send_len;
+  int64_t chunk;
+  bool direct_dest;  // receive straight into the buffer vs on_chunk scratch
+};
+
+void RunSide(Socket* sock, unsigned my_seed, unsigned peer_seed,
+             const SidePlan& mine, const SidePlan& theirs,
+             const std::string& header, int round) {
+  std::vector<char> out = MakePattern(my_seed, mine.send_len);
+  std::vector<char> in(static_cast<size_t>(theirs.send_len));
+  int64_t consumed = 0;
+  ChunkExchangeError err;
+  bool ok;
+  if (mine.direct_dest) {
+    ok = ChunkedDuplexExchange(*sock, out.data(), mine.send_len, *sock,
+                               theirs.send_len, mine.chunk, header,
+                               in.data(), nullptr, nullptr, &err);
+  } else {
+    ok = ChunkedDuplexExchange(
+        *sock, out.data(), mine.send_len, *sock, theirs.send_len, mine.chunk,
+        header, nullptr,
+        [&](int64_t off, const char* data, int64_t n) {
+          if (off != consumed) Fail("out-of-order chunk", round);
+          std::memcpy(in.data() + off, data, static_cast<size_t>(n));
+          consumed += n;
+        },
+        nullptr, &err);
+  }
+  if (!ok) return Fail("exchange returned false", round);
+  if (err.kind != ChunkExchangeError::kNone) {
+    return Fail("err.kind set on success", round);
+  }
+  if (!mine.direct_dest && consumed != theirs.send_len) {
+    return Fail("on_chunk did not consume the full stream", round);
+  }
+  if (!CheckPattern(in.data(), peer_seed, theirs.send_len)) {
+    return Fail("payload corrupted", round);
+  }
+}
+
+bool MakePair(Socket* a, Socket* b) {
+  int fds[2];
+  if (::socketpair(AF_UNIX, SOCK_STREAM, 0, fds) != 0) return false;
+  *a = Socket(fds[0]);
+  *b = Socket(fds[1]);
+  return true;
+}
+
+void FuzzRounds() {
+  std::mt19937 rng(0xC0FFEE);
+  auto rand_len = [&](int64_t lo, int64_t hi) {
+    return std::uniform_int_distribution<int64_t>(lo, hi)(rng);
+  };
+  for (int round = 0; round < 40; ++round) {
+    Socket a, b;
+    if (!MakePair(&a, &b)) return Fail("socketpair", round);
+    // Geometry mix: tiny chunks over big payloads, chunk > payload,
+    // zero-length streams in either/both directions, uneven sides.
+    SidePlan pa{rand_len(0, 3) == 0 ? 0 : rand_len(1, 3 << 20),
+                rand_len(1, 4) == 1 ? rand_len(100, 5000)
+                                    : rand_len(1 << 14, 1 << 20),
+                (rng() & 1) != 0};
+    SidePlan pb{rand_len(0, 3) == 0 ? 0 : rand_len(1, 3 << 20),
+                rand_len(1, 4) == 1 ? rand_len(100, 5000)
+                                    : rand_len(1 << 14, 1 << 20),
+                (rng() & 1) != 0};
+    std::string header = "hdr" + std::to_string(round);
+    unsigned sa = rng(), sb = rng();
+    std::thread ta(RunSide, &a, sa, sb, pa, pb, header, round);
+    RunSide(&b, sb, sa, pb, pa, header, round);
+    ta.join();
+  }
+}
+
+void HeaderMismatch() {
+  Socket a, b;
+  if (!MakePair(&a, &b)) return Fail("socketpair", -1);
+  std::vector<char> pay(1 << 16, 'x');
+  auto side = [&](Socket* s, const std::string& hdr) {
+    std::vector<char> in(pay.size());
+    ChunkExchangeError err;
+    bool ok = ChunkedDuplexExchange(*s, pay.data(), (int64_t)pay.size(), *s,
+                                    (int64_t)pay.size(), 1 << 12, hdr,
+                                    in.data(), nullptr, nullptr, &err);
+    if (ok) Fail("header mismatch not detected", -1);
+    if (err.kind != ChunkExchangeError::kHeaderMismatch) {
+      Fail("wrong error kind for header mismatch", -1);
+    }
+  };
+  std::thread t(side, &a, std::string("AAAA9999"));
+  side(&b, std::string("BBBB9999"));
+  t.join();
+}
+
+void Cancellation() {
+  Socket a, b;
+  if (!MakePair(&a, &b)) return Fail("socketpair", -2);
+  // Peer never sends: the side must notice the cancel flag and abort
+  // within a poll interval instead of hanging.
+  std::vector<char> in(1 << 16);
+  std::atomic<bool> cancel{false};
+  std::thread flipper([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(150));
+    cancel = true;
+  });
+  ChunkExchangeError err;
+  bool ok = ChunkedDuplexExchange(a, nullptr, 0, a, (int64_t)in.size(),
+                                  1 << 12, "h", in.data(), nullptr,
+                                  [&] { return cancel.load(); }, &err);
+  flipper.join();
+  if (ok) Fail("cancelled exchange reported success", -2);
+  if (err.kind != ChunkExchangeError::kTransport) {
+    Fail("wrong error kind for cancellation", -2);
+  }
+}
+
+}  // namespace
+
+int main() {
+  FuzzRounds();
+  HeaderMismatch();
+  Cancellation();
+  if (failures.load() != 0) {
+    std::fprintf(stderr, "%d failure(s)\n", failures.load());
+    return 1;
+  }
+  std::printf("PASS chunk_exchange_selftest\n");
+  return 0;
+}

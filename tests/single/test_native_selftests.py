@@ -33,3 +33,10 @@ def test_core_selftest_3ranks():
 def test_core_selftest_under_tsan():
     out = _build_and_run("tsan_selftest")
     assert "ThreadSanitizer" not in out, out
+
+
+def test_chunk_exchange_selftest():
+    """Randomized-geometry fuzz of ChunkedDuplexExchange (the primitive
+    under the pipelined ring/chain data plane) plus its header-mismatch
+    and cancellation error paths."""
+    _build_and_run("chunk_exchange_selftest")

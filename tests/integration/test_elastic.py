@@ -299,16 +299,16 @@ def _shm_files():
         return set()
 
 
-def _run_shm_crash(kill_rank):
-    """VERDICT r3 #7: SIGKILL a worker mid-shm-collective; survivors must
-    surface the tombstone (no SockBarrier deadlock), restore, and the next
-    generation must re-open a FRESH region — with no stale /dev/shm file
-    left when the job ends."""
+def _run_shm_crash(kill_rank, env_extra=None, body=None, expect_shm=True):
+    """VERDICT r3 #7: SIGKILL a worker mid-collective; survivors must
+    surface the tombstone (no deadlock), restore, and recover.  With the
+    shm plane active the next generation must re-open a FRESH region —
+    with no stale /dev/shm file left when the job ends."""
     before = _shm_files()
     with tempfile.TemporaryDirectory() as td:
         script = os.path.join(td, "worker.py")
         with open(script, "w") as f:
-            f.write(SHM_CRASH_WORKER)
+            f.write(body or SHM_CRASH_WORKER)
         flag = os.path.join(td, "killed.flag")
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -316,6 +316,7 @@ def _run_shm_crash(kill_rank):
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.update({"TEST_KILL_EPOCH": "1", "TEST_KILL_RANK": str(kill_rank),
                     "TEST_KILL_FLAG": flag})
+        env.update(env_extra or {})
         cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
                "--min-np", "1", "-np", "3", "-H", "localhost:3", "--verbose",
                sys.executable, script]
@@ -324,9 +325,13 @@ def _run_shm_crash(kill_rank):
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert os.path.exists(flag), "kill hook never fired"
     assert "epoch=5" in proc.stdout, proc.stdout
-    # The shm plane was active (region present during collectives)...
-    assert "SHM-ACTIVE" in proc.stdout, proc.stdout
-    # ...and the post-kill generation re-formed.
+    if expect_shm:
+        # The shm plane was active (region present during collectives).
+        assert "SHM-ACTIVE" in proc.stdout, proc.stdout
+    else:
+        # The disable must actually bite, or this silently re-tests shm.
+        assert "SHM-ACTIVE" not in proc.stdout, proc.stdout
+    # The post-kill generation re-formed.
     assert proc.stderr.count(" formed with ") >= 2, proc.stderr
     # No stale region file survives the run (the creator-death case would
     # leak without the unconditional unlink in ShmRegion teardown).
@@ -337,6 +342,22 @@ def _run_shm_crash(kill_rank):
 
 def test_elastic_shm_crash_highest_rank():
     _run_shm_crash(kill_rank=2)
+
+
+def test_elastic_chain_broadcast_crash_recovers():
+    """Worker death mid-chain-broadcast on the TCP plane: the pipelined
+    chain's blocking hops must fail fast through the broken sockets (no
+    abort polling inside SendAll/RecvAll), surface the tombstone, and
+    recover.  Uses the shm-crash worker with shm disabled and a broadcast
+    big enough (32 MiB > 1 MiB threshold) to ride the chain; rank 1 is an
+    interior chain hop, so its death breaks both its upstream's send and
+    its downstream's recv."""
+    body = SHM_CRASH_WORKER.replace(
+        "hvd.allreduce(np.ones(BIG, np.float32),",
+        "hvd.broadcast(np.ones(BIG, np.float32), root_rank=0,")
+    assert "hvd.broadcast(np.ones(BIG" in body  # replace really matched
+    _run_shm_crash(kill_rank=1, env_extra={"HOROVOD_SHM_DISABLE": "1"},
+                   body=body, expect_shm=False)
 
 
 def test_elastic_shm_crash_region_creator():

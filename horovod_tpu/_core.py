@@ -82,6 +82,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_allreduce_buffer.restype = c.c_int
     lib.hvd_allreduce_buffer.argtypes = [
         c.c_longlong, c.c_void_p, c.c_longlong, c.c_int, c.c_int, c.c_int]
+    lib.hvd_reducescatter_buffer.restype = c.c_int
+    lib.hvd_reducescatter_buffer.argtypes = [
+        c.c_longlong, c.c_void_p, c.c_longlong, c.c_int, c.c_int, c.c_int,
+        c.POINTER(c.c_longlong), c.c_int]
     lib.hvd_allgather_buffer.restype = c.c_int
     lib.hvd_allgather_buffer.argtypes = [
         c.c_longlong, c.c_void_p, c.c_longlong, c.c_int,
@@ -256,6 +260,20 @@ class NativeCore(CoreBackend):
             self._current_seq, buf.ctypes.data_as(ctypes.c_void_p), buf.size,
             int(wire_dtype(buf.dtype)), int(reduce_op), psid)
         self._check(rc, "allreduce")
+        return buf
+
+    def reducescatter_buffer(self, buf: np.ndarray, psid: int,
+                             reduce_op: ReduceOp, slice_counts) -> np.ndarray:
+        """In-place ring reduce-scatter: on return this rank's slice
+        (slice_counts[my_pos] elements at its offset) is fully reduced;
+        the rest of buf is unspecified."""
+        buf = np.ascontiguousarray(buf)
+        arr = (ctypes.c_longlong * len(slice_counts))(*slice_counts)
+        rc = self._lib.hvd_reducescatter_buffer(
+            self._current_seq, buf.ctypes.data_as(ctypes.c_void_p), buf.size,
+            int(wire_dtype(buf.dtype)), int(reduce_op), psid, arr,
+            len(slice_counts))
+        self._check(rc, "reducescatter")
         return buf
 
     def allgather_buffer(self, buf: np.ndarray, psid: int):

@@ -604,29 +604,32 @@ class HorovodContext:
         e.recv_splits = np.asarray(recv_splits, dtype=np.int64)
 
     def _exec_reducescatter(self, e: TensorEntry, psid: int) -> None:
-        # Reduce everywhere, then keep this rank's slice of the first dim.
-        # Slicing rule matches the reference (ReducescatterOp): the first
+        # True ring reduce-scatter ((m-1)/m of the buffer on the wire,
+        # half the allreduce-then-slice this used to do): the plane
+        # reduces each rank's slice in place and we keep ours.  Slicing
+        # rule matches the reference (ReducescatterOp): the first
         # (d0 % size) ranks receive one extra row.
         n = self._ps_size(psid)
-        dtype = e.array.dtype
         fused = e.array.ravel().copy()
         pre = e.prescale_factor
         if pre != 1.0:
             fused = _scale(fused, pre)
         wire_op = ReduceOp.SUM if e.reduce_op == ReduceOp.AVERAGE else e.reduce_op
-        fused = self.core.allreduce_buffer(fused, psid, wire_op)
-        if e.reduce_op == ReduceOp.AVERAGE:
-            fused = _scale(fused, 1.0 / max(n, 1))
-        if e.postscale_factor != 1.0:
-            fused = _scale(fused, e.postscale_factor)
-        full = fused.reshape(e.array.shape)
         d0 = e.array.shape[0]
+        row = fused.size // d0 if d0 else 0
         ranks = self.core.process_set_ranks(psid)
         my_pos = ranks.index(self.core.rank()) if self.core.rank() in ranks else 0
         base, extra = divmod(d0, n)
-        start = my_pos * base + min(my_pos, extra)
-        length = base + (1 if my_pos < extra else 0)
-        e.result = full[start:start + length]
+        slice_rows = [base + (1 if p < extra else 0) for p in range(n)]
+        fused = self.core.reducescatter_buffer(
+            fused, psid, wire_op, [r * row for r in slice_rows])
+        start = (my_pos * base + min(my_pos, extra)) * row
+        mine = fused[start:start + slice_rows[my_pos] * row]
+        if e.reduce_op == ReduceOp.AVERAGE:
+            mine = _scale(mine, 1.0 / max(n, 1))
+        if e.postscale_factor != 1.0:
+            mine = _scale(mine, e.postscale_factor)
+        e.result = mine.reshape((slice_rows[my_pos],) + e.array.shape[1:])
 
 
 def _adasum_pair(a: np.ndarray, b: np.ndarray) -> np.ndarray:

@@ -63,6 +63,18 @@ class Controller {
   // Host data plane over fused contiguous buffers.
   virtual Status AllreduceBuffer(void* buf, int64_t count, DataType dtype,
                                  ReduceOp op, int process_set_id) = 0;
+  // Reduce-scatter: on return, this rank's slice (slice_counts[my_pos]
+  // elements at its offset within buf) is fully reduced; other regions of
+  // buf are unspecified.  Default: full allreduce (correct everywhere,
+  // 2x the optimal wire bytes — SocketController overrides with a ring
+  // phase that moves (m-1)/m of the buffer instead of 2(m-1)/m).
+  virtual Status ReduceScatterBuffer(void* buf, int64_t count,
+                                     DataType dtype, ReduceOp op,
+                                     const std::vector<int64_t>& slice_counts,
+                                     int process_set_id) {
+    (void)slice_counts;
+    return AllreduceBuffer(buf, count, dtype, op, process_set_id);
+  }
   virtual Status AllgatherBuffer(const void* in, int64_t nbytes,
                                  int process_set_id, std::string* out,
                                  std::vector<int64_t>* nbytes_per_rank) = 0;

@@ -463,6 +463,20 @@ int hvd_allreduce_buffer(long long seq, void* buf, long long count, int dtype,
   return StatusToInt(s);
 }
 
+int hvd_reducescatter_buffer(long long seq, void* buf, long long count,
+                             int dtype, int reduce_op, int psid,
+                             const long long* slice_counts, int n_slices) {
+  if (g == nullptr) return -1;
+  SetSeq(seq);
+  std::vector<int64_t> slices(slice_counts, slice_counts + n_slices);
+  g->timeline.Begin("seq." + std::to_string(seq), "DATA_REDUCESCATTER");
+  Status s = g->controller->ReduceScatterBuffer(
+      buf, count, static_cast<DataType>(dtype),
+      static_cast<ReduceOp>(reduce_op), slices, psid);
+  g->timeline.End("seq." + std::to_string(seq), "DATA_REDUCESCATTER");
+  return StatusToInt(s);
+}
+
 // Allgather: returns malloc'd buffer in *out (caller frees via hvd_free).
 int hvd_allgather_buffer(long long seq, const void* in, long long nbytes,
                          int psid, void** out, long long* out_len,

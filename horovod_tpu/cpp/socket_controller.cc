@@ -24,6 +24,7 @@ constexpr int32_t kProtocolVersion = 3;         // v3: psid in mesh HELLOs
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
 constexpr int32_t kTagReduceScatter = 0x1000;
+constexpr int32_t kTagReduceScatterOp = 0x1800;
 constexpr int32_t kTagAllgatherPhase = 0x2000;
 constexpr int32_t kTagAllgather = 0x4000;
 constexpr int32_t kTagAllgatherSize = 0x4800;
@@ -867,6 +868,45 @@ Status SocketController::ChunkedStep(
   return Status::OK();
 }
 
+Status SocketController::PipelinedReducePhase(
+    std::vector<Socket>& socks, const std::vector<int>& members, int idx,
+    int vidx, char* base, const std::vector<int64_t>& offs, DataType dtype,
+    ReduceOp op, int32_t tag_base, int64_t chunkb) {
+  const int m = static_cast<int>(members.size());
+  const int item = ItemSize(dtype);
+  const int next = members[(idx + 1) % m];
+  const int prev = members[(idx - 1 + m) % m];
+  std::vector<char> scratch;
+  for (int s2 = 0; s2 < m - 1; ++s2) {
+    const int send_c = ((vidx - s2) % m + m) % m;
+    const int recv_c = ((vidx - s2 - 1) % m + m) % m;
+    const int64_t rbytes = (offs[recv_c + 1] - offs[recv_c]) * item;
+    if (static_cast<int64_t>(scratch.size()) < rbytes) {
+      scratch.resize(static_cast<size_t>(rbytes));
+    }
+    char* seg = base + offs[recv_c] * item;
+    int64_t reduced = 0;
+    auto consume = [&](int64_t off, const char* /*data*/, int64_t nb) {
+      // Reduce every fully-received element so far; the peer's chunking
+      // need not be element-aligned (its HOROVOD_RING_CHUNK_BYTES may
+      // differ), so carry any partial element to the next chunk.
+      const int64_t avail = (off + nb) / item * item;
+      if (avail > reduced) {
+        ReduceInto(seg + reduced, scratch.data() + reduced,
+                   (avail - reduced) / item, dtype, op);
+        reduced = avail;
+      }
+    };
+    Status st = ChunkedStep(socks, next,
+                            base + offs[send_c] * item,
+                            (offs[send_c + 1] - offs[send_c]) * item, prev,
+                            rbytes, scratch.data(), tag_base + s2, chunkb,
+                            consume);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
 Status SocketController::RingAllreduce(std::vector<Socket>& socks, void* buf,
                                        int64_t count, DataType dtype,
                                        ReduceOp op,
@@ -889,34 +929,12 @@ Status SocketController::RingAllreduce(std::vector<Socket>& socks, void* buf,
     // kernel keeps moving later chunks, so compute overlaps the wire.
     const int64_t chunkb =
         std::max<int64_t>(item, ring_chunk_bytes_ / item * item);
-    std::vector<char> scratch;
     // Phase 1: ring reduce-scatter with in-flight reduction.
-    for (int s = 0; s < m - 1; ++s) {
-      const int send_c = ((idx - s) % m + m) % m;
-      const int recv_c = ((idx - s - 1) % m + m) % m;
-      const int64_t rbytes = len(recv_c) * item;
-      if (static_cast<int64_t>(scratch.size()) < rbytes) {
-        scratch.resize(static_cast<size_t>(rbytes));
-      }
-      char* seg = base + start(recv_c) * item;
-      int64_t reduced = 0;
-      auto consume = [&](int64_t off, const char* /*data*/, int64_t nb) {
-        // Reduce every fully-received element so far; the peer's chunking
-        // need not be element-aligned (its HOROVOD_RING_CHUNK_BYTES may
-        // differ), so carry any partial element to the next chunk.
-        const int64_t avail = (off + nb) / item * item;
-        if (avail > reduced) {
-          ReduceInto(seg + reduced, scratch.data() + reduced,
-                     (avail - reduced) / item, dtype, op);
-          reduced = avail;
-        }
-      };
-      Status st = ChunkedStep(socks, next, base + start(send_c) * item,
-                              len(send_c) * item, prev, rbytes,
-                              scratch.data(), kTagReduceScatter + s, chunkb,
-                              consume);
-      if (!st.ok()) return st;
-    }
+    std::vector<int64_t> offs(m + 1, 0);
+    for (int c = 0; c < m; ++c) offs[c + 1] = start(c + 1);
+    Status st = PipelinedReducePhase(socks, members, idx, idx, base, offs,
+                                     dtype, op, kTagReduceScatter, chunkb);
+    if (!st.ok()) return st;
     // Phase 2: ring allgather, received straight into place (zero-copy in
     // both directions).
     for (int s = 0; s < m - 1; ++s) {
@@ -992,6 +1010,53 @@ Status SocketController::AllreduceBuffer(void* buf, int64_t count,
     }
   }
   return RingAllreduce(SocksFor(psid), buf, count, dtype, op, members, idx);
+}
+
+Status SocketController::ReduceScatterBuffer(
+    void* buf, int64_t count, DataType dtype, ReduceOp op,
+    const std::vector<int64_t>& slice_counts, int psid) {
+  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  std::vector<int> members;
+  int idx;
+  Status st = Members(psid, &members, &idx);
+  if (!st.ok()) return st;
+  const int m = static_cast<int>(members.size());
+  if (static_cast<int>(slice_counts.size()) != m) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "reducescatter slice_counts length != set size");
+  }
+  int64_t total = 0;
+  for (int64_t c : slice_counts) total += c;
+  if (total != count) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "reducescatter slice_counts do not sum to count");
+  }
+  if (m == 1) return Status::OK();
+  if (ShmRegion* shm = ShmFor(psid)) {
+    // Same-host: the shm allreduce is one region write + segment reduce
+    // per member; the caller slices.  (A slice-only shm variant would
+    // save only the readback of the other slices.)
+    return ShmAllreduce(*shm, SocksFor(psid), members, idx, buf, count,
+                        dtype, op);
+  }
+  // Ring reduce-scatter over the CALLER's slice boundaries (the Horovod
+  // row-split rule), phase 1 of the ring allreduce only: each rank moves
+  // (m-1)/m of the buffer instead of the allreduce's 2(m-1)/m.  The
+  // schedule runs in a shifted index space (vidx = idx-1) so this rank
+  // finishes owning ITS slice (the standard ring leaves rank j with
+  // chunk j+1).  This op always uses the chunked wire format — it has no
+  // legacy framing, so per-rank HOROVOD_RING_CHUNK_BYTES (even 0) stays
+  // interoperable.
+  char* base = static_cast<char*>(buf);
+  const int item = ItemSize(dtype);
+  std::vector<int64_t> offs(m + 1, 0);
+  for (int c = 0; c < m; ++c) offs[c + 1] = offs[c] + slice_counts[c];
+  const int vidx = (idx - 1 + m) % m;
+  const int64_t want = ring_chunk_bytes_ > 0 ? ring_chunk_bytes_
+                                             : (int64_t{1} << 19);
+  const int64_t chunkb = std::max<int64_t>(item, want / item * item);
+  return PipelinedReducePhase(SocksFor(psid), members, idx, vidx, base,
+                              offs, dtype, op, kTagReduceScatterOp, chunkb);
 }
 
 Status SocketController::AllgatherBuffer(const void* in, int64_t nbytes,

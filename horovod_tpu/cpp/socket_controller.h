@@ -59,6 +59,10 @@ class SocketController : public Controller {
 
   Status AllreduceBuffer(void* buf, int64_t count, DataType dtype, ReduceOp op,
                          int process_set_id) override;
+  Status ReduceScatterBuffer(void* buf, int64_t count, DataType dtype,
+                             ReduceOp op,
+                             const std::vector<int64_t>& slice_counts,
+                             int process_set_id) override;
   Status AllgatherBuffer(const void* in, int64_t nbytes, int process_set_id,
                          std::string* out,
                          std::vector<int64_t>* nbytes_per_rank) override;
@@ -129,6 +133,18 @@ class SocketController : public Controller {
   Status RingAllreduce(std::vector<Socket>& socks, void* buf, int64_t count,
                        DataType dtype, ReduceOp op,
                        const std::vector<int>& members, int idx);
+  // Shared pipelined ring reduce phase (m-1 hops, in-flight reduction
+  // with partial-element carry): segment boundaries come from `offs`
+  // (m+1 element offsets into buf), the schedule runs in `vidx` index
+  // space (rank ends owning segment (vidx+1)%m), frames are tagged
+  // tag_base+step.  Used by RingAllreduce phase 1 (equal split,
+  // vidx=idx) and ReduceScatterBuffer (caller slices, vidx=idx-1).
+  Status PipelinedReducePhase(std::vector<Socket>& socks,
+                              const std::vector<int>& members, int idx,
+                              int vidx, char* base,
+                              const std::vector<int64_t>& offs,
+                              DataType dtype, ReduceOp op, int32_t tag_base,
+                              int64_t chunkb);
   // Build a socket mesh among `members` with HELLOs tagged by `psid`
   // (lower member dials, higher accepts); init uses psid 0 over all ranks.
   Status ConnectMesh(const std::vector<int>& members, int psid,

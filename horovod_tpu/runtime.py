@@ -147,6 +147,14 @@ class CoreBackend:
                          reduce_op: ReduceOp) -> np.ndarray:
         raise NotImplementedError
 
+    def reducescatter_buffer(self, buf: np.ndarray, process_set_id: int,
+                             reduce_op: ReduceOp,
+                             slice_counts) -> np.ndarray:
+        """On return this rank's slice of ``buf`` is fully reduced; other
+        regions are unspecified.  Default: full allreduce (single-process
+        backends have nothing to scatter)."""
+        return self.allreduce_buffer(buf, process_set_id, reduce_op)
+
     def allgather_buffer(self, buf: np.ndarray, process_set_id: int):
         """Returns (concatenated bytes of all ranks' buffers, per-rank counts)."""
         raise NotImplementedError

@@ -103,6 +103,21 @@ def _soak_worker():
         assert list(np.asarray(rsplits)) == [M[q][r] for q in range(s)]
         checks += 1
 
+    # Ring reduce-scatter on the TCP path (phase-1-only ring, (m-1)/m of
+    # the bytes): uneven rows (7 over 3 ranks -> 3/2/2), Average op, big
+    # enough rows to span chunks at the 4 KiB setting.
+    W = 2000
+    rs_in = (np.arange(7 * W, dtype=np.float64).reshape(7, W) + r * 1000.0)
+    rs_out = np.asarray(hvd.reducescatter(rs_in, op=hvd.Average,
+                                          name="soak.rs"))
+    base7, extra7 = divmod(7, s)
+    my_rows = base7 + (1 if r < extra7 else 0)
+    start = r * base7 + min(r, extra7)
+    expect_rs = (np.arange(7 * W, dtype=np.float64).reshape(7, W)
+                 + 1000.0 * (s - 1) / 2.0)[start:start + my_rows]
+    np.testing.assert_allclose(rs_out, expect_rs)
+    checks += 1
+
     # Subset collectives ride a dedicated channel over the same wire.
     ps = hvd.add_process_set([0, s - 1])
     if r in (0, s - 1):
@@ -126,7 +141,7 @@ def test_pipelined_ring_soak_matches_ground_truth():
     # 4 KiB chunks: a 200k-element f64 buffer crosses ~130 chunk frames
     # per ring hop.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "4096"})
-    assert res == [18, 17, 18]
+    assert res == [19, 18, 19]
 
 
 def test_pipelined_and_legacy_rings_agree():
@@ -135,7 +150,7 @@ def test_pipelined_and_legacy_rings_agree():
     # both protocols are exactly correct, not merely consistent.
     piped = _totals({})                                # default 512 KiB
     legacy = _totals({"HOROVOD_RING_CHUNK_BYTES": "0"})
-    assert piped == legacy == [18, 17, 18]
+    assert piped == legacy == [19, 18, 19]
 
 
 def test_mixed_chunk_sizes_interoperate():
@@ -143,4 +158,4 @@ def test_mixed_chunk_sizes_interoperate():
     # rank 1 deliberately disagrees with the others.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "8192",
                    "TEST_MIXED_CHUNKS": "1"})
-    assert res == [18, 17, 18]
+    assert res == [19, 18, 19]

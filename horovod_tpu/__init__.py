@@ -22,10 +22,11 @@ from .mpi_ops import (  # noqa: F401
     allreduce, allreduce_, allreduce_async, allreduce_async_,
     grouped_allreduce, grouped_allreduce_, grouped_allreduce_async,
     grouped_allreduce_async_,
-    allgather, allgather_async,
+    allgather, allgather_async, grouped_allgather, grouped_allgather_async,
     broadcast, broadcast_, broadcast_async, broadcast_async_,
     alltoall, alltoall_async,
-    reducescatter, reducescatter_async,
+    reducescatter, reducescatter_async, grouped_reducescatter,
+    grouped_reducescatter_async,
     barrier, join, synchronize, poll,
 )
 from .process_sets import (  # noqa: F401

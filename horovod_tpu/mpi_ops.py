@@ -153,9 +153,12 @@ def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
                             process_set: Optional[ProcessSet] = None) -> List[int]:
     rop = _resolve_op(op, average)
     ctx = HorovodContext.instance()
-    base = name or f"grouped.{id(tensors):x}"
+    # Unnamed groups fall back to the per-tensor deterministic auto-name
+    # (context noname counter): names must MATCH across ranks for
+    # negotiation, so a process-local id() would deadlock.
     return [
-        ctx.enqueue(t, OpType.ALLREDUCE, name=f"{base}.{i}", reduce_op=rop,
+        ctx.enqueue(t, OpType.ALLREDUCE,
+                    name=f"{name}.{i}" if name else None, reduce_op=rop,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
                     process_set_id=_resolve_psid(process_set))
@@ -189,6 +192,32 @@ def allgather_async(tensor, name: Optional[str] = None,
         tensor, OpType.ALLGATHER, name=name,
         process_set_id=_resolve_psid(process_set),
     )
+
+
+def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None,
+                      axis_name: Optional[str] = None) -> List:
+    """Allgather a list of tensors as one atomic negotiation group
+    (reference: grouped_allgather, group_table.cc)."""
+    if tensors and _is_traced(tensors[0]):
+        ax = _axis(axis_name)
+        members = _traced_members(process_set)
+        return [_jit_ops.allgather(t, ax, member_ranks=members)
+                for t in tensors]
+    _check_eager_args(axis_name)
+    return [synchronize(h) for h in grouped_allgather_async(
+        tensors, name=name, process_set=process_set)]
+
+
+def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None
+                            ) -> List[int]:
+    ctx = HorovodContext.instance()
+    # See grouped_allreduce_async: names must match across ranks.
+    return [ctx.enqueue(t, OpType.ALLGATHER,
+                        name=f"{name}.{i}" if name else None,
+                        process_set_id=_resolve_psid(process_set))
+            for i, t in enumerate(tensors)]
 
 
 # ---------------------------------------------------------------------------
@@ -281,6 +310,45 @@ def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_resolve_psid(process_set),
     )
+
+
+def grouped_reducescatter(tensors: Sequence,
+                          op: ReduceOp = ReduceOp.AVERAGE,
+                          name: Optional[str] = None,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          process_set: Optional[ProcessSet] = None,
+                          axis_name: Optional[str] = None) -> List:
+    """Reducescatter a list of tensors as one atomic negotiation group
+    (reference: grouped_reducescatter, group_table.cc)."""
+    if tensors and _is_traced(tensors[0]):
+        ax = _axis(axis_name)
+        members = _traced_members(process_set)
+        return [_jit_ops.reducescatter(t, ax, op, prescale_factor,
+                                       postscale_factor,
+                                       member_ranks=members)
+                for t in tensors]
+    _check_eager_args(axis_name)
+    return [synchronize(h) for h in grouped_reducescatter_async(
+        tensors, op=op, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)]
+
+
+def grouped_reducescatter_async(tensors: Sequence,
+                                op: ReduceOp = ReduceOp.AVERAGE,
+                                name: Optional[str] = None,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> List[int]:
+    ctx = HorovodContext.instance()
+    # See grouped_allreduce_async: names must match across ranks.
+    return [ctx.enqueue(t, OpType.REDUCESCATTER,
+                        name=f"{name}.{i}" if name else None,
+                        reduce_op=op, prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set_id=_resolve_psid(process_set))
+            for i, t in enumerate(tensors)]
 
 
 # ---------------------------------------------------------------------------

@@ -118,6 +118,21 @@ def _soak_worker():
     np.testing.assert_allclose(rs_out, expect_rs)
     checks += 1
 
+    # Grouped variants: one atomic negotiation group per list.
+    ga = hvd.grouped_allgather(
+        [np.full((2, 2), float(r), np.float32),
+         np.full((1, 2), float(10 + r), np.float32)], name="soak.gag")
+    assert np.asarray(ga[0]).shape == (2 * s, 2)
+    np.testing.assert_allclose(np.asarray(ga[1])[:, 0],
+                               [10.0 + rr for rr in range(s)])
+    # No name=: the default auto-naming must still agree across ranks
+    # (a process-local default would deadlock negotiation).
+    grs = hvd.grouped_reducescatter(
+        [np.full((s, 4), float(r + 1), np.float32)], op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(grs[0]),
+                               float(s * (s + 1) / 2))
+    checks += 1
+
     # Subset collectives ride a dedicated channel over the same wire.
     ps = hvd.add_process_set([0, s - 1])
     if r in (0, s - 1):
@@ -141,7 +156,7 @@ def test_pipelined_ring_soak_matches_ground_truth():
     # 4 KiB chunks: a 200k-element f64 buffer crosses ~130 chunk frames
     # per ring hop.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "4096"})
-    assert res == [19, 18, 19]
+    assert res == [20, 19, 20]
 
 
 def test_pipelined_and_legacy_rings_agree():
@@ -150,7 +165,7 @@ def test_pipelined_and_legacy_rings_agree():
     # both protocols are exactly correct, not merely consistent.
     piped = _totals({})                                # default 512 KiB
     legacy = _totals({"HOROVOD_RING_CHUNK_BYTES": "0"})
-    assert piped == legacy == [19, 18, 19]
+    assert piped == legacy == [20, 19, 20]
 
 
 def test_mixed_chunk_sizes_interoperate():
@@ -158,4 +173,4 @@ def test_mixed_chunk_sizes_interoperate():
     # rank 1 deliberately disagrees with the others.
     res = _totals({"HOROVOD_RING_CHUNK_BYTES": "8192",
                    "TEST_MIXED_CHUNKS": "1"})
-    assert res == [19, 18, 19]
+    assert res == [20, 19, 20]

@@ -98,6 +98,14 @@ def test_interleaved_multi_set_traffic_is_exact():
     assert run(_interleave_worker, np=3) == [0, 1, 2]
 
 
+def test_interleaved_multi_set_traffic_is_exact_tcp():
+    # Same interleaving with shm off: concurrent lane threads each run
+    # chunk-pipelined rings over their OWN per-set socket channels, so
+    # this exercises cross-lane frame isolation on the pipelined wire.
+    assert run(_interleave_worker, np=3,
+               env={"HOROVOD_SHM_DISABLE": "1"}) == [0, 1, 2]
+
+
 def _join_with_lanes_worker():
     import numpy as np
     import horovod_tpu as hvd

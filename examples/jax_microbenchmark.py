@@ -1,4 +1,5 @@
-"""Collective microbenchmarks: allreduce/allgather/alltoall (config 4).
+"""Collective microbenchmarks: allreduce/allgather/alltoall/
+reducescatter/broadcast (config 4).
 
 Reference analog: the timeline/benchmark harness Horovod ships for measuring
 fused-allreduce throughput (docs/benchmarks.rst synthetic benchmarks).
@@ -60,10 +61,16 @@ def bench_eager(mb: float, iters: int):
     rows = n // max(hvd.size(), 1) * hvd.size()
     xa = np.ones((rows, 1), np.float32)
     results = {}
+    # xa doubles for reducescatter: both split first-dim rows across
+    # the set.
     for name, fn in [
         ("allreduce", lambda i: hvd.allreduce(x, name=f"b.ar.{i}")),
         ("allgather", lambda i: hvd.allgather(x, name=f"b.ag.{i}")),
         ("alltoall", lambda i: hvd.alltoall(xa, name=f"b.a2a.{i}")),
+        ("reducescatter", lambda i: hvd.reducescatter(
+            xa, op=hvd.Sum, name=f"b.rs.{i}")),
+        ("broadcast", lambda i: hvd.broadcast(
+            x, root_rank=0, name=f"b.bc.{i}")),
     ]:
         fn(0)  # warmup
         t0 = time.perf_counter()

@@ -14,6 +14,8 @@ import os
 import shlex
 from typing import Dict, List, Optional
 
+from .util import FORWARD_ENV_PREFIXES
+
 
 class LSFUtils:
     """Queries over the LSF allocation environment (reference:
@@ -84,9 +86,12 @@ def make_jsrun_command(num_proc: int, command: List[str],
         cmd += ["--gpu_per_rs", str(gpu_per_rs)]
     if launch_args:
         cmd += shlex.split(launch_args)
+    # Prefixes only (not forwardable_env): a jsrun worker pins its own
+    # TPU chips on its own host, so the launcher's TPU_* pins must not
+    # ride along.
     env_str = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in env.items()
-        if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "JAX_", "XLA_")))
+        if k.startswith(FORWARD_ENV_PREFIXES))
     wrapped = "env " + env_str + " " + \
         " ".join(shlex.quote(c) for c in command)
     cmd += ["sh", "-c", wrapped]

@@ -75,7 +75,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_enqueue.argtypes = [
         c.c_longlong, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_longlong,
         c.POINTER(c.c_longlong), c.c_int, c.c_int, c.c_int, c.c_double,
-        c.c_double, c.POINTER(c.c_longlong), c.c_int,
+        c.c_double, c.POINTER(c.c_longlong), c.c_int, c.c_int, c.c_char_p,
+        c.c_int,
     ]
     lib.hvd_pop_response.restype = c.c_int
     lib.hvd_pop_response.argtypes = [c.c_char_p, c.c_int, c.c_int]
@@ -189,7 +190,8 @@ class NativeCore(CoreBackend):
             int(entry.dtype), int(entry.reduce_op), entry.array.nbytes,
             shape, len(entry.array.shape), entry.process_set_id,
             entry.root_rank, entry.prescale_factor, entry.postscale_factor,
-            splits, nsplits)
+            splits, nsplits, 1 if entry.device_array is not None else 0,
+            entry.group_key.encode(), entry.group_size)
         if rc == -2:
             raise ValueError(f"duplicate in-flight tensor name {entry.name!r}")
         if rc != 0:
@@ -215,6 +217,7 @@ class NativeCore(CoreBackend):
             counts=obj.get("counts"),
             last_joined=obj.get("last_joined", -1),
             seq=obj.get("seq", -1),
+            device=bool(obj.get("device", 0)),
         )
 
     def set_current_seq(self, seq: int) -> None:

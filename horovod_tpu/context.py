@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .exceptions import HorovodInternalError
+from .ops.device_plane import DevicePlane
 from .runtime import CoreBackend, FusedResponse, PyLocalCore, TensorEntry
 from .utils.env import Config, get_bool
 from .utils.logging import get_logger
@@ -38,6 +39,10 @@ _INT_TYPES = (
     DataType.UINT8, DataType.INT8, DataType.UINT16, DataType.INT16,
     DataType.INT32, DataType.INT64, DataType.BOOL,
 )
+
+# Pseudo process-set id keying the single shared device-plane executor lane
+# (never collides with real psids, which are >= 0).
+_DEVICE_LANE = -1
 
 
 def _scale(arr: np.ndarray, factor: float) -> np.ndarray:
@@ -156,6 +161,10 @@ class HorovodContext:
         self._joined = False  # this rank called join() and awaits the rest
         self._handle_counter = itertools.count(1)
         self._noname_counter = itertools.count(0)
+        # Grouped-call counter: unnamed groups need a key that MATCHES
+        # across ranks; like the noname counter, determinism follows from
+        # every rank issuing grouped calls in the same order.
+        self._group_counter = itertools.count(0)
         self._shutdown = threading.Event()
         # One fusion buffer PER EXECUTOR LANE (thread-local): lanes finalize
         # different process sets' responses concurrently, each packing its
@@ -164,6 +173,10 @@ class HorovodContext:
         self._fusion_tls = threading.local()
         self._fusion_initial = min(cfg.fusion_threshold_bytes, 64 << 20)
         self.core.start(cfg)
+        # Eager device data plane: executes responses negotiated
+        # device=True as cached jitted fused XLA collectives (the NCCL-ops
+        # analog; ops/device_plane.py).
+        self.device_plane = DevicePlane(self.core, cfg)
         # Parallel lanes: one finalization thread per process set, so an
         # in-flight host collective on one set cannot head-of-line-block
         # independent traffic on another.  Requires per-set data channels
@@ -236,8 +249,22 @@ class HorovodContext:
         process_set_id: int = 0,
         prescale_factor: float = 1.0,
         postscale_factor: float = 1.0,
+        group_key: str = "",
+        group_size: int = 0,
     ) -> int:
-        np_arr, was_jax, orig_dtype = _to_host(array)
+        # Device-plane capability first: a device-resident jax.Array whose
+        # op the plane serves never touches the host — the entry carries a
+        # zero-memory shape/dtype proxy for negotiation metadata only, and
+        # the announced device bit tells the coordinator this rank can
+        # dispatch the jitted collective.
+        dev_arr = self.device_plane.adopt(array, op, reduce_op, process_set_id)
+        if dev_arr is not None:
+            np_arr = np.broadcast_to(
+                np.zeros((), numpy_dtype(wire_dtype(dev_arr.dtype))),
+                tuple(dev_arr.shape))
+            was_jax, orig_dtype = True, dev_arr.dtype
+        else:
+            np_arr, was_jax, orig_dtype = _to_host(array)
         dtype = wire_dtype(np_arr.dtype if orig_dtype is None else orig_dtype)
         if name is None:
             name = f"{op.name.lower()}.noname.{next(self._noname_counter)}"
@@ -267,6 +294,9 @@ class HorovodContext:
             postscale_factor=postscale_factor,
             was_jax=was_jax,
             orig_dtype=orig_dtype,
+            group_key=group_key,
+            group_size=group_size,
+            device_array=dev_arr,
         )
         with self._entries_lock:
             self._entries[handle] = entry
@@ -280,6 +310,14 @@ class HorovodContext:
             self._inflight_names.add(name)
         self.core.enqueue(entry)
         return handle
+
+    def group_key_for(self, name: Optional[str]) -> str:
+        """Negotiation key for one grouped_* call (group_table.cc analog).
+        Must match across ranks: named groups key on the name; unnamed ones
+        on the deterministic grouped-call counter."""
+        if name:
+            return f"g.{name}"
+        return f"g.anon.{next(self._group_counter)}"
 
     # -- completion ---------------------------------------------------------
     def poll(self, handle: int) -> bool:
@@ -321,7 +359,18 @@ class HorovodContext:
                 resp.joined_at_dispatch = self._joined
                 if resp.op == OpType.JOIN and not resp.error:
                     self._joined = False
-            if self._use_lanes:
+            if resp.device and self._use_lanes:
+                # ALL device-plane responses share ONE lane: XLA executes
+                # collectives in per-device enqueue order, so every host
+                # must enqueue them in the same (negotiated) global order —
+                # two concurrent lanes whose rank meshes share devices
+                # could otherwise enqueue in opposite orders on different
+                # hosts and deadlock the ICI ring.  A dedicated lane (not
+                # inline dispatch) also keeps a program-cache-miss compile
+                # from head-of-line-blocking other sets' host traffic
+                # behind the dispatcher.
+                self._lane_for(_DEVICE_LANE).submit(resp)
+            elif self._use_lanes:
                 self._lane_for(resp.process_set_id).submit(resp)
             else:
                 self._process_response(resp)
@@ -339,6 +388,7 @@ class HorovodContext:
         """Remove a set from the core AND retire its executor lane (ids are
         never reused, so a leaked lane thread would accumulate forever)."""
         self.core.remove_process_set(psid)
+        self.device_plane.invalidate(psid)
         lane = self._lanes.pop(psid, None)
         if lane is not None:
             lane.stop()
@@ -403,6 +453,18 @@ class HorovodContext:
     def _execute(self, resp: FusedResponse, entries: List[TensorEntry]) -> None:
         op = resp.op
         psid = resp.process_set_id
+        if resp.device:
+            # Negotiated device plane: EVERY rank announced capability, so
+            # every rank dispatches the same cached jitted collective here.
+            self.device_plane.execute(resp, entries)
+            return
+        # Host plane.  Negotiation may have demoted device-resident entries
+        # (a host tensor or joined rank elsewhere): materialize their bytes
+        # now — the only place an eager device array crosses to the host.
+        for e in entries:
+            if e.device_array is not None:
+                e.array = _contig(np.asarray(e.device_array))
+                self.device_plane.note_host_fallback(e.name)
         if op == OpType.ALLREDUCE:
             self._exec_allreduce(entries, psid)
         elif op == OpType.ALLGATHER:
@@ -436,6 +498,11 @@ class HorovodContext:
         psid = resp.process_set_id
         if self.cfg.rank not in self.core.process_set_ranks(psid):
             return
+        if resp.device:
+            # Unreachable: the coordinator demotes every via-join response
+            # to the host plane (socket_controller.cc CoordinatorCycle).
+            raise HorovodInternalError(
+                "joined rank received a device-plane response")
         if resp.op == OpType.ALLREDUCE:
             count = int(sum(resp.counts or []))
             zeros = np.zeros(count, numpy_dtype(resp.dtype))
@@ -678,6 +745,8 @@ def _to_host(array):
 
 
 def _from_host(result: np.ndarray, entry: TensorEntry):
+    if entry.device_array is not None and not isinstance(result, np.ndarray):
+        return result  # device plane: already a device-resident jax.Array
     if not entry.was_jax:
         return result
     import jax.numpy as jnp

@@ -101,6 +101,19 @@ struct TensorRequest {
   double prescale = 1.0;
   double postscale = 1.0;
   std::vector<int64_t> splits; // alltoall send splits
+  // 1 when the submitting rank can execute this tensor on the device data
+  // plane (a device-resident jax.Array + a ready rank mesh).  The
+  // coordinator ANDs the flag across ranks so every rank deterministically
+  // picks the same plane — the analog of the reference's device-id
+  // coherence that decides NCCL vs CPU ops (message.h Request::device).
+  int32_t device = 0;
+  // Atomic grouped negotiation (reference: group_table.cc — GroupTable):
+  // tensors sharing a non-empty key become ready all-or-nothing (the
+  // coordinator withholds the group until group_size members are ready on
+  // every rank) and are emitted contiguously, so they fuse together and
+  // never interleave with other traffic.
+  std::string group_key;
+  int32_t group_size = 0;
   double enqueued_at = 0.0;    // monotonic seconds (stall inspection)
 };
 

@@ -3,6 +3,8 @@
 #include <algorithm>
 #include <cmath>
 #include <cstring>
+#include <unordered_map>
+#include <utility>
 
 #include "logging.h"
 
@@ -80,6 +82,9 @@ std::vector<Response> FuseRequests(const std::vector<TensorRequest>& ready,
                      bucket.front()->reduce_op == t.reduce_op &&
                      bucket.front()->prescale == t.prescale &&
                      bucket.front()->postscale == t.postscale &&
+                     // device buckets stay pure: a fused response executes
+                     // on exactly one data plane
+                     bucket.front()->device == t.device &&
                      bucket_bytes + t.nbytes <= fusion_threshold;
       if (!fusable) flush();
       bucket.push_back(&t);
@@ -119,7 +124,18 @@ Status LocalController::Initialize() {
 
 Status LocalController::ComputeResponses(
     std::vector<TensorRequest>& new_requests, std::vector<Response>* out) {
-  *out = FuseRequests(new_requests, cfg_.fusion_threshold);
+  // Atomic group gating at np=1: a grouped enqueue can race the cycle
+  // drain mid-call, so members may arrive across drains — hold a group
+  // until all group_size members are present (GateAndOrderGroups; the
+  // SocketController coordinator applies the same rule cross-rank).
+  for (auto& r : new_requests) held_.emplace_back(arrival_++, std::move(r));
+  std::vector<TensorRequest> ready;
+  std::vector<std::pair<int64_t, TensorRequest>> still_held;
+  GateAndOrderGroups(
+      std::move(held_), &still_held, &ready,
+      [](const TensorRequest& r) -> const TensorRequest& { return r; });
+  held_ = std::move(still_held);
+  *out = FuseRequests(ready, cfg_.fusion_threshold);
   for (auto& r : *out) {
     // Single process: this rank is trivially the last (and only) joiner.
     if (r.op == OpType::JOIN) r.last_joined = 0;

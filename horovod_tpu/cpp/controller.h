@@ -10,15 +10,70 @@
 // dispatches the same fused XLA program.
 #pragma once
 
+#include <algorithm>
 #include <map>
 #include <mutex>
 #include <set>
 #include <string>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 
 #include "common.h"
 
 namespace hvdtpu {
+
+// Atomic group gating shared by LocalController and the coordinator
+// (reference: group_table.cc — GroupTable): members of incomplete groups
+// are withheld into `still_held`; everything else lands in `ready` sorted
+// so each complete group sits CONTIGUOUSLY at its first member's arrival
+// position (so members fuse together and other traffic cannot
+// interleave).  `meta(payload)` yields the TensorRequest describing an
+// item.  Fast path: with no grouped items in flight this is just the
+// arrival-order sort + move the pre-group code did.
+template <typename T, typename MetaFn>
+void GateAndOrderGroups(std::vector<std::pair<int64_t, T>>&& items,
+                        std::vector<std::pair<int64_t, T>>* still_held,
+                        std::vector<T>* ready, MetaFn meta) {
+  still_held->clear();
+  ready->clear();
+  bool any_group = false;
+  for (const auto& it : items) {
+    if (!meta(it.second).group_key.empty()) {
+      any_group = true;
+      break;
+    }
+  }
+  if (!any_group) {
+    std::sort(items.begin(), items.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    for (auto& [ord, t] : items) ready->push_back(std::move(t));
+    return;
+  }
+  std::unordered_map<std::string, std::pair<int32_t, int64_t>> gstate;
+  for (const auto& [ord, t] : items) {
+    const auto& m = meta(t);
+    if (m.group_key.empty()) continue;
+    auto it = gstate.emplace(m.group_key, std::make_pair(0, ord)).first;
+    it->second.first++;
+    it->second.second = std::min(it->second.second, ord);
+  }
+  std::vector<std::pair<std::pair<int64_t, int64_t>, T>> keyed;
+  keyed.reserve(items.size());
+  for (auto& [ord, t] : items) {
+    const auto& m = meta(t);
+    if (m.group_key.empty()) {
+      keyed.push_back({{ord, ord}, std::move(t)});
+    } else if (gstate[m.group_key].first < m.group_size) {
+      still_held->emplace_back(ord, std::move(t));
+    } else {
+      keyed.push_back({{gstate[m.group_key].second, ord}, std::move(t)});
+    }
+  }
+  std::sort(keyed.begin(), keyed.end(),
+            [](const auto& a, const auto& b) { return a.first < b.first; });
+  for (auto& [k, t] : keyed) ready->push_back(std::move(t));
+}
 
 class ProcessSetTable {
  public:
@@ -136,6 +191,13 @@ class LocalController : public Controller {
     return Status::OK();
   }
   Status Barrier(int) override { return Status::OK(); }
+
+ private:
+  // Grouped requests held until every member of the group has arrived
+  // (a grouped enqueue can race the cycle drain mid-call; atomicity must
+  // hold at np=1 too — group_table.cc analog).
+  std::vector<std::pair<int64_t, TensorRequest>> held_;
+  int64_t arrival_ = 0;
 };
 
 // Typed elementwise reduction into `acc` (used by the socket data plane).

@@ -105,6 +105,12 @@ std::string ResponseToJson(const Response& r) {
     os << r.handles[i];
   }
   os << "]";
+  // Negotiated data plane: 1 only when EVERY rank announced device
+  // capability for every member (the coordinator ANDs the bits), so all
+  // ranks dispatch the same cached jitted collective.
+  bool device = !r.metas.empty();
+  for (const auto& m : r.metas) device = device && m.device != 0;
+  os << ",\"device\":" << (device ? 1 : 0);
   // Per-member element counts + reduce op: a joined rank has no local
   // entries yet must still walk the ring with a zero buffer of the right
   // size (hvd.join zero-contribution semantics).
@@ -395,7 +401,8 @@ int hvd_local_size() { return g ? g->cfg.local_size : -1; }
 long long hvd_enqueue(long long handle, const char* name, int op, int dtype,
                       int reduce_op, long long nbytes, const long long* shape,
                       int ndim, int psid, int root_rank, double prescale,
-                      double postscale, const long long* splits, int nsplits) {
+                      double postscale, const long long* splits, int nsplits,
+                      int device, const char* group_key, int group_size) {
   if (g == nullptr) return -1;
   TensorRequest r;
   r.handle = handle;
@@ -409,6 +416,11 @@ long long hvd_enqueue(long long handle, const char* name, int op, int dtype,
   r.root_rank = root_rank;
   r.prescale = prescale;
   r.postscale = postscale;
+  r.device = device != 0 ? 1 : 0;
+  if (group_key && group_key[0]) {
+    r.group_key = group_key;
+    r.group_size = group_size;
+  }
   if (splits && nsplits > 0) r.splits.assign(splits, splits + nsplits);
   r.enqueued_at = MonotonicSeconds();
   if (r.op == OpType::JOIN) g->join_inflight.store(true);

@@ -6,10 +6,19 @@ namespace hvdtpu {
 
 std::string ResponseCache::Signature(const TensorRequest& r) {
   std::ostringstream os;
+  // The device bit is deliberately NOT part of the signature: entries are
+  // inserted with the coordinator-ANDed bit while lookups use the local
+  // capability bit, so including it would permanently miss for any
+  // device-capable rank in a host-demoted collective (the steady-state
+  // fallback the cache matters most for).  A cache hit replays the STORED
+  // negotiated bit; the Python executor tolerates either direction of a
+  // stale bit (device_put on a replayed device=1, host materialization on
+  // a replayed device=0).
   os << r.name << '|' << static_cast<int>(r.op) << '|'
      << static_cast<int>(r.dtype) << '|' << static_cast<int>(r.reduce_op)
      << '|' << r.process_set_id << '|' << r.root_rank << '|' << r.prescale
-     << '|' << r.postscale << '|';
+     << '|' << r.postscale << '|' << r.group_key << '|'
+     << r.group_size << '|';
   for (auto d : r.shape) os << d << ',';
   os << '|';
   for (auto s : r.splits) os << s << ',';

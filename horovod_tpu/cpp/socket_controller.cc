@@ -6,6 +6,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <sstream>
+#include <unordered_map>
 
 #include "logging.h"
 
@@ -19,7 +20,7 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-constexpr int32_t kProtocolVersion = 3;         // v3: psid in mesh HELLOs
+constexpr int32_t kProtocolVersion = 4;         // v4: device bit in requests
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -72,6 +73,16 @@ SocketController::SocketController(const CoreConfig& cfg)
 SocketController::~SocketController() { Shutdown(); }
 
 Status SocketController::Initialize() {
+  // Frame-tag families are spaced 0x800 apart and several data-plane
+  // algorithms encode a step/member index into the tag — a mesh of 0x800+
+  // members would alias the next family and silently weaken the desync
+  // check the tags exist for.
+  if (cfg_.size >= 0x800) {
+    return Status::Error(
+        StatusCode::INVALID_ARGUMENT,
+        "socket controller supports at most 2047 ranks (frame-tag step "
+        "encoding); shard the job into process sets or hosts");
+  }
   process_sets_.InitGlobal(cfg_.size);
   // Every rank owns a mesh listener on an ephemeral port; the coordinator
   // brokers the address book (the Gloo rendezvous-store analog).
@@ -463,6 +474,9 @@ void SocketController::Announce(int rank, TensorRequest req,
   } else if (p.meta.prescale != req.prescale ||
              p.meta.postscale != req.postscale) {
     mismatch = "scale factors";
+  } else if (p.meta.group_key != req.group_key ||
+             p.meta.group_size != req.group_size) {
+    mismatch = "group membership";
   } else if (req.op == OpType::ALLREDUCE || req.op == OpType::BROADCAST ||
              req.op == OpType::REDUCESCATTER) {
     if (p.meta.shape != req.shape) mismatch = "shape";
@@ -492,6 +506,10 @@ void SocketController::Announce(int rank, TensorRequest req,
     pending_.erase(it);
     return;
   }
+  // Device-plane coherence: the response's plane is the AND of every
+  // rank's capability bit — deliberately NOT a mismatch error (a host
+  // numpy on one rank simply demotes the collective to the host plane).
+  p.meta.device = p.meta.device & req.device;
   p.announced.insert(rank);
 }
 
@@ -632,24 +650,35 @@ Status SocketController::CoordinatorCycle(
         join_rejected.push_back(kv.first);
         continue;
       }
+      // A joined rank zero-participates through the HOST plane (it has no
+      // local tensor to place on a device); demote the whole collective so
+      // every member walks the same ring.
+      kv.second.meta.device = 0;
     }
     ready_names.emplace_back(kv.second.order, kv.first);
   }
   for (const auto& name : join_rejected) pending_.erase(name);
-  std::sort(ready_names.begin(), ready_names.end());
+  // Atomic group gating (GateAndOrderGroups, group_table.cc analog):
+  // members of incomplete groups are withheld — they simply REMAIN in
+  // pending_ for a later cycle; complete groups come out contiguous.
+  std::vector<std::string> ordered;
+  std::vector<std::pair<int64_t, std::string>> withheld;
+  GateAndOrderGroups(std::move(ready_names), &withheld, &ordered,
+                     [this](const std::string& n) -> const TensorRequest& {
+                       return pending_[n].meta;
+                     });
   // JOIN completion must come after every via-join collective of the same
   // cycle: once a rank's executor processes the JOIN it stops zero-
   // participating, so a later-ordered via-join response would hang the
   // ring.  The partition is deterministic, so all ranks stay identical.
   std::stable_partition(
-      ready_names.begin(), ready_names.end(),
-      [this](const std::pair<int64_t, std::string>& p) {
-        auto it = pending_.find(p.second);
+      ordered.begin(), ordered.end(), [this](const std::string& n) {
+        auto it = pending_.find(n);
         return it != pending_.end() && it->second.meta.op != OpType::JOIN;
       });
   std::vector<TensorRequest> ready;
-  ready.reserve(ready_names.size());
-  for (auto& [ord, name] : ready_names) {
+  ready.reserve(ordered.size());
+  for (auto& name : ordered) {
     ready.push_back(pending_[name].meta);
     pending_.erase(name);
   }
@@ -757,14 +786,33 @@ std::string SocketController::StallReport(double older_than_s) {
   if (!is_coordinator()) return "";
   double now = MonotonicSeconds();
   std::ostringstream os;
+  // Per-group ready counts: a grouped tensor announced by every rank can
+  // still stall on MISSING group members (submitted nowhere) — report the
+  // group shortfall, not an empty rank list.
+  std::unordered_map<std::string, int32_t> gcount;
+  for (const auto& kv : pending_) {
+    if (!kv.second.meta.group_key.empty()) {
+      gcount[kv.second.meta.group_key]++;
+    }
+  }
   for (const auto& kv : pending_) {
     if (now - kv.second.first_seen < older_than_s) continue;
     std::vector<int> members;
     process_sets_.Ranks(kv.second.meta.process_set_id, &members);
-    os << kv.first << " (waiting on ranks:";
+    std::vector<int> waiting;
     for (int m : members) {
-      if (!kv.second.announced.count(m)) os << " " << m;
+      if (!kv.second.announced.count(m)) waiting.push_back(m);
     }
+    const auto& meta = kv.second.meta;
+    if (waiting.empty() && !meta.group_key.empty() &&
+        gcount[meta.group_key] < meta.group_size) {
+      os << kv.first << " (group " << meta.group_key << " incomplete: "
+         << gcount[meta.group_key] << "/" << meta.group_size
+         << " members submitted); ";
+      continue;
+    }
+    os << kv.first << " (waiting on ranks:";
+    for (int m : waiting) os << " " << m;
     os << "); ";
   }
   return os.str();
@@ -1199,6 +1247,11 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       std::string frame;
       if (!socks[src].RecvFrame(&frame)) {
         aborted_ = true;
+        // Mirror of the send-side fail-fast: our downstream is blocked in
+        // RecvAll with no abort polling; closing its socket propagates the
+        // failure down the chain immediately instead of leaving it wedged
+        // until job-level teardown.
+        if (next_sock) next_sock->Close();
         return Status::Error(StatusCode::ABORTED,
                              "broadcast chain recv from rank " +
                                  std::to_string(src) + " failed");
@@ -1208,14 +1261,17 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       if (!st.ok()) {
         // Our upstream is mid-SendAll of the raw stream with no abort
         // polling; closing the socket fails it fast instead of letting it
-        // block on full kernel buffers until process teardown.
+        // block on full kernel buffers until process teardown.  The
+        // downstream is symmetric: it blocks in RecvAll.
         socks[src].Close();
+        if (next_sock) next_sock->Close();
         return st;
       }
       int64_t peer_bytes = rd.GetI64();
       if (!rd.ok() || peer_bytes != nbytes) {
         aborted_ = true;
         socks[src].Close();
+        if (next_sock) next_sock->Close();
         return Status::Error(StatusCode::ABORTED,
                              "broadcast size mismatch across ranks");
       }
@@ -1234,6 +1290,8 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       const int64_t n = std::min<int64_t>(ring_chunk_bytes_, nbytes - off);
       if (src >= 0 && !socks[src].RecvAll(base + off, n)) {
         aborted_ = true;
+        // Fail the blocked downstream RecvAll fast (see header path).
+        if (next_sock) next_sock->Close();
         return Status::Error(StatusCode::ABORTED,
                              "broadcast chain recv from rank " +
                                  std::to_string(src) + " failed");

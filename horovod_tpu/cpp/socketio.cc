@@ -42,6 +42,9 @@ void SerializeRequest(const TensorRequest& r, Writer* w) {
   w->PutF64(r.prescale);
   w->PutF64(r.postscale);
   w->PutI64Vec(r.splits);
+  w->PutI32(r.device);
+  w->PutString(r.group_key);
+  w->PutI32(r.group_size);
 }
 
 TensorRequest DeserializeRequest(Reader* r) {
@@ -58,6 +61,9 @@ TensorRequest DeserializeRequest(Reader* r) {
   t.prescale = r->GetF64();
   t.postscale = r->GetF64();
   t.splits = r->GetI64Vec();
+  t.device = r->GetI32();
+  t.group_key = r->GetString();
+  t.group_size = r->GetI32();
   return t;
 }
 
@@ -481,27 +487,35 @@ bool ChunkedDuplexExchange(
       }
       if ((pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) && want_recv &&
           pfds[i].fd == rfd) {
+        // Drain the length prefix AND the header within one wakeup (they
+        // are tiny and nearly always arrive in the same segment) — an
+        // if/else ladder here would cost an extra poll round-trip per
+        // chunk frame.  1 = complete, 0 = would block, -1 = error/EOF.
+        auto drain = [&](char* dst, size_t want, size_t& got) -> int {
+          while (got < want) {
+            ssize_t r = ::recv(pfds[i].fd, dst + got, want - got, 0);
+            if (r > 0) {
+              got += static_cast<size_t>(r);
+              continue;
+            }
+            if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                          errno == EINTR)) {
+              return 0;
+            }
+            return -1;
+          }
+          return 1;
+        };
+        int pr = 1;
         if (rlen_got < 4) {
-          ssize_t r = ::recv(pfds[i].fd,
-                             reinterpret_cast<char*>(&rlen) + rlen_got,
-                             4 - rlen_got, 0);
-          if (r > 0) {
-            rlen_got += static_cast<size_t>(r);
-          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
-                                errno != EINTR)) {
-            ok = false;
-            break;
-          }
-        } else if (rhdr_got < hdr_n) {
-          ssize_t r = ::recv(pfds[i].fd, &rhdr[rhdr_got], hdr_n - rhdr_got,
-                             0);
-          if (r > 0) {
-            rhdr_got += static_cast<size_t>(r);
-          } else if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK &&
-                                errno != EINTR)) {
-            ok = false;
-            break;
-          }
+          pr = drain(reinterpret_cast<char*>(&rlen), 4, rlen_got);
+        }
+        if (pr > 0 && rhdr_got < hdr_n) {
+          pr = drain(&rhdr[0], hdr_n, rhdr_got);
+        }
+        if (pr < 0) {
+          ok = false;
+          break;
         }
         if (!rframe_known && rlen_got == 4 && rhdr_got == hdr_n) {
           if (rhdr != header) {

@@ -155,13 +155,18 @@ def grouped_allreduce_async(tensors: Sequence, average: Optional[bool] = None,
     ctx = HorovodContext.instance()
     # Unnamed groups fall back to the per-tensor deterministic auto-name
     # (context noname counter): names must MATCH across ranks for
-    # negotiation, so a process-local id() would deadlock.
+    # negotiation, so a process-local id() would deadlock.  The group key
+    # makes negotiation ATOMIC: the coordinator withholds the whole group
+    # until every member is ready on every rank, then emits the members
+    # contiguously (reference: group_table.cc).
+    gkey = ctx.group_key_for(name)
     return [
         ctx.enqueue(t, OpType.ALLREDUCE,
                     name=f"{name}.{i}" if name else None, reduce_op=rop,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
-                    process_set_id=_resolve_psid(process_set))
+                    process_set_id=_resolve_psid(process_set),
+                    group_key=gkey, group_size=len(tensors))
         for i, t in enumerate(tensors)
     ]
 
@@ -213,10 +218,13 @@ def grouped_allgather_async(tensors: Sequence, name: Optional[str] = None,
                             process_set: Optional[ProcessSet] = None
                             ) -> List[int]:
     ctx = HorovodContext.instance()
-    # See grouped_allreduce_async: names must match across ranks.
+    # See grouped_allreduce_async: names must match across ranks; the
+    # group key makes the negotiation atomic.
+    gkey = ctx.group_key_for(name)
     return [ctx.enqueue(t, OpType.ALLGATHER,
                         name=f"{name}.{i}" if name else None,
-                        process_set_id=_resolve_psid(process_set))
+                        process_set_id=_resolve_psid(process_set),
+                        group_key=gkey, group_size=len(tensors))
             for i, t in enumerate(tensors)]
 
 
@@ -342,12 +350,15 @@ def grouped_reducescatter_async(tensors: Sequence,
                                 process_set: Optional[ProcessSet] = None
                                 ) -> List[int]:
     ctx = HorovodContext.instance()
-    # See grouped_allreduce_async: names must match across ranks.
+    # See grouped_allreduce_async: names must match across ranks; the
+    # group key makes the negotiation atomic.
+    gkey = ctx.group_key_for(name)
     return [ctx.enqueue(t, OpType.REDUCESCATTER,
                         name=f"{name}.{i}" if name else None,
                         reduce_op=op, prescale_factor=prescale_factor,
                         postscale_factor=postscale_factor,
-                        process_set_id=_resolve_psid(process_set))
+                        process_set_id=_resolve_psid(process_set),
+                        group_key=gkey, group_size=len(tensors))
             for i, t in enumerate(tensors)]
 
 

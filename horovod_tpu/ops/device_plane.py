@@ -1,0 +1,450 @@
+"""Eager device data plane: cached jitted fused collectives.
+
+TPU-native analog of the reference's NCCL ops layer for the EAGER path
+(reference: horovod/common/ops/nccl_operations.cc — NCCLAllreduce/
+NCCLBroadcast execute ON the accelerator and the fused buffer stays
+device-resident; SURVEY.md §2.2 and §7's design stance "the ops layer
+compiles and caches jitted fused collectives").  Where the traced path
+(``horovod_tpu.ops.collectives``) serves code already inside jit/shard_map,
+this module serves *eager* enqueues of device-resident ``jax.Array``s: the
+executor dispatches a cached, jitted fused collective over a
+one-device-per-rank mesh instead of copying to host and riding the TCP
+plane.
+
+Correctness across ranks is negotiated, exactly like the reference decides
+NCCL vs CPU ops from the request's device id: every enqueue announces a
+``device`` capability bit, the coordinator ANDs the bits, and the response's
+``device`` flag tells every rank which plane to dispatch — so a host numpy
+on one rank demotes the collective to the host plane for all, and a
+response flagged ``device`` is dispatched as the same XLA program in the
+same negotiated order on every host (ICI moves the bytes).
+
+Program caching (SURVEY.md §7 "Hard parts" #1): the collective program is
+keyed by (mesh, reduce op, dtype, padded bucket length); fused buckets are
+padded up to a small set of size classes ({1, 1.25, 1.5, 1.75}·2^k
+elements) so steady-state cycles reuse compiled programs even when the
+fusion composition varies cycle to cycle.  Pack/unpack are ordinary jits
+cached by jax on member shapes.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..exceptions import HorovodInternalError
+from ..utils.logging import get_logger
+from ..wire import DataType, OpType, ReduceOp
+
+log = get_logger()
+
+AXIS = "hvdev"
+
+_MIN_BUCKET = 1024
+
+_SUPPORTED_REDUCE = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN,
+                     ReduceOp.MAX, ReduceOp.PRODUCT)
+
+
+def bucket_len(n: int) -> int:
+    """Pad a flat element count up to the {1, 1.25, 1.5, 1.75}·2^k size-class
+    set (<= 25% padding, ~4 compiled programs per octave)."""
+    if n <= _MIN_BUCKET:
+        return _MIN_BUCKET
+    base = 1 << (int(n).bit_length() - 1)  # largest pow2 <= n
+    for num in (4, 5, 6, 7, 8):
+        cls = base * num // 4
+        if n <= cls:
+            return cls
+    return base * 2
+
+
+class DevicePlane:
+    """Executes negotiated ``device=True`` responses as jitted XLA
+    collectives over a one-device-per-rank mesh."""
+
+    def __init__(self, core, cfg):
+        self._core = core
+        self._cfg = cfg
+        mode = os.environ.get("HOROVOD_DEVICE_PLANE", "auto").strip().lower()
+        self._enabled = mode not in ("off", "0", "false", "no")
+        self._lock = threading.Lock()
+        # psid -> (mesh, ranks, my_device) or None (not buildable)
+        self._meshes: Dict[int, Optional[tuple]] = {}
+        self._programs: Dict[tuple, Any] = {}
+        self._pack_fn = None
+        self._unpack_fn = None
+        self._scale_fn = None
+        self.stats = {
+            "allreduce": 0,       # fused device allreduce dispatches
+            "broadcast": 0,       # device broadcast dispatches
+            "identity": 0,        # single-member identity completions
+            "programs_built": 0,  # collective compile-cache misses
+            "host_fallback": 0,   # device-resident entries demoted to host
+        }
+
+    # -- enqueue-side capability -------------------------------------------
+    def adopt(self, array, op: OpType, reduce_op: ReduceOp,
+              psid: int):
+        """The device-resident jax.Array behind ``array`` if this enqueue
+        can ride the device plane, else None (host path).  This decides the
+        rank's announced ``device`` capability bit, so it must only return
+        an array when execute() is guaranteed to succeed locally."""
+        if not self._enabled:
+            return None
+        if op == OpType.ALLREDUCE:
+            if reduce_op not in _SUPPORTED_REDUCE:
+                return None
+        elif op != OpType.BROADCAST:
+            return None
+        try:
+            import jax
+        except ImportError:  # pragma: no cover
+            return None
+        if not isinstance(array, jax.Array) or isinstance(array, jax.core.Tracer):
+            return None
+        if not array.is_fully_addressable:
+            # A multi-process global array is the SAME logical tensor on
+            # every rank — not the per-rank contribution eager collectives
+            # are defined over.
+            return None
+        if array.dtype == bool:
+            return None  # the host plane's logical and/or semantics apply
+        if not self.ready(psid):
+            return None
+        return array
+
+    def note_host_fallback(self, name: str) -> None:
+        """A device-resident tensor was demoted to the host plane by
+        negotiation (a host tensor, unsupported op, or joined rank
+        somewhere).  On TPU that means a chip->PCIe->TCP round-trip per
+        collective — warn once so the perf trap is visible."""
+        with self._lock:
+            self.stats["host_fallback"] += 1
+            warned = getattr(self, "_fallback_warned", False)
+            self._fallback_warned = True
+        if not warned:
+            try:
+                import jax
+
+                on_tpu = jax.default_backend() == "tpu"
+            except Exception:  # pragma: no cover
+                on_tpu = False
+            if on_tpu:
+                log.warning(
+                    "eager collective %r has a device-resident input but was "
+                    "negotiated onto the HOST data plane (another rank "
+                    "submitted a host tensor, an unsupported op/dtype, or a "
+                    "rank is joined) — gradients will cross PCIe + host TCP. "
+                    "Prefer jit/shard_map training steps, or keep every "
+                    "rank's inputs device-resident. (warned once)", name)
+
+    def ready(self, psid: int) -> bool:
+        if self._core.size() == 1:
+            return True
+        return self._mesh_for(psid) is not None
+
+    def invalidate(self, psid: int) -> None:
+        with self._lock:
+            self._meshes.pop(psid, None)
+            for key in [k for k in self._programs if k[0] == psid]:
+                self._programs.pop(key, None)
+
+    # -- mesh / program construction ---------------------------------------
+    def _mesh_for(self, psid: int):
+        """(mesh, ranks, my_device) for the process set, or None when the
+        jax runtime does not span its ranks (single-process jax with np>1,
+        or a rank whose process owns no device)."""
+        with self._lock:
+            if psid in self._meshes:
+                return self._meshes[psid]
+        import jax
+        from jax.sharding import Mesh
+
+        result = None
+        try:
+            ranks = self._core.process_set_ranks(psid)
+            by_proc: Dict[int, Any] = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, d)
+            devs = [by_proc[r] for r in ranks]
+            my = by_proc.get(self._core.rank())
+            # hvd rank <-> jax process mapping comes from
+            # jax.distributed.initialize(process_id=cfg.rank) (basics.init);
+            # if the runtime was wired differently, "my" device may not be
+            # addressable — then the plane cannot place local shards.
+            if my is not None and my in jax.local_devices():
+                mesh = Mesh(np.asarray(devs), (AXIS,))
+                result = (mesh, list(ranks), my)
+        except Exception as exc:  # noqa: BLE001 - capability probe
+            log.debug("device plane unavailable for set %d: %s", psid, exc)
+            result = None
+        if result is not None:
+            # Cache successes only: a transient probe failure (e.g. the
+            # jax distributed runtime still connecting at first enqueue)
+            # must not demote the set to the host plane for the whole job.
+            with self._lock:
+                self._meshes[psid] = result
+        return result
+
+    def _collective(self, psid: int, mesh, rop: ReduceOp, dtype, length: int):
+        """Cached jitted fused-allreduce program over (k, L) global arrays:
+        every member's [1, L] shard in, every member's reduced [1, L] shard
+        out (out_specs stay device-varying so one program shape serves all
+        reduce ops)."""
+        key = (psid, "ar", int(rop), str(np.dtype(dtype)), length,
+               tuple(d.id for d in mesh.devices.flat))
+        with self._lock:
+            fn = self._programs.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from .collectives import ensure_varying
+
+        k = int(mesh.devices.size)
+
+        def inner(x):  # [1, L]: this member's shard
+            if rop == ReduceOp.SUM:
+                out = lax.psum(x, AXIS)
+            elif rop == ReduceOp.AVERAGE:
+                out = lax.psum(x, AXIS) / k
+            elif rop == ReduceOp.MIN:
+                out = lax.pmin(x, AXIS)
+            elif rop == ReduceOp.MAX:
+                out = lax.pmax(x, AXIS)
+            elif rop == ReduceOp.PRODUCT:
+                g = lax.all_gather(x, AXIS, axis=0, tiled=True)
+                out = jax.numpy.prod(g, axis=0, keepdims=True)
+            else:  # pragma: no cover - adopt() filters
+                raise HorovodInternalError(f"unsupported device reduce {rop}")
+            return ensure_varying(out, AXIS)
+
+        fn = jax.jit(shard_map(inner, mesh=mesh, in_specs=P(AXIS, None),
+                               out_specs=P(AXIS, None)))
+        with self._lock:
+            if key not in self._programs:
+                self._programs[key] = fn
+                self.stats["programs_built"] += 1
+            fn = self._programs[key]
+        return fn
+
+    def _broadcast_program(self, psid: int, mesh, dtype, shape, root_pos: int):
+        key = (psid, "bc", str(np.dtype(dtype)), tuple(shape), root_pos,
+               tuple(d.id for d in mesh.devices.flat))
+        with self._lock:
+            fn = self._programs.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        from .collectives import ensure_varying
+
+        ndim = len(shape)
+
+        def inner(x):  # [1, ...]: this member's value
+            idx = lax.axis_index(AXIS)
+            contrib = jnp.where(idx == root_pos, x, jnp.zeros_like(x))
+            return ensure_varying(lax.psum(contrib, AXIS), AXIS)
+
+        spec = P(AXIS, *([None] * ndim))
+        fn = jax.jit(shard_map(inner, mesh=mesh, in_specs=spec,
+                               out_specs=spec))
+        with self._lock:
+            if key not in self._programs:
+                self._programs[key] = fn
+                self.stats["programs_built"] += 1
+            fn = self._programs[key]
+        return fn
+
+    def _pack(self):
+        """Jitted fuse: concat member tensors flat, optional prescale, pad
+        to the bucket length (MemcpyInFusionBuffer analog, on device).
+        Scale factors are static (compile-time constants): an eager
+        ``jnp.asarray(pre)`` would be a host->device scalar transfer, which
+        the no-host-copy guarantee (and its transfer-guard test) forbids."""
+        if self._pack_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def pack(arrays, pre, length):
+                flat = (jnp.concatenate([a.ravel() for a in arrays])
+                        if len(arrays) > 1 else arrays[0].ravel())
+                if pre != 1.0:
+                    flat = flat * jnp.asarray(pre, flat.dtype)
+                pad = length - flat.size
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), flat.dtype)])
+                return flat.reshape(1, length)
+
+            self._pack_fn = jax.jit(pack, static_argnums=(1, 2))
+        return self._pack_fn
+
+    def _unpack(self):
+        """Jitted unfuse: slice the reduced flat bucket back into member
+        shapes, optional postscale (MemcpyOutFusionBuffer analog)."""
+        if self._unpack_fn is None:
+            import jax
+
+            import jax.numpy as jnp
+
+            def unpack(row, post, shapes):
+                flat = row.reshape(-1)
+                outs = []
+                off = 0
+                for shp in shapes:
+                    n = int(np.prod(shp)) if shp else 1
+                    seg = flat[off:off + n].reshape(shp)
+                    if post != 1.0:
+                        seg = seg * jnp.asarray(post, seg.dtype)
+                    outs.append(seg)
+                    off += n
+                return outs
+
+            self._unpack_fn = jax.jit(unpack, static_argnums=(1, 2))
+        return self._unpack_fn
+
+    def _scale(self):
+        if self._scale_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def scale(x, a, b):
+                if a != 1.0:
+                    x = x * jnp.asarray(a, x.dtype)
+                if b != 1.0:
+                    x = x * jnp.asarray(b, x.dtype)
+                return x
+
+            self._scale_fn = jax.jit(scale, static_argnums=(1, 2))
+        return self._scale_fn
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, resp, entries: Sequence) -> None:
+        """Run a negotiated ``device=True`` response; fills entry results
+        with device-resident jax.Arrays (no host copies anywhere in the
+        steady state).
+
+        A response-cache replay carries the bit of the ORIGINAL
+        negotiation, so a tensor that flipped device->host since then can
+        arrive here without a device array — place its host bytes on
+        device explicitly (one slow step, correct result; the response
+        cache evicts/re-learns the signature only when metadata changes,
+        not the plane)."""
+        import jax
+
+        for e in entries:
+            if e.device_array is None:
+                e.device_array = jax.device_put(np.ascontiguousarray(e.array))
+                with self._lock:
+                    self.stats["late_device_put"] = (
+                        self.stats.get("late_device_put", 0) + 1)
+        if resp.op == OpType.ALLREDUCE:
+            self._exec_allreduce(resp, entries)
+        elif resp.op == OpType.BROADCAST:
+            self._exec_broadcast(resp, entries[0])
+        else:
+            raise HorovodInternalError(
+                f"op {resp.op} is not served by the device plane")
+
+    def _members(self, psid: int) -> List[int]:
+        return self._core.process_set_ranks(psid)
+
+    def _exec_allreduce(self, resp, entries: Sequence) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        psid = resp.process_set_id
+        rop = entries[0].reduce_op
+        pre = entries[0].prescale_factor
+        post = entries[0].postscale_factor
+        if len(self._members(psid)) == 1:
+            # Single-member set: every supported reduce op is the identity
+            # (modulo scale factors) — complete without any data movement,
+            # preserving each input's sharding.
+            for e in entries:
+                x = e.device_array
+                if pre != 1.0 or post != 1.0:
+                    x = self._scale()(x, float(pre), float(post))
+                e.result = x
+            with self._lock:
+                self.stats["identity"] += len(entries)
+            return
+
+        mesh, ranks, my_dev = self._mesh_for(psid)
+        arrays = [jax.device_put(e.device_array, my_dev) for e in entries]
+        dtype = arrays[0].dtype
+        total = int(sum(a.size for a in arrays))
+        length = bucket_len(total)
+        packed = jax.device_put(
+            self._pack()(tuple(arrays), float(pre), length), my_dev)
+        garr = self._to_global(mesh, [packed])
+        out = self._collective(psid, mesh, rop, dtype, length)(garr)
+        row = self._shard_on(out, my_dev)
+        shapes = tuple(tuple(e.device_array.shape) for e in entries)
+        results = self._unpack()(row, float(post), shapes)
+        for e, r in zip(entries, results):
+            e.result = r
+        with self._lock:
+            self.stats["allreduce"] += 1
+
+    def _exec_broadcast(self, resp, entry) -> None:
+        import jax
+
+        psid = resp.process_set_id
+        members = self._members(psid)
+        if len(members) == 1:
+            entry.result = entry.device_array
+            with self._lock:
+                self.stats["identity"] += 1
+            return
+        mesh, ranks, my_dev = self._mesh_for(psid)
+        root_pos = ranks.index(entry.root_rank)
+        x = jax.device_put(entry.device_array, my_dev)
+        garr = self._to_global(mesh, [x[None]])
+        fn = self._broadcast_program(psid, mesh, x.dtype, x.shape, root_pos)
+        out = fn(garr)
+        entry.result = self._shard_on(out, my_dev).reshape(x.shape)
+        with self._lock:
+            self.stats["broadcast"] += 1
+
+    # -- global-array plumbing (shared with the simulation tests) ----------
+    def _to_global(self, mesh, rows: List):
+        """Assemble per-member [1, ...] rows into the (k, ...) global array.
+        In production ``rows`` holds this process's single shard; the
+        simulation tests (and the dryrun gate) pass one row per mesh device
+        of a local mesh — the same code path either way, zero-copy."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row0 = rows[0]
+        k = int(mesh.devices.size)
+        sharding = NamedSharding(mesh, P(AXIS, *([None] * (row0.ndim - 1))))
+        gshape = (k,) + tuple(row0.shape[1:])
+        if len(rows) > 1:
+            # Simulation: commit row i to mesh device i.
+            rows = [jax.device_put(r, d)
+                    for r, d in zip(rows, list(mesh.devices.flat))]
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, rows)
+
+    @staticmethod
+    def _shard_on(garr, device):
+        """The [1, ...] result shard residing on ``device``."""
+        for s in garr.addressable_shards:
+            if s.device == device:
+                return s.data
+        raise HorovodInternalError(
+            "device plane result has no shard on the local mesh device")

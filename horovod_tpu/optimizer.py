@@ -13,10 +13,16 @@ ranks:
 
 - **inside jit / shard_map** (tracers): gradients compile to XLA
   collectives over the named mesh axis — one fused psum per dtype after XLA's
-  collective combining, riding ICI;
+  collective combining, riding ICI.  This is the recommended path: the
+  whole train step is one compiled program with compute/communication
+  overlap scheduled by XLA;
 - **eager**: every leaf is enqueued async into the core runtime and then
-  synchronized, which is exactly the reference's hook-then-synchronize
-  overlap and engages tensor fusion in the core.
+  synchronized — the reference's hook-then-synchronize overlap, with
+  tensor fusion in the core.  Device-resident (jax.Array) gradients
+  execute on the eager device plane (``ops.device_plane`` — cached jitted
+  fused collectives, no host copies) once negotiation confirms every rank
+  can; host numpy gradients (or a rank without a device mesh) ride the
+  host TCP plane, and device tensors demoted to it warn once on TPU.
 
 ``backward_passes_per_step`` accumulates gradients locally and only
 communicates (and applies the inner optimizer) every k-th call, built with

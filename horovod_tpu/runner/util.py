@@ -160,12 +160,17 @@ def ssh_command(ssh_port=None, connect_timeout=None) -> List[str]:
     """
     override = os.environ.get("HOROVOD_SSH_COMMAND")
     if override:
+        # Warn only on the user-passed --ssh-port: connect_timeout is an
+        # internal default on some call sites (driver_service preflight),
+        # so warning on it alone would fire spuriously for every override
+        # user.  The message still names both dropped option kinds.
         if ssh_port:
             import warnings
 
             warnings.warn(
-                "HOROVOD_SSH_COMMAND is set; --ssh-port/-p is ignored — "
-                "bake the port into the override command instead.")
+                "HOROVOD_SSH_COMMAND is set; --ssh-port/-p (and any "
+                "ConnectTimeout option) are ignored — bake them into the "
+                "override command instead.")
         return shlex.split(override)
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if connect_timeout:

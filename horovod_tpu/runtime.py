@@ -10,14 +10,17 @@ RunLoopOnce, tensor_queue.cc, global_state.h; SURVEY.md §3.2):
   runs the background cycle loop: readiness negotiation across ranks, tensor
   fusion into buckets, response caching, stall inspection.
 - An *executor thread* pops fused responses from the core and runs the data
-  plane: XLA collectives for device-sharded arrays, the core's host collectives
-  (TCP) for host arrays in multi-process mode, identity at size()==1.
+  plane: the eager device plane (``ops.device_plane`` — cached jitted fused
+  XLA collectives) for responses negotiated ``device=True``, the core's host
+  collectives (TCP) otherwise, identity at size()==1.
 - ``synchronize(handle)`` blocks on completion; ``poll(handle)`` checks.
 
 The crucial TPU-first property: a response list is negotiated to be *identical
-on every rank*, so in multi-host SPMD mode every host dispatches the same
-cached, jitted fused-collective XLA program — negotiation keeps hosts in
-lockstep, XLA+ICI move the bytes (no NCCL/MPI anywhere).
+on every rank*, including a per-response ``device`` bit that is the AND of
+every rank's capability (a device-resident jax.Array + a ready rank mesh),
+so in multi-host SPMD mode every host dispatches the same cached, jitted
+fused-collective XLA program — negotiation keeps hosts in lockstep, XLA+ICI
+move the bytes (no NCCL/MPI anywhere).
 """
 
 from __future__ import annotations
@@ -53,6 +56,11 @@ class TensorEntry:
     process_set_id: int = 0
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # Atomic grouped negotiation (reference: group_table.cc): members of a
+    # group (same non-empty key) become ready all-or-nothing and are
+    # emitted contiguously.
+    group_key: str = ""
+    group_size: int = 0
     # completion
     result: Any = None
     recv_splits: Optional[np.ndarray] = None  # alltoall receive splits
@@ -62,7 +70,11 @@ class TensorEntry:
     # framework round-trip info
     was_jax: bool = False
     orig_dtype: Any = None
-    sharding: Any = None
+    # Device-plane input: the original device-resident jax.Array (None for
+    # host entries) — the source of the enqueue-side ``device`` bit.  It
+    # carries its own .sharding, which the single-member identity path
+    # preserves by returning the array itself.
+    device_array: Any = None
 
 
 @dataclasses.dataclass
@@ -92,6 +104,10 @@ class FusedResponse:
     # dispatcher sees responses in global negotiated order, so the flag is
     # order-correct even when finalization happens on concurrent lanes.
     joined_at_dispatch: bool = False
+    # Negotiated data plane: True only when EVERY rank announced device
+    # capability for every member (the coordinator ANDs the bits) — then
+    # all ranks MUST dispatch the device plane's cached jitted collective.
+    device: bool = False
 
 
 class CoreBackend:
@@ -239,6 +255,10 @@ class PyLocalCore(CoreBackend):
         self._psets: Optional[_ProcessSetTable] = None
         self.timeline = Timeline()
         self._last_stall_warn = 0.0
+        # Names already reported as stalled: a NEW stall always warns at
+        # first detection; only repeats are rate-limited.  Completion
+        # clears a name so a later stall of the same tensor warns afresh.
+        self._stall_warned: set = set()
 
     def start(self, cfg: Config) -> None:
         self._cfg = cfg
@@ -321,7 +341,9 @@ class PyLocalCore(CoreBackend):
                 with self._queue_lock:
                     for r in responses:
                         for h in r.handles:
-                            self._awaiting.pop(h, None)
+                            done = self._awaiting.pop(h, None)
+                            if done is not None:
+                                self._stall_warned.discard(done.name)
                 with self._resp_cv:
                     self._responses.extend(responses)
                     self._resp_cv.notify_all()
@@ -331,7 +353,32 @@ class PyLocalCore(CoreBackend):
         """Single-rank negotiation: everything enqueued is ready; fuse
         consecutive allreduces of matching (dtype, process set, reduce op)
         up to the fusion threshold — same bucketing rule the native
-        controller uses."""
+        controller uses.  Grouped tensors are held until their whole group
+        has arrived, then released contiguously at the first member's
+        arrival position (group_table.cc all-or-nothing analog — a grouped
+        enqueue can race the cycle drain mid-call)."""
+        held = getattr(self, "_held_groups", [])
+        if not held and not any(e.group_key for e in pending):
+            return self._fuse_ready(pending)
+        work = held + pending
+        gstate: Dict[str, List[int]] = {}
+        for i, e in enumerate(work):
+            if e.group_key:
+                gstate.setdefault(e.group_key, []).append(i)
+        still_held: List[TensorEntry] = []
+        keyed: List[tuple] = []
+        for i, e in enumerate(work):
+            if not e.group_key:
+                keyed.append(((i, i), e))
+            elif len(gstate[e.group_key]) < e.group_size:
+                still_held.append(e)
+            else:
+                keyed.append(((gstate[e.group_key][0], i), e))
+        self._held_groups = still_held
+        keyed.sort(key=lambda t: t[0])
+        return self._fuse_ready([e for _, e in keyed])
+
+    def _fuse_ready(self, pending: List[TensorEntry]) -> List[FusedResponse]:
         responses: List[FusedResponse] = []
         bucket: List[TensorEntry] = []
         bucket_bytes = 0
@@ -347,6 +394,7 @@ class PyLocalCore(CoreBackend):
                         dtype=bucket[0].dtype,
                         process_set_id=bucket[0].process_set_id,
                         handles=[e.handle for e in bucket],
+                        device=bucket[0].device_array is not None,
                     )
                 )
                 bucket, bucket_bytes = [], 0
@@ -361,6 +409,9 @@ class PyLocalCore(CoreBackend):
                     and bucket[0].reduce_op == e.reduce_op
                     and bucket[0].prescale_factor == e.prescale_factor
                     and bucket[0].postscale_factor == e.postscale_factor
+                    # device buckets stay pure (one data plane per response)
+                    and ((bucket[0].device_array is None)
+                         == (e.device_array is None))
                     and bucket_bytes + nbytes <= self._cfg.fusion_threshold_bytes
                 )
                 if not fusable:
@@ -379,6 +430,7 @@ class PyLocalCore(CoreBackend):
                         # single process: this rank is trivially the last
                         # (and only) joiner
                         last_joined=0 if e.op == OpType.JOIN else -1,
+                        device=e.device_array is not None,
                     )
                 )
         flush()
@@ -389,14 +441,24 @@ class PyLocalCore(CoreBackend):
         if not cfg.stall_check_enabled:
             return
         now = time.monotonic()
-        if now - self._last_stall_warn < cfg.stall_warning_s:
-            return
+        # Snapshot + mark under ONE lock hold: a completion between two
+        # separate sections could discard a name from _stall_warned only
+        # for a stale re-add to suppress its next first-detection warning.
         with self._queue_lock:
             stalled = [e.name for e in self._awaiting.values()
                        if now - e.enqueued_at > cfg.stall_warning_s]
-        if stalled:
+            if not stalled:
+                return
+            fresh = [n for n in stalled if n not in self._stall_warned]
+            # Rate-limit REPEATS only: a tensor stalling for the first
+            # time warns immediately even if an unrelated warning just
+            # fired (reference: stall_inspector.cc reports per tensor,
+            # not per window).
+            if not fresh and now - self._last_stall_warn < cfg.stall_warning_s:
+                return
             self._last_stall_warn = now
-            log.warning(
-                "Stall detected: %d tensor(s) waiting > %.0fs for negotiation: %s",
-                len(stalled), cfg.stall_warning_s, ", ".join(stalled[:8]),
-            )
+            self._stall_warned.update(stalled)
+        log.warning(
+            "Stall detected: %d tensor(s) waiting > %.0fs for negotiation: %s",
+            len(stalled), cfg.stall_warning_s, ", ".join(stalled[:8]),
+        )

@@ -1,0 +1,120 @@
+"""Eager device plane end-to-end at np=2: the negotiated ``device`` bit
+drives every rank to dispatch the same cached jitted fused collective over
+a one-device-per-rank mesh (reference analog: ops/nccl_operations.cc — the
+eager data plane executes on the accelerator; SURVEY.md §2.2).
+
+Two CPU processes under jax.distributed stand in for two TPU hosts: the
+jitted psum rides jax's cross-process CPU transport the way it rides ICI on
+a pod — same programs, same negotiation, same dispatch path.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKER = textwrap.dedent("""
+    import os
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np, jax.numpy as jnp
+    import horovod_tpu as hvd
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init()
+    assert jax.process_count() == 2, jax.process_count()
+    rank = hvd.rank()
+    stats = HorovodContext.instance().device_plane.stats
+
+    # Device-negotiated fused allreduce: jax.Array in, jax.Array out,
+    # executed as a jitted psum over the rank mesh (no host TCP ring).
+    x = jnp.full((3, 4), float(rank + 1), jnp.float32)
+    r = hvd.allreduce(x, op=hvd.Sum, name="devsum")
+    assert isinstance(r, jax.Array), type(r)
+    assert np.allclose(np.asarray(r), 3.0), np.asarray(r)
+    assert stats["allreduce"] == 1, stats
+
+    # Grouped -> one fused device bucket.
+    outs = hvd.grouped_allreduce(
+        [jnp.full((4,), float(rank + i), jnp.float32) for i in range(6)],
+        op=hvd.Sum, name="devgroup")
+    for i, o in enumerate(outs):
+        assert np.allclose(np.asarray(o), 2.0 * i + 1.0), (i, np.asarray(o))
+
+    # Steady state: the same bucket class reuses the compiled program.
+    built = stats["programs_built"]
+    for it in range(5):
+        g = hvd.allreduce(x, op=hvd.Sum, name="steady")
+        assert np.allclose(np.asarray(g), 3.0)
+    assert stats["programs_built"] == built, stats
+
+    # Reduce-op coverage on the device plane.
+    assert np.allclose(np.asarray(hvd.allreduce(x, op=hvd.Average,
+                                                name="devavg")), 1.5)
+    assert np.allclose(np.asarray(hvd.allreduce(x, op=hvd.Min,
+                                                name="devmin")), 1.0)
+    assert np.allclose(np.asarray(hvd.allreduce(x, op=hvd.Max,
+                                                name="devmax")), 2.0)
+    assert np.allclose(np.asarray(hvd.allreduce(x, op=hvd.Product,
+                                                name="devprod")), 2.0)
+    assert np.allclose(np.asarray(hvd.allreduce(
+        x, op=hvd.Sum, name="devscale",
+        prescale_factor=0.5, postscale_factor=3.0)), 4.5)
+
+    # Broadcast on the device plane, each root.
+    for root in range(2):
+        b = hvd.broadcast(jnp.full((4,), float(rank * 10), jnp.float32),
+                          root_rank=root, name=f"devbc{root}")
+        assert np.allclose(np.asarray(b), float(root * 10)), np.asarray(b)
+
+    # Mixed planes: one rank submits numpy -> the coordinator ANDs the
+    # device bits to 0 and BOTH ranks ride the host plane, correctly.
+    if rank == 0:
+        m = hvd.allreduce(np.full((2,), 5.0, np.float32), op=hvd.Sum,
+                          name="mixed")
+    else:
+        m = hvd.allreduce(jnp.full((2,), 7.0, jnp.float32), op=hvd.Sum,
+                          name="mixed")
+    assert np.allclose(np.asarray(m), 12.0), np.asarray(m)
+    assert stats["host_fallback"] == (1 if rank == 1 else 0), (rank, stats)
+
+    # join(): device traffic keeps flowing while rank 1 is joined — the
+    # coordinator demotes via-join responses to the host plane so the
+    # joined rank can zero-participate.
+    if rank == 0:
+        j = hvd.allreduce(jnp.full((3,), 4.0, jnp.float32), op=hvd.Sum,
+                          name="joinsum")
+        assert np.allclose(np.asarray(j), 4.0), np.asarray(j)
+        hvd.join()
+    else:
+        hvd.join()
+
+    assert stats["allreduce"] >= 8, stats
+    print(f"DEVPLANE OK rank={rank} stats={stats}")
+    hvd.shutdown()
+""")
+
+
+def test_device_plane_np2():
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        # One device per worker process: the rank mesh is 2 processes x 1.
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+             "--jax-distributed", sys.executable, script],
+            capture_output=True, text=True, timeout=240, env=env, cwd=td)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert proc.stdout.count("DEVPLANE OK") == 2, proc.stdout

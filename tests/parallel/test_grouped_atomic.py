@@ -1,0 +1,121 @@
+"""Atomic grouped negotiation (reference: group_table.cc — GroupTable):
+all-or-nothing readiness across ranks, contiguous emission (no interleaving
+with other traffic), and group-shortfall stall reporting.
+
+np=3 workers under the socket controller; member submission is deliberately
+staggered across ranks and interleaved with independent traffic.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner import run
+
+
+def _atomic_group_worker():
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import mpi_ops
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    assert s == 3
+
+    # 1) All-or-nothing: ranks submit the group members one at a time with
+    # rank-dependent staggering, interleaved with independent traffic.  No
+    # member may complete before the LAST member is submitted on the LAST
+    # rank — negotiation must withhold the whole group.  The async enqueue
+    # API controls timing per member.
+    from horovod_tpu.context import HorovodContext
+
+    k = 4
+
+    ctx = HorovodContext.instance()
+    gkey = ctx.group_key_for("grp")
+    hs = []
+    for i in range(k - 1):
+        hs.append(ctx.enqueue(np.full(8, float(r + i), np.float32),
+                              mpi_ops.OpType.ALLREDUCE, name=f"grp.{i}",
+                              reduce_op=hvd.Sum, group_key=gkey,
+                              group_size=k))
+        # more independent traffic that must NOT interleave into the group
+        mpi_ops.allreduce(np.full(2, 2.0, np.float32), op=hvd.Sum,
+                          name=f"mid.{i}")
+    # All but the last member are in flight on every rank; give negotiation
+    # ample cycles — nothing may complete (all-or-nothing).
+    time.sleep(1.0)
+    assert not any(mpi_ops.poll(h) for h in hs), \
+        "group members completed before the group was complete"
+
+    # Rank-staggered release of the final member.
+    time.sleep(0.2 * r)
+    hs.append(ctx.enqueue(np.full(8, float(r + k - 1), np.float32),
+                          mpi_ops.OpType.ALLREDUCE, name=f"grp.{k-1}",
+                          reduce_op=hvd.Sum, group_key=gkey, group_size=k))
+    for i, h in enumerate(hs):
+        out = mpi_ops.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), 3.0 * i + 3.0)  # sum r
+
+    # 2) The public grouped API end-to-end with staggered ranks.
+    time.sleep(0.1 * r)
+    outs = hvd.grouped_allreduce(
+        [np.full(4, float(r * 10 + i), np.float32) for i in range(5)],
+        op=hvd.Sum, name="pub")
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(np.asarray(o), 30.0 + 3 * i)
+
+    # grouped allgather + reducescatter keep working under group gating
+    g = hvd.grouped_allgather(
+        [np.full((1, 2), float(r), np.float32) for _ in range(3)],
+        name="pubag")
+    for o in g:
+        np.testing.assert_allclose(np.asarray(o).ravel(),
+                                   [0.0, 0.0, 1.0, 1.0, 2.0, 2.0])
+
+    hvd.barrier()
+    hvd.shutdown()
+    return r
+
+
+def test_grouped_atomicity_np3():
+    assert run(_atomic_group_worker, np=3) == [0, 1, 2]
+
+
+def _missing_member_stall_worker():
+    """A group whose last member is never submitted anywhere must stall
+    (watchdog shutdown) with a group-shortfall report, and must NOT
+    complete partially."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import mpi_ops
+    from horovod_tpu.context import HorovodContext
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    hvd.init(build_mesh=False)
+    ctx = HorovodContext.instance()
+    gkey = ctx.group_key_for("dead")
+    hs = [ctx.enqueue(np.full(4, 1.0, np.float32), mpi_ops.OpType.ALLREDUCE,
+                      name=f"dead.{i}", reduce_op=hvd.Sum, group_key=gkey,
+                      group_size=3)
+          for i in range(2)]  # member 2 never comes
+    # Independent traffic still flows while the group is withheld.
+    out = mpi_ops.allreduce(np.full(4, 1.0, np.float32), op=hvd.Sum,
+                            name="alive")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    # The stall watchdog (HOROVOD_STALL_SHUTDOWN_TIME_SECONDS) kills the
+    # job; every group handle must fail, not hang or half-complete.
+    try:
+        for h in hs:
+            mpi_ops.synchronize(h)
+        return "completed"  # would be the atomicity bug
+    except HorovodInternalError:
+        return "stalled"
+
+
+def test_group_missing_member_stalls_np2():
+    results = run(_missing_member_stall_worker, np=2,
+                  env={"HOROVOD_STALL_WARNING_TIME_SECONDS": "1",
+                       "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "4"})
+    assert results == ["stalled", "stalled"]

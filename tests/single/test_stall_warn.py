@@ -1,0 +1,96 @@
+"""Stall-inspector first-detection semantics (runtime.PyLocalCore):
+a NEWLY stalled tensor warns immediately even inside the rate-limit window
+of an earlier, unrelated warning; repeats of known stalls stay limited; a
+name that completes and stalls again warns afresh.
+
+Reference: stall_inspector.cc reports per tensor, not per window
+(SURVEY.md §2.1)."""
+
+import logging
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runtime import PyLocalCore, TensorEntry
+from horovod_tpu.utils.env import Config
+from horovod_tpu.utils.logging import get_logger
+from horovod_tpu.wire import OpType, wire_dtype
+
+
+@contextmanager
+def capture_warnings():
+    """The package logger has propagate=False, so caplog can't see it —
+    attach a capturing handler directly."""
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture(level=logging.WARNING)
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
+def _core(warn_s=30.0):
+    core = PyLocalCore()
+    core._cfg = Config(stall_check_enabled=True, stall_warning_s=warn_s)
+    return core
+
+
+def _stalled_entry(handle, name, age_s, warn_s=30.0):
+    arr = np.zeros(4, np.float32)
+    e = TensorEntry(handle=handle, name=name, op=OpType.ALLREDUCE,
+                    array=arr, dtype=wire_dtype(arr.dtype))
+    e.enqueued_at = time.monotonic() - age_s
+    return e
+
+
+def test_new_stall_warns_inside_rate_window():
+    core = _core(warn_s=30.0)
+    core._awaiting[1] = _stalled_entry(1, "first", age_s=60.0)
+    with capture_warnings() as records:
+        core._check_stalls()
+        assert sum("Stall detected" in m for m in records) == 1
+        assert "first" in records[-1]
+
+        # Second, DIFFERENT tensor stalls immediately afterwards — well
+        # inside the 30s window: must still warn at first detection.
+        core._awaiting[2] = _stalled_entry(2, "second", age_s=60.0)
+        core._check_stalls()
+        assert sum("Stall detected" in m for m in records) == 2
+        assert "second" in records[-1]
+
+        # No new stalls: repeat stays rate-limited.
+        core._check_stalls()
+        assert sum("Stall detected" in m for m in records) == 2
+
+
+def test_completed_then_restalled_name_warns_again():
+    core = _core(warn_s=30.0)
+    core._awaiting[1] = _stalled_entry(1, "grad.0", age_s=60.0)
+    with capture_warnings() as records:
+        core._check_stalls()
+        assert sum("Stall detected" in m for m in records) == 1
+        # Completion clears the warned marker (mirrors the cycle loop's
+        # _awaiting.pop bookkeeping).
+        done = core._awaiting.pop(1)
+        core._stall_warned.discard(done.name)
+        # Same name stalls again later (duplicate-name resubmission).
+        core._awaiting[2] = _stalled_entry(2, "grad.0", age_s=60.0)
+        core._check_stalls()
+        assert sum("Stall detected" in m for m in records) == 2
+
+
+def test_no_warning_when_nothing_stalled():
+    core = _core(warn_s=30.0)
+    core._awaiting[1] = _stalled_entry(1, "young", age_s=1.0)
+    with capture_warnings() as records:
+        core._check_stalls()
+    assert not any("Stall detected" in m for m in records)

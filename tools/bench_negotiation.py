@@ -1,0 +1,116 @@
+"""Steady-state negotiation benchmark: response-cache on vs off vs
+fusion-off at np=4 (SURVEY.md §5 — "the response-cache bit-vector trick
+matters even more on TPU": DCN round-trips are pricier than MPI ones).
+
+Measures, per configuration:
+- steady-state cycle throughput (gradient-bucket steps/s, 50 named
+  tensors per step, the DistributedOptimizer eager shape), and
+- negotiation ctrl-channel bytes per step on a worker rank (cache hits
+  travel as 16-byte (id, handle) pairs; misses re-serialize the full
+  request metadata every cycle).
+
+Usage: python tools/bench_negotiation.py [--np 4] [--steps 60]
+Prints one JSON line per configuration plus a summary ratio line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _worker(steps: int, tensors: int):
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import mpi_ops
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init(build_mesh=False)
+    grads = [np.full(64, float(i), np.float32) for i in range(tensors)]
+
+    def step(tag):
+        hs = [mpi_ops.allreduce_async(g, name=f"grad.{i}", op=hvd.Sum)
+              for i, g in enumerate(grads)]
+        for h in hs:
+            mpi_ops.synchronize(h)
+
+    # Warmup: populate the response cache / reach steady state.
+    for s in range(5):
+        step(s)
+    core = HorovodContext.instance().core
+    stats0 = core.negotiation_stats() if hasattr(core, "negotiation_stats") \
+        else None
+    t0 = time.perf_counter()
+    for s in range(steps):
+        step(s)
+    dt = time.perf_counter() - t0
+    result = {"rank": hvd.rank(), "steps_per_s": steps / dt,
+              "tensor_ops_per_s": steps * len(grads) / dt}
+    if stats0 is not None:
+        stats1 = core.negotiation_stats()
+        result["ctrl_bytes_per_step"] = (
+            (stats1["ctrl_sent"] + stats1["ctrl_recv"]
+             - stats0["ctrl_sent"] - stats0["ctrl_recv"]) / steps)
+    hvd.shutdown()
+    return result
+
+
+def run_config(name: str, env: dict, np_: int, steps: int, tensors: int):
+    from horovod_tpu.runner import run
+
+    full_env = {"JAX_PLATFORMS": "cpu", **env}
+    results = run(_worker, args=(steps, tensors), np=np_, env=full_env,
+                  stream_prefix=False)
+    agg = {
+        "config": name,
+        "np": np_,
+        "steps_per_s": round(min(r["steps_per_s"] for r in results), 2),
+        "tensor_ops_per_s": round(
+            min(r["tensor_ops_per_s"] for r in results), 1),
+    }
+    per_step = [r.get("ctrl_bytes_per_step") for r in results[1:]]
+    if per_step and per_step[0] is not None:
+        # Worker ranks only: the coordinator's ctrl traffic counts every
+        # worker's frames and would double-book.
+        agg["worker_ctrl_bytes_per_step"] = round(max(per_step), 1)
+    print(json.dumps(agg), flush=True)
+    return agg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--np", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--tensors", type=int, default=50)
+    args = ap.parse_args()
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    cache_on = run_config("cache_on", {}, args.np, args.steps, args.tensors)
+    cache_off = run_config("cache_off", {"HOROVOD_CACHE_CAPACITY": "0"},
+                           args.np, args.steps, args.tensors)
+    fusion_off = run_config(
+        "fusion_off", {"HOROVOD_FUSION_THRESHOLD": "1"},
+        args.np, args.steps, args.tensors)
+
+    summary = {
+        "metric": "negotiation_cache_speedup",
+        "steps_ratio_cache_on_vs_off": round(
+            cache_on["steps_per_s"] / cache_off["steps_per_s"], 3),
+        "steps_ratio_cache_on_vs_fusion_off": round(
+            cache_on["steps_per_s"] / fusion_off["steps_per_s"], 3),
+    }
+    if "worker_ctrl_bytes_per_step" in cache_on and \
+            "worker_ctrl_bytes_per_step" in cache_off:
+        summary["ctrl_bytes_ratio_on_vs_off"] = round(
+            cache_on["worker_ctrl_bytes_per_step"]
+            / max(cache_off["worker_ctrl_bytes_per_step"], 1.0), 3)
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

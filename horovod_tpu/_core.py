@@ -109,6 +109,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_remove_process_set.argtypes = [c.c_int]
     lib.hvd_process_set_ranks.restype = c.c_int
     lib.hvd_process_set_ranks.argtypes = [c.c_int, c.POINTER(c.c_int), c.c_int]
+    lib.hvd_negotiation_stats.argtypes = [
+        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.hvd_start_timeline.argtypes = [c.c_char_p, c.c_int]
     lib.hvd_stop_timeline.argtypes = []
     lib.hvd_last_error.restype = c.c_char_p
@@ -355,6 +357,16 @@ class NativeCore(CoreBackend):
         self._check(rc, "barrier")
 
     # -- observability ------------------------------------------------------
+    def negotiation_stats(self) -> dict:
+        """Cumulative negotiation ctrl-channel payload bytes for this rank
+        (the response-cache fast path's measurable effect: hits travel as
+        16-byte (id, handle) pairs instead of full request metadata)."""
+        sent = ctypes.c_longlong()
+        recv = ctypes.c_longlong()
+        self._lib.hvd_negotiation_stats(ctypes.byref(sent),
+                                        ctypes.byref(recv))
+        return {"ctrl_sent": sent.value, "ctrl_recv": recv.value}
+
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         self._lib.hvd_start_timeline(path.encode(), 1 if mark_cycles else 0)
 

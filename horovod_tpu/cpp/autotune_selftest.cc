@@ -34,29 +34,59 @@ double Surface(double x0, double x1, unsigned* rng) {
 }  // namespace
 
 int main() {
-  BayesianOptimizer bo;
-  unsigned rng = 12345;
-  // First probe: a deliberately bad corner (tiny fusion, huge cycle).
-  double x0 = 0.05, x1 = 0.95;
-  double first_score = Surface(x0, x1, &rng);
-  bo.AddSample(x0, x1, first_score);
-  for (int round = 0; round < 30; ++round) {
-    bo.Suggest(&x0, &x1);
-    bo.AddSample(x0, x1, Surface(x0, x1, &rng));
+  {
+    BayesianOptimizer bo;
+    unsigned rng = 12345;
+    // First probe: a deliberately bad corner (tiny fusion, huge cycle).
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0;
+    double first_score = Surface(x0, x1, &rng);
+    bo.AddSample(x0, x1, x2, first_score);
+    for (int round = 0; round < 30; ++round) {
+      bo.Suggest(&x0, &x1, &x2);
+      bo.AddSample(x0, x1, x2, Surface(x0, x1, &rng));
+    }
+    double bx0, bx1, bx2, best;
+    bo.Best(&bx0, &bx1, &bx2, &best);
+    std::printf("first=%.3e best=%.3e at (%.2f, %.2f, %.0f)\n", first_score,
+                best, bx0, bx1, bx2);
+    // The optimum value is ~1e9; the bad corner scores ~0.  Require the
+    // optimizer to have found at least 80% of the peak.
+    if (best < 0.8e9) {
+      std::printf("FAIL: best score did not approach the optimum\n");
+      return 1;
+    }
+    if (best <= first_score * 2) {
+      std::printf("FAIL: no improvement over the initial configuration\n");
+      return 1;
+    }
   }
-  double bx0, bx1, best;
-  bo.Best(&bx0, &bx1, &best);
-  std::printf("first=%.3e best=%.3e at (%.2f, %.2f)\n", first_score, best,
-              bx0, bx1);
-  // The optimum value is ~1e9; the bad corner scores ~0.  Require the
-  // optimizer to have found at least 80% of the peak.
-  if (best < 0.8e9) {
-    std::printf("FAIL: best score did not approach the optimum\n");
-    return 1;
-  }
-  if (best <= first_score * 2) {
-    std::printf("FAIL: no improvement over the initial configuration\n");
-    return 1;
+  {
+    // Categorical dimension: the same continuous surface, but category 1
+    // (e.g. cache-announce on) scores 25% higher everywhere.  The
+    // optimizer must converge onto category 1 (reference analog:
+    // ParameterManager's categorical cache/hierarchical flags).
+    BayesianOptimizer bo;
+    unsigned rng = 777;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0;
+    bo.AddSample(x0, x1, x2, Surface(x0, x1, &rng));
+    for (int round = 0; round < 30; ++round) {
+      bo.Suggest(&x0, &x1, &x2);
+      double s = Surface(x0, x1, &rng) * (x2 >= 0.5 ? 1.25 : 1.0);
+      bo.AddSample(x0, x1, x2, s);
+    }
+    double bx0, bx1, bx2, best;
+    bo.Best(&bx0, &bx1, &bx2, &best);
+    std::printf("categorical best=%.3e at (%.2f, %.2f, cat=%.0f)\n", best,
+                bx0, bx1, bx2);
+    if (bx2 < 0.5) {
+      std::printf("FAIL: categorical knob did not converge to the better "
+                  "arm\n");
+      return 1;
+    }
+    if (best < 0.8 * 1.25e9) {
+      std::printf("FAIL: categorical surface peak not approached\n");
+      return 1;
+    }
   }
   std::printf("PASS\n");
   return 0;

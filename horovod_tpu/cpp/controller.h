@@ -156,6 +156,15 @@ class Controller {
   // it yet (reference: stall_inspector.cc per-rank missing lists).
   virtual std::string StallReport(double older_than_s) { return ""; }
 
+  // Cumulative negotiation ctrl-channel payload bytes (sent, received) by
+  // this rank — the cache bit-vector fast path's measurable effect: cache
+  // hits travel as 16-byte (id, handle) pairs instead of full request
+  // metadata.  Local controller: zero (no sockets).
+  virtual void NegotiationStats(int64_t* sent, int64_t* recv) const {
+    *sent = 0;
+    *recv = 0;
+  }
+
  protected:
   CoreConfig cfg_;
   ProcessSetTable process_sets_;

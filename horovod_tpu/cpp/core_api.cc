@@ -255,7 +255,12 @@ void BackgroundLoop() {
       g->fusion_threshold.store(fusion);
       g->cycle_ms = cycle;
       g->cfg.fusion_threshold = fusion;
-      HVD_LOG(DEBUG) << "autotune: fusion=" << fusion << " cycle_ms=" << cycle;
+      // Categorical knob: worker-side cache announce (safe per rank —
+      // inserts stay deterministic either way).
+      auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+      if (sc) sc->SetAnnounceCache(g->params.announce_cache());
+      HVD_LOG(DEBUG) << "autotune: fusion=" << fusion << " cycle_ms=" << cycle
+                     << " announce_cache=" << g->params.announce_cache();
     }
 
     double now = MonotonicSeconds();
@@ -578,6 +583,17 @@ int hvd_process_set_ranks(int id, int* out, int cap) {
   if (static_cast<int>(ranks.size()) > cap) return -3;
   for (size_t i = 0; i < ranks.size(); ++i) out[i] = ranks[i];
   return static_cast<int>(ranks.size());
+}
+
+void hvd_negotiation_stats(long long* sent, long long* recv) {
+  if (g == nullptr) {
+    *sent = *recv = 0;
+    return;
+  }
+  int64_t s = 0, r = 0;
+  g->controller->NegotiationStats(&s, &r);
+  *sent = s;
+  *recv = r;
 }
 
 void hvd_start_timeline(const char* path, int mark_cycles) {

@@ -44,9 +44,16 @@ double XToCycle(double x) {
                                             kMinCycleMs));
 }
 
-double Rbf(double ax, double ay, double bx, double by) {
-  double dx = ax - bx, dy = ay - by;
-  return std::exp(-(dx * dx + dy * dy) / (2 * kLengthscale * kLengthscale));
+// The binary coordinate enters the RBF at half scale: distance 0.5
+// between the two categories keeps moderate correlation, so each arm
+// borrows shape information from the other instead of starting cold.
+constexpr double kCatScale = 0.5;
+
+double Rbf(double ax, double ay, double az, double bx, double by,
+           double bz) {
+  double dx = ax - bx, dy = ay - by, dz = kCatScale * (az - bz);
+  return std::exp(-(dx * dx + dy * dy + dz * dz) /
+                  (2 * kLengthscale * kLengthscale));
 }
 
 // Standard normal pdf/cdf for Expected Improvement.
@@ -59,8 +66,9 @@ double phi(double z) {
 
 // ---- BayesianOptimizer -----------------------------------------------------
 
-void BayesianOptimizer::AddSample(double x0, double x1, double score) {
-  xs_.emplace_back(x0, x1);
+void BayesianOptimizer::AddSample(double x0, double x1, double x2,
+                                  double score) {
+  xs_.push_back({x0, x1, x2});
   ys_.push_back(score);
   y_max_ = std::max(y_max_, std::abs(score));
   FitGP();
@@ -74,8 +82,8 @@ void BayesianOptimizer::FitGP() {
   chol_.assign(static_cast<size_t>(n) * n, 0.0);
   for (int i = 0; i < n; ++i) {
     for (int j = 0; j <= i; ++j) {
-      double k = Rbf(xs_[i].first, xs_[i].second, xs_[j].first,
-                     xs_[j].second);
+      double k = Rbf(xs_[i].x0, xs_[i].x1, xs_[i].x2, xs_[j].x0, xs_[j].x1,
+                     xs_[j].x2);
       if (i == j) k += kNoise;
       chol_[i * n + j] = k;
     }
@@ -105,8 +113,8 @@ void BayesianOptimizer::FitGP() {
   }
 }
 
-void BayesianOptimizer::Predict(double x0, double x1, double* mean,
-                                double* var) const {
+void BayesianOptimizer::Predict(double x0, double x1, double x2,
+                                double* mean, double* var) const {
   const int n = static_cast<int>(xs_.size());
   if (n == 0) {
     *mean = 0;
@@ -115,7 +123,7 @@ void BayesianOptimizer::Predict(double x0, double x1, double* mean,
   }
   std::vector<double> kstar(n);
   for (int i = 0; i < n; ++i) {
-    kstar[i] = Rbf(x0, x1, xs_[i].first, xs_[i].second);
+    kstar[i] = Rbf(x0, x1, x2, xs_[i].x0, xs_[i].x1, xs_[i].x2);
   }
   double m = 0;
   for (int i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
@@ -132,54 +140,63 @@ void BayesianOptimizer::Predict(double x0, double x1, double* mean,
   *var = std::max(1e-12, 1.0 + kNoise - vv);
 }
 
-void BayesianOptimizer::Suggest(double* x0, double* x1) {
-  // Seed phase: spread the first probes before trusting the GP (the
-  // reference warms its GP with a fixed design too).
-  static const double kSeeds[][2] = {
-      {0.15, 0.15}, {0.85, 0.15}, {0.5, 0.5}, {0.15, 0.85}, {0.85, 0.85}};
+void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2) {
+  // Seed phase: spread the first probes over both categories before
+  // trusting the GP (the reference warms its GP with a fixed design too).
+  static const double kSeeds[][3] = {
+      {0.15, 0.15, 0}, {0.85, 0.15, 1}, {0.5, 0.5, 0},
+      {0.5, 0.5, 1},   {0.15, 0.85, 0}, {0.85, 0.85, 1}};
   const int n = num_samples();
-  if (n < 5) {
+  if (n < 6) {
     *x0 = kSeeds[n][0];
     *x1 = kSeeds[n][1];
+    *x2 = kSeeds[n][2];
     return;
   }
   const double denom = y_max_ > 0 ? y_max_ : 1.0;
   double best_y = *std::max_element(ys_.begin(), ys_.end()) / denom;
-  double best_ei = -1, bx = 0.5, by = 0.5;
-  for (int i = 0; i <= kGrid; ++i) {
-    for (int j = 0; j <= kGrid; ++j) {
-      // Deterministic jitter decorrelates the grid across rounds.
-      rng_ = rng_ * 1664525u + 1013904223u;
-      double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-      rng_ = rng_ * 1664525u + 1013904223u;
-      double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-      double cx = std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
-      double cy = std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
-      double mean, var;
-      Predict(cx, cy, &mean, &var);
-      double sd = std::sqrt(var);
-      double z = (mean - best_y - 0.01) / sd;
-      double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
-      if (ei > best_ei) {
-        best_ei = ei;
-        bx = cx;
-        by = cy;
+  double best_ei = -1, bx = 0.5, by = 0.5, bz = 1.0;
+  for (int cat = 0; cat <= 1; ++cat) {
+    for (int i = 0; i <= kGrid; ++i) {
+      for (int j = 0; j <= kGrid; ++j) {
+        // Deterministic jitter decorrelates the grid across rounds.
+        rng_ = rng_ * 1664525u + 1013904223u;
+        double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+        rng_ = rng_ * 1664525u + 1013904223u;
+        double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+        double cx = std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
+        double cy = std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
+        double mean, var;
+        Predict(cx, cy, cat, &mean, &var);
+        double sd = std::sqrt(var);
+        double z = (mean - best_y - 0.01) / sd;
+        double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
+        if (ei > best_ei) {
+          best_ei = ei;
+          bx = cx;
+          by = cy;
+          bz = cat;
+        }
       }
     }
   }
   *x0 = bx;
   *x1 = by;
+  *x2 = bz;
 }
 
-void BayesianOptimizer::Best(double* x0, double* x1, double* score) const {
+void BayesianOptimizer::Best(double* x0, double* x1, double* x2,
+                             double* score) const {
   if (ys_.empty()) {
     *x0 = *x1 = 0.5;
+    *x2 = 1.0;
     *score = 0;
     return;
   }
   size_t i = std::max_element(ys_.begin(), ys_.end()) - ys_.begin();
-  *x0 = xs_[i].first;
-  *x1 = xs_[i].second;
+  *x0 = xs_[i].x0;
+  *x1 = xs_[i].x1;
+  *x2 = xs_[i].x2;
   *score = ys_[i];
 }
 
@@ -194,7 +211,10 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
   active_ = true;
   if (!log_path.empty()) {
     log_ = std::fopen(log_path.c_str(), "w");
-    if (log_) std::fputs("time_s,fusion_bytes,cycle_ms,score_bytes_per_s\n", log_);
+    if (log_) {
+      std::fputs("time_s,fusion_bytes,cycle_ms,cache_use,score_bytes_per_s\n",
+                 log_);
+    }
   }
 }
 
@@ -206,8 +226,9 @@ void ParameterManager::RecordBytes(int64_t bytes) { bytes_ += bytes; }
 
 void ParameterManager::Log(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.3f,%lld,%.3f,%.1f\n", MonotonicSeconds(),
-               static_cast<long long>(fusion_), cycle_ms_, score);
+  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%.1f\n", MonotonicSeconds(),
+               static_cast<long long>(fusion_), cycle_ms_,
+               cache_use_ ? 1 : 0, score);
   std::fflush(log_);
 }
 
@@ -219,7 +240,8 @@ void ParameterManager::Score(double score) {
     --warmup_windows_;
     return;
   }
-  bo_.AddSample(FusionToX(fusion_), CycleToX(cycle_ms_), score);
+  bo_.AddSample(FusionToX(fusion_), CycleToX(cycle_ms_),
+                cache_use_ ? 1.0 : 0.0, score);
   if (score > best_score_ * 1.02) {
     windows_since_best_ = 0;
   } else {
@@ -229,6 +251,7 @@ void ParameterManager::Score(double score) {
     best_score_ = score;
     best_fusion_ = fusion_;
     best_cycle_ = cycle_ms_;
+    best_cache_ = cache_use_;
   }
   // Converge (reference: ParameterManager stops tuning once samples stop
   // improving): lock in the best configuration instead of exploring
@@ -239,14 +262,17 @@ void ParameterManager::Score(double score) {
     converged_ = true;
     fusion_ = best_fusion_;
     cycle_ms_ = best_cycle_;
+    cache_use_ = best_cache_;
     HVD_LOG(INFO) << "autotune converged: fusion=" << fusion_
-                  << " cycle_ms=" << cycle_ms_;
+                  << " cycle_ms=" << cycle_ms_
+                  << " announce_cache=" << (cache_use_ ? 1 : 0);
     return;
   }
-  double x0, x1;
-  bo_.Suggest(&x0, &x1);
+  double x0, x1, x2;
+  bo_.Suggest(&x0, &x1, &x2);
   fusion_ = XToFusion(x0);
   cycle_ms_ = XToCycle(x1);
+  cache_use_ = x2 >= 0.5;
 }
 
 bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
@@ -258,10 +284,15 @@ bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
   window_start_ = now;
   int64_t old_fusion = fusion_;
   double old_cycle = cycle_ms_;
+  bool old_cache = cache_use_;
   Score(score);
   *fusion_threshold = fusion_;
   *cycle_time_ms = cycle_ms_;
-  return fusion_ != old_fusion || cycle_ms_ != old_cycle;
+  // cache_use_ participates: a cache-only proposal must still be applied
+  // by the caller, or the next window's GP sample would be labeled with a
+  // setting that was never in effect.
+  return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
+         cache_use_ != old_cache;
 }
 
 }  // namespace hvdtpu

@@ -18,25 +18,33 @@
 
 namespace hvdtpu {
 
-// Gaussian-process regression + Expected Improvement on the unit square.
-// Exposed for the synthetic-surface self-test (autotune_selftest.cc).
+// Gaussian-process regression + Expected Improvement over two continuous
+// knobs on the unit square plus one BINARY knob (reference:
+// ParameterManager also tunes categorical flags like cache/hierarchical
+// allreduce — a binary coordinate in the same GP is the cheap TPU-native
+// form).  Exposed for the synthetic-surface self-test
+// (autotune_selftest.cc).
 class BayesianOptimizer {
  public:
-  // Observations are (x in [0,1]^2, score); scores are internally
-  // max-normalized so the kernel scales stay dimensionless.
-  void AddSample(double x0, double x1, double score);
-  // Next point to try: argmax EI over a jittered grid.  Falls back to
-  // latin-square-ish seed points for the first few calls.
-  void Suggest(double* x0, double* x1);
+  // Observations are (x in [0,1]^2, x2 in {0,1}, score); scores are
+  // internally max-normalized so the kernel scales stay dimensionless.
+  void AddSample(double x0, double x1, double x2, double score);
+  // Next point to try: argmax EI over a jittered grid x {0,1}.  Falls
+  // back to latin-square-ish seed points for the first few calls.
+  void Suggest(double* x0, double* x1, double* x2);
   // Best observed sample.
-  void Best(double* x0, double* x1, double* score) const;
+  void Best(double* x0, double* x1, double* x2, double* score) const;
   int num_samples() const { return static_cast<int>(xs_.size()); }
 
  private:
   void FitGP();
-  void Predict(double x0, double x1, double* mean, double* var) const;
+  void Predict(double x0, double x1, double x2, double* mean,
+               double* var) const;
 
-  std::vector<std::pair<double, double>> xs_;
+  struct Pt {
+    double x0, x1, x2;
+  };
+  std::vector<Pt> xs_;
   std::vector<double> ys_;      // raw scores
   std::vector<double> alpha_;   // K^-1 y_norm
   std::vector<double> chol_;    // Cholesky factor of K (row-major lower)
@@ -61,6 +69,10 @@ class ParameterManager {
   int64_t fusion() const { return fusion_; }
   double cycle_ms() const { return cycle_ms_; }
   double best_score() const { return best_score_; }
+  // Categorical knob: should workers announce steady-state tensors via
+  // response-cache ids?  (Per-rank safe: announcing full requests never
+  // desyncs the deterministic cache-insert order.)
+  bool announce_cache() const { return cache_use_; }
 
  private:
   void Score(double score);
@@ -73,9 +85,11 @@ class ParameterManager {
 
   int64_t fusion_ = 0;
   double cycle_ms_ = 1.0;
+  bool cache_use_ = true;
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
+  bool best_cache_ = true;
   int warmup_windows_ = 1;
   int windows_since_best_ = 0;
   bool converged_ = false;

@@ -553,6 +553,7 @@ Status SocketController::CoordinatorCycle(
       return Status::Error(StatusCode::ABORTED,
                            "lost connection to rank " + std::to_string(rank));
     }
+    ctrl_recv_.fetch_add(frame.size(), std::memory_order_relaxed);
     Reader rd(frame);
     int32_t n_cached = rd.GetI32();
     if (n_cached == -1) {  // BYE: clean worker exit
@@ -702,6 +703,7 @@ Status SocketController::CoordinatorCycle(
   const std::string payload = w.data();
   for (int rank = 1; rank < cfg_.size; ++rank) {
     if (departed_ranks_.count(rank)) continue;
+    ctrl_sent_.fetch_add(payload.size(), std::memory_order_relaxed);
     if (!ctrl_socks_[rank].SendFrame(payload)) {
       aborted_ = true;
       return Status::Error(StatusCode::ABORTED,
@@ -722,8 +724,9 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   // announcer of an earlier negotiation).
   std::vector<std::pair<int64_t, int64_t>> cached;
   std::vector<const TensorRequest*> full;
+  const bool use_cache = announce_cache_.load(std::memory_order_relaxed);
   for (const auto& r : new_requests) {
-    int64_t id = cache_.Lookup(r);
+    int64_t id = use_cache ? cache_.Lookup(r) : -1;
     if (id >= 0) {
       cached.emplace_back(id, r.handle);
     } else {
@@ -737,6 +740,7 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   }
   w.PutI32(static_cast<int32_t>(full.size()));
   for (const auto* r : full) SerializeRequest(*r, &w);
+  ctrl_sent_.fetch_add(w.data().size(), std::memory_order_relaxed);
   if (!coord_ctrl_.SendFrame(w.data())) {
     aborted_ = true;
     return Status::Error(StatusCode::ABORTED, "lost coordinator (send)");
@@ -746,6 +750,7 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
     aborted_ = true;
     return Status::Error(StatusCode::ABORTED, "lost coordinator (recv)");
   }
+  ctrl_recv_.fetch_add(frame.size(), std::memory_order_relaxed);
   Reader rd(frame);
   int32_t n = rd.GetI32();
   if (n == -1) {  // coordinator farewell: the job is ending deliberately

@@ -89,7 +89,24 @@ class SocketController : public Controller {
   // thread_local: each lane thread tags its own collective's frames.
   void SetCurrentSeq(int64_t seq) { current_seq_ = seq; }
 
+  void NegotiationStats(int64_t* sent, int64_t* recv) const override {
+    *sent = ctrl_sent_.load(std::memory_order_relaxed);
+    *recv = ctrl_recv_.load(std::memory_order_relaxed);
+  }
+
+  // Autotuned categorical knob: announce steady-state tensors via cache
+  // ids (default) or as full requests.  Per-rank safe — inserts stay
+  // deterministic either way, so cache ids never diverge across ranks.
+  void SetAnnounceCache(bool v) {
+    announce_cache_.store(v, std::memory_order_relaxed);
+  }
+
  private:
+  // Negotiation ctrl-channel payload byte counters (background thread
+  // writes, Python reads — relaxed atomics suffice for monotone counters).
+  std::atomic<int64_t> ctrl_sent_{0};
+  std::atomic<int64_t> ctrl_recv_{0};
+  std::atomic<bool> announce_cache_{true};
   struct Pending {
     TensorRequest meta;
     std::set<int> announced;

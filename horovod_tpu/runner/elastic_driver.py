@@ -311,8 +311,12 @@ class ElasticDriver:
                 recent = [t for t in self._failures[w.host]
                           if now - t < 4 * FAST_FAILURE_S]
                 self._failures[w.host] = recent
-                if len(recent) >= BLACKLIST_FAILURES:
+                if (len(recent) >= BLACKLIST_FAILURES
+                        and w.host not in self._blacklist):
                     self._blacklist.add(w.host)
+                    print(f"elastic driver: blacklisting host {w.host} "
+                          f"after {len(recent)} fast failures",
+                          file=sys.stderr)
         if self.verbose:
             print(f"elastic driver: worker {w.worker_id} exited rc={rc}",
                   file=sys.stderr)

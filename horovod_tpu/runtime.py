@@ -188,6 +188,11 @@ class CoreBackend:
         raise NotImplementedError
 
     # -- observability ------------------------------------------------------
+    def negotiation_stats(self) -> dict:
+        """Cumulative negotiation ctrl-channel payload bytes (zero for
+        backends without a socket control plane)."""
+        return {"ctrl_sent": 0, "ctrl_recv": 0}
+
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError
 

@@ -383,3 +383,70 @@ def test_elastic_survives_repeated_kills():
         assert os.path.exists(f1) and os.path.exists(f2), proc.stderr
         # Two deaths -> at least three formations.
         assert proc.stderr.count(" formed with ") >= 3, proc.stderr
+
+
+def test_elastic_discovery_flap_within_one_poll():
+    """VERDICT r4 #8a: discovery adds a slot and removes it again within
+    one poll interval (exactly ONE discovery invocation sees the larger
+    set).  The driver re-checks discovery at formation time, so the flap
+    must be a no-op: no extra worker, no re-formation, training undisturbed."""
+    with tempfile.TemporaryDirectory() as td:
+        grow_flag = os.path.join(td, "grow.flag")
+        seen_flag = os.path.join(td, "seen.flag")
+        script = os.path.join(td, "discover.sh")
+        with open(script, "w") as f:
+            f.write(f"#!/bin/sh\n"
+                    f"if [ -e {grow_flag} ] && [ ! -e {seen_flag} ]; then\n"
+                    f"  touch {seen_flag}\n"
+                    f"  echo localhost:3\n"
+                    f"else\n"
+                    f"  echo localhost:2\n"
+                    f"fi\n")
+        os.chmod(script, 0o755)
+        proc = _run_launcher(
+            ["--min-np", "2", "--max-np", "3", "--host-discovery-script",
+             script, "--verbose"],
+            env_extra={
+                # The worker's grow hook fires the flap mid-training (it
+                # only touches the flag; the discovery script self-reverts
+                # after a single sighting).
+                "TEST_GROW_EPOCH": "1",
+                "TEST_GROW_FILE": os.path.join(td, "unused.txt"),
+                "TEST_GROW_CONTENT": "ignored",
+                "TEST_GROW_FLAG": grow_flag,
+                "TEST_EPOCHS": "6",
+                "TEST_EPOCH_SLEEP": "0.7",
+            },
+            timeout=240)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert os.path.exists(seen_flag), "flap never reached discovery"
+        results = [ln for ln in proc.stdout.splitlines() if "RESULT" in ln]
+        assert len(results) == 2, proc.stdout  # no third worker survived
+        assert all("size=2" in ln and "epoch=6" in ln for ln in results)
+        # The flap resolved before formation: exactly the initial one.
+        assert proc.stderr.count(" formed with ") == 1, proc.stderr
+
+
+def test_elastic_min_np_not_met_fails_cleanly():
+    """VERDICT r4 #8b: repeated fast worker deaths blacklist the only
+    host; with min-np unreachable the driver must fail the job cleanly
+    (non-zero exit, named reason) instead of hanging — blacklist intact."""
+    with tempfile.TemporaryDirectory() as td:
+        f1 = os.path.join(td, "k1.flag")
+        f2 = os.path.join(td, "k2.flag")
+        f3 = os.path.join(td, "k3.flag")
+        proc = _run_launcher(
+            ["--min-np", "2", "-np", "2", "-H", "localhost:2",
+             "--start-timeout", "10", "--verbose"],
+            env_extra={
+                "TEST_KILLS": f"1:{f1},2:{f2},3:{f3}",
+                "TEST_EPOCHS": "30",
+                "TEST_EPOCH_SLEEP": "0.3",
+                # Default threshold (2 fast failures) blacklists localhost.
+            },
+            timeout=240)
+        assert proc.returncode != 0, proc.stdout + proc.stderr
+        assert "blacklisting host localhost" in proc.stderr, proc.stderr
+        assert "could not reach min_np=2" in proc.stderr, proc.stderr
+        # Clean failure, not a partial success: no worker reached the end.
+        assert "epoch=30" not in proc.stdout

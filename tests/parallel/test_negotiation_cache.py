@@ -1,0 +1,64 @@
+"""Response-cache steady-state effect on the negotiation ctrl channel
+(reference: response_cache.h — the bit-vector fast path; SURVEY.md §5
+"the response-cache bit-vector trick matters even more on TPU").
+
+With the cache, a steady-state worker announces each recurring tensor as a
+16-byte (id, handle) pair; without it, the full request metadata
+re-serializes every cycle.  The assertion is on ANNOUNCE bytes (worker ->
+coordinator): the response-list direction is identical in both configs.
+"""
+
+import numpy as np
+
+from horovod_tpu.runner import run
+
+STEPS = 20
+TENSORS = 30
+
+
+def _steady_state_worker():
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import mpi_ops
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init(build_mesh=False)
+    grads = [np.full(32, float(i), np.float32) for i in range(TENSORS)]
+
+    def step():
+        hs = [mpi_ops.allreduce_async(g, name=f"grad.{i}", op=hvd.Sum)
+              for i, g in enumerate(grads)]
+        for h in hs:
+            mpi_ops.synchronize(h)
+
+    for _ in range(4):  # warmup: populate the cache on every rank
+        step()
+    core = HorovodContext.instance().core
+    rank = hvd.rank()
+    s0 = core.negotiation_stats()
+    for _ in range(STEPS):
+        step()
+    s1 = core.negotiation_stats()
+    hvd.shutdown()
+    return {"rank": rank, "announce_bytes": s1["ctrl_sent"] - s0["ctrl_sent"]}
+
+
+def _announce_bytes(env) -> float:
+    results = run(_steady_state_worker, np=2, env=env)
+    # Worker rank (rank 1) announces over coord_ctrl_: its ctrl_sent is
+    # the announce direction.  (The coordinator's ctrl_sent counts the
+    # response broadcast instead.)
+    worker = next(r for r in results if r["rank"] == 1)
+    return worker["announce_bytes"] / STEPS
+
+
+def test_cache_skips_full_request_exchange_np2():
+    env = {"JAX_PLATFORMS": "cpu"}
+    with_cache = _announce_bytes(env)
+    without = _announce_bytes({**env, "HOROVOD_CACHE_CAPACITY": "0"})
+    # Steady state with the cache: ~16 bytes/tensor + frame counts.
+    # Without: full serialized requests (name, shape, scales, ...).
+    assert with_cache < 0.5 * without, (with_cache, without)
+    # Absolute sanity: the cached announce really is the id-pair form.
+    assert with_cache < TENSORS * 40, with_cache
+    assert without > TENSORS * 60, without

@@ -53,6 +53,11 @@ def _worker(steps: int, tensors: int):
               "tensor_ops_per_s": steps * len(grads) / dt}
     if stats0 is not None:
         stats1 = core.negotiation_stats()
+        # Announce direction (worker -> coordinator): where the cache's
+        # (id, handle) pairs replace full request metadata.  The recv
+        # direction is the response list, identical in both configs.
+        result["announce_bytes_per_step"] = (
+            (stats1["ctrl_sent"] - stats0["ctrl_sent"]) / steps)
         result["ctrl_bytes_per_step"] = (
             (stats1["ctrl_sent"] + stats1["ctrl_recv"]
              - stats0["ctrl_sent"] - stats0["ctrl_recv"]) / steps)
@@ -78,6 +83,8 @@ def run_config(name: str, env: dict, np_: int, steps: int, tensors: int):
         # Worker ranks only: the coordinator's ctrl traffic counts every
         # worker's frames and would double-book.
         agg["worker_ctrl_bytes_per_step"] = round(max(per_step), 1)
+        agg["worker_announce_bytes_per_step"] = round(
+            max(r["announce_bytes_per_step"] for r in results[1:]), 1)
     print(json.dumps(agg), flush=True)
     return agg
 
@@ -109,6 +116,9 @@ def main():
         summary["ctrl_bytes_ratio_on_vs_off"] = round(
             cache_on["worker_ctrl_bytes_per_step"]
             / max(cache_off["worker_ctrl_bytes_per_step"], 1.0), 3)
+        summary["announce_bytes_ratio_on_vs_off"] = round(
+            cache_on["worker_announce_bytes_per_step"]
+            / max(cache_off["worker_announce_bytes_per_step"], 1.0), 3)
     print(json.dumps(summary), flush=True)
 
 

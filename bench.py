@@ -81,6 +81,24 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+# Known-noise child log lines that would otherwise crowd the 400-char
+# live_error provenance out of the useful part.  Only the unconditional
+# per-init banner qualifies — fatal init errors ("Unable to initialize
+# backend ...") must SURVIVE, they are the root cause being recorded.
+_NOISE_MARKERS = (
+    "is experimental and not all JAX functionality",
+)
+
+
+def _clean_tail(text: str, limit: int = 400) -> str:
+    """Last ``limit`` chars of ``text`` with known-noise lines dropped
+    (falling back to the raw tail if filtering would erase everything)."""
+    lines = [ln for ln in text.strip().splitlines()
+             if ln.strip() and not any(m in ln for m in _NOISE_MARKERS)]
+    cleaned = "\n".join(lines)[-limit:]
+    return cleaned if cleaned else text.strip()[-limit:]
+
+
 # ---------------------------------------------------------------------------
 # Child: the actual measurement, phase-incremental output
 # ---------------------------------------------------------------------------
@@ -585,7 +603,7 @@ def main() -> None:
 
             if rc not in (None, 0) and not kill_reason:
                 errf.seek(0)
-                tail = errf.read()[-400:]
+                tail = _clean_tail(errf.read())
                 stage = "before probe" if run.probe is None else "post-probe"
                 last_err = f"child rc={rc} {stage}: {tail}"
                 _log(last_err)
@@ -610,7 +628,7 @@ def main() -> None:
         # log tail so a hang/wedge is localizable from it alone.
         if "child rc=" not in last_err:
             errf.seek(0)
-            tail = errf.read()[-400:].strip()
+            tail = _clean_tail(errf.read())
             if tail:
                 last_err = f"{last_err}; child log tail: {tail}"
 

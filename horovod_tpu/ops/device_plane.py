@@ -82,10 +82,27 @@ class DevicePlane:
         self.stats = {
             "allreduce": 0,       # fused device allreduce dispatches
             "broadcast": 0,       # device broadcast dispatches
+            "reducescatter": 0,   # device reducescatter dispatches
             "identity": 0,        # single-member identity completions
             "programs_built": 0,  # collective compile-cache misses
             "host_fallback": 0,   # device-resident entries demoted to host
+            "late_device_put": 0,  # stale cache-replayed device=1 on a host entry
         }
+
+    def _cached_program(self, key, build):
+        """Double-checked program-cache access shared by every collective
+        builder; ``build()`` runs outside the lock (jit/shard_map
+        construction is slow) and ties break toward the first insert."""
+        with self._lock:
+            fn = self._programs.get(key)
+        if fn is not None:
+            return fn
+        fn = build()
+        with self._lock:
+            if key not in self._programs:
+                self._programs[key] = fn
+                self.stats["programs_built"] += 1
+            return self._programs[key]
 
     # -- enqueue-side capability -------------------------------------------
     def adopt(self, array, op: OpType, reduce_op: ReduceOp,
@@ -98,6 +115,18 @@ class DevicePlane:
             return None
         if op == OpType.ALLREDUCE:
             if reduce_op not in _SUPPORTED_REDUCE:
+                return None
+        elif op == OpType.REDUCESCATTER:
+            # Device reducescatter serves Sum/Average on evenly divisible
+            # first dims (psum_scatter needs uniform chunks); the host
+            # plane's extra-row slicing covers the remainder case.  Shape
+            # equality across ranks is already negotiation-validated, so
+            # the divisibility check agrees on every rank.
+            if reduce_op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+                return None
+            k = len(self._members(psid))
+            d0 = array.shape[0] if getattr(array, "ndim", 0) else 0
+            if k == 0 or d0 == 0 or d0 % k != 0:
                 return None
         elif op != OpType.BROADCAST:
             return None
@@ -198,75 +227,100 @@ class DevicePlane:
         reduce ops)."""
         key = (psid, "ar", int(rop), str(np.dtype(dtype)), length,
                tuple(d.id for d in mesh.devices.flat))
-        with self._lock:
-            fn = self._programs.get(key)
-        if fn is not None:
-            return fn
-        import jax
-        from jax import lax
-        from jax.sharding import PartitionSpec as P
-        from jax import shard_map
 
-        from .collectives import ensure_varying
+        def build():
+            import jax
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax import shard_map
 
-        k = int(mesh.devices.size)
+            from .collectives import ensure_varying
 
-        def inner(x):  # [1, L]: this member's shard
-            if rop == ReduceOp.SUM:
-                out = lax.psum(x, AXIS)
-            elif rop == ReduceOp.AVERAGE:
-                out = lax.psum(x, AXIS) / k
-            elif rop == ReduceOp.MIN:
-                out = lax.pmin(x, AXIS)
-            elif rop == ReduceOp.MAX:
-                out = lax.pmax(x, AXIS)
-            elif rop == ReduceOp.PRODUCT:
-                g = lax.all_gather(x, AXIS, axis=0, tiled=True)
-                out = jax.numpy.prod(g, axis=0, keepdims=True)
-            else:  # pragma: no cover - adopt() filters
-                raise HorovodInternalError(f"unsupported device reduce {rop}")
-            return ensure_varying(out, AXIS)
+            k = int(mesh.devices.size)
 
-        fn = jax.jit(shard_map(inner, mesh=mesh, in_specs=P(AXIS, None),
-                               out_specs=P(AXIS, None)))
-        with self._lock:
-            if key not in self._programs:
-                self._programs[key] = fn
-                self.stats["programs_built"] += 1
-            fn = self._programs[key]
-        return fn
+            def inner(x):  # [1, L]: this member's shard
+                if rop == ReduceOp.SUM:
+                    out = lax.psum(x, AXIS)
+                elif rop == ReduceOp.AVERAGE:
+                    out = lax.psum(x, AXIS) / k
+                elif rop == ReduceOp.MIN:
+                    out = lax.pmin(x, AXIS)
+                elif rop == ReduceOp.MAX:
+                    out = lax.pmax(x, AXIS)
+                elif rop == ReduceOp.PRODUCT:
+                    g = lax.all_gather(x, AXIS, axis=0, tiled=True)
+                    out = jax.numpy.prod(g, axis=0, keepdims=True)
+                else:  # pragma: no cover - adopt() filters
+                    raise HorovodInternalError(
+                        f"unsupported device reduce {rop}")
+                return ensure_varying(out, AXIS)
+
+            return jax.jit(shard_map(inner, mesh=mesh,
+                                     in_specs=P(AXIS, None),
+                                     out_specs=P(AXIS, None)))
+
+        return self._cached_program(key, build)
+
+    def _reducescatter_program(self, psid: int, mesh, rop: ReduceOp, dtype,
+                               count: int, pre: float, post: float):
+        """Cached jitted reducescatter over (k, N) global arrays: every
+        member's full flat [1, N] in, its reduced [1, N/k] chunk out —
+        lowered to psum_scatter ((k-1)/k of the bytes on the wire)."""
+        key = (psid, "rs", int(rop), str(np.dtype(dtype)), count, pre, post,
+               tuple(d.id for d in mesh.devices.flat))
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax import shard_map
+
+            from .collectives import ensure_varying
+
+            k = int(mesh.devices.size)
+
+            def inner(x):  # [1, N]: this member's full contribution
+                flat = x[0]
+                if pre != 1.0:
+                    flat = flat * jnp.asarray(pre, flat.dtype)
+                out = lax.psum_scatter(flat, AXIS, scatter_dimension=0,
+                                      tiled=True)
+                if rop == ReduceOp.AVERAGE:
+                    out = out / k
+                if post != 1.0:
+                    out = out * jnp.asarray(post, out.dtype)
+                return ensure_varying(out, AXIS)[None]
+
+            return jax.jit(shard_map(inner, mesh=mesh,
+                                     in_specs=P(AXIS, None),
+                                     out_specs=P(AXIS, None)))
+
+        return self._cached_program(key, build)
 
     def _broadcast_program(self, psid: int, mesh, dtype, shape, root_pos: int):
         key = (psid, "bc", str(np.dtype(dtype)), tuple(shape), root_pos,
                tuple(d.id for d in mesh.devices.flat))
-        with self._lock:
-            fn = self._programs.get(key)
-        if fn is not None:
-            return fn
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-        from jax.sharding import PartitionSpec as P
-        from jax import shard_map
 
-        from .collectives import ensure_varying
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax import shard_map
 
-        ndim = len(shape)
+            from .collectives import ensure_varying
 
-        def inner(x):  # [1, ...]: this member's value
-            idx = lax.axis_index(AXIS)
-            contrib = jnp.where(idx == root_pos, x, jnp.zeros_like(x))
-            return ensure_varying(lax.psum(contrib, AXIS), AXIS)
+            def inner(x):  # [1, ...]: this member's value
+                idx = lax.axis_index(AXIS)
+                contrib = jnp.where(idx == root_pos, x, jnp.zeros_like(x))
+                return ensure_varying(lax.psum(contrib, AXIS), AXIS)
 
-        spec = P(AXIS, *([None] * ndim))
-        fn = jax.jit(shard_map(inner, mesh=mesh, in_specs=spec,
-                               out_specs=spec))
-        with self._lock:
-            if key not in self._programs:
-                self._programs[key] = fn
-                self.stats["programs_built"] += 1
-            fn = self._programs[key]
-        return fn
+            spec = P(AXIS, *([None] * len(shape)))
+            return jax.jit(shard_map(inner, mesh=mesh, in_specs=spec,
+                                     out_specs=spec))
+
+        return self._cached_program(key, build)
 
     def _pack(self):
         """Jitted fuse: concat member tensors flat, optional prescale, pad
@@ -349,12 +403,13 @@ class DevicePlane:
             if e.device_array is None:
                 e.device_array = jax.device_put(np.ascontiguousarray(e.array))
                 with self._lock:
-                    self.stats["late_device_put"] = (
-                        self.stats.get("late_device_put", 0) + 1)
+                    self.stats["late_device_put"] += 1
         if resp.op == OpType.ALLREDUCE:
             self._exec_allreduce(resp, entries)
         elif resp.op == OpType.BROADCAST:
             self._exec_broadcast(resp, entries[0])
+        elif resp.op == OpType.REDUCESCATTER:
+            self._exec_reducescatter(resp, entries[0])
         else:
             raise HorovodInternalError(
                 f"op {resp.op} is not served by the device plane")
@@ -399,6 +454,37 @@ class DevicePlane:
             e.result = r
         with self._lock:
             self.stats["allreduce"] += 1
+
+    def _exec_reducescatter(self, resp, entry) -> None:
+        import jax
+
+        psid = resp.process_set_id
+        members = self._members(psid)
+        pre = float(entry.prescale_factor)
+        post = float(entry.postscale_factor)
+        if len(members) == 1:
+            # One member keeps the whole reduced buffer (host-plane
+            # semantics at n=1): identity modulo scales.
+            x = entry.device_array
+            if pre != 1.0 or post != 1.0:
+                x = self._scale()(x, pre, post)
+            entry.result = x
+            with self._lock:
+                self.stats["identity"] += 1
+            return
+        mesh, ranks, my_dev = self._mesh_for(psid)
+        k = len(ranks)
+        x = jax.device_put(entry.device_array, my_dev)
+        row = x.reshape(1, -1)
+        garr = self._to_global(mesh, [row])
+        fn = self._reducescatter_program(psid, mesh, entry.reduce_op,
+                                         x.dtype, row.shape[1], pre, post)
+        out = fn(garr)
+        chunk_rows = x.shape[0] // k
+        entry.result = self._shard_on(out, my_dev).reshape(
+            (chunk_rows,) + tuple(x.shape[1:]))
+        with self._lock:
+            self.stats["reducescatter"] += 1
 
     def _exec_broadcast(self, resp, entry) -> None:
         import jax

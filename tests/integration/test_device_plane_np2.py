@@ -67,6 +67,23 @@ WORKER = textwrap.dedent("""
         x, op=hvd.Sum, name="devscale",
         prescale_factor=0.5, postscale_factor=3.0)), 4.5)
 
+    # Reducescatter on the device plane: rows divisible by 2 -> device
+    # psum_scatter; rank p keeps rows [2p, 2p+2) of the sum.
+    base = jnp.arange(8.0, dtype=jnp.float32).reshape(4, 2)
+    rs = hvd.reducescatter(base, op=hvd.Sum, name="devrs")
+    assert isinstance(rs, jax.Array)
+    assert np.allclose(np.asarray(rs),
+                       2.0 * np.asarray(base)[2 * rank:2 * rank + 2]), rs
+    assert stats.get("reducescatter", 0) == 1, stats
+    # Non-divisible first dim (3 rows over 2 ranks) -> host plane, with the
+    # reference's extra-row slicing.
+    odd = jnp.arange(6.0, dtype=jnp.float32).reshape(3, 2)
+    ro = hvd.reducescatter(odd, op=hvd.Sum, name="devrs.odd")
+    expect = 2.0 * np.arange(6.0, dtype=np.float32).reshape(3, 2)
+    mine = expect[:2] if rank == 0 else expect[2:]
+    assert np.allclose(np.asarray(ro), mine), np.asarray(ro)
+    assert stats.get("reducescatter", 0) == 1, stats  # still one (host path)
+
     # Broadcast on the device plane, each root.
     for root in range(2):
         b = hvd.broadcast(jnp.full((4,), float(rank * 10), jnp.float32),

@@ -119,6 +119,44 @@ def test_np1_bf16_device(hvd_single, transfer_guard):
     np.testing.assert_allclose(np.asarray(r, np.float32), 1.5)
 
 
+def test_np1_reducescatter_device_identity(hvd_single, transfer_guard):
+    """np=1 reducescatter on the device plane: one member keeps the whole
+    reduced buffer (identity modulo scales), no host copy."""
+    hvd = hvd_single
+    x = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
+    transfer_guard()
+    r = hvd.reducescatter(x, op=hvd.Sum, name="dp.rs")
+    r2 = hvd.reducescatter(x, op=hvd.Sum, name="dp.rs2",
+                           prescale_factor=2.0)
+    jax.config.update("jax_transfer_guard", "allow")
+    assert isinstance(r, jax.Array)
+    np.testing.assert_allclose(np.asarray(r),
+                               np.arange(12, dtype=np.float32).reshape(4, 3))
+    np.testing.assert_allclose(np.asarray(r2), 2.0 * np.asarray(r))
+
+
+def test_sim_reducescatter_program():
+    plane = DevicePlane(_FakeCore(4), None)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs), (AXIS,))
+    # Each "rank" contributes rows [8, 2] valued rank+1; reduced rows sum
+    # to 10; rank p keeps rows [2p, 2p+2).
+    rows = [jnp.full((1, 16), float(r + 1), jnp.float32) for r in range(4)]
+    garr = plane._to_global(mesh, rows)
+    fn = plane._reducescatter_program(0, mesh, ReduceOp.SUM, jnp.float32,
+                                      16, 1.0, 1.0)
+    out = fn(garr)
+    for d in devs:
+        np.testing.assert_allclose(np.asarray(plane._shard_on(out, d)), 10.0)
+        assert plane._shard_on(out, d).shape == (1, 4)
+    # AVERAGE + scales variant compiles separately and divides by k.
+    fa = plane._reducescatter_program(0, mesh, ReduceOp.AVERAGE, jnp.float32,
+                                      16, 2.0, 1.0)
+    oa = fa(garr)
+    np.testing.assert_allclose(np.asarray(plane._shard_on(oa, devs[1])), 5.0)
+    assert plane.stats["programs_built"] == 2
+
+
 def test_np1_adasum_falls_back_to_host(hvd_single):
     """Adasum is not served by the device plane; a jax input must still
     work via host materialization (negotiated device=False)."""

@@ -30,6 +30,14 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
+# Per-row statistics (lse, delta) cross the pallas_call boundary broadcast
+# across a trailing 128-lane dimension: the TPU lowering requires the last
+# two block dims to be (sublane-multiple, lane-multiple-or-whole), so a
+# [rows] vector must ride as [rows, 128] (the same layout the reference
+# jax TPU kernel uses for its l/m outputs, MIN_BLOCK_SIZE lanes).  Inside
+# kernels the [:, :1] column is the value; wrappers squeeze lane 0.
+LANES = 128
+
 
 def _out_struct(shape, dtype, like):
     """ShapeDtypeStruct for a pallas_call output, carrying the varying-
@@ -97,7 +105,9 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Log-sum-exp per query row, the residual the backward pass needs to
     # re-materialize P = exp(S - lse) blockwise without storing [S, S].
-    lse_ref[:] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    # Written lane-broadcast ([Bq, LANES]) per the TPU block-shape rule.
+    lse_ref[:] = jnp.broadcast_to(
+        m + jnp.log(jnp.maximum(l, 1e-30)), (block_q, LANES))
 
 
 def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
@@ -108,7 +118,7 @@ def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
     kernel = functools.partial(_mha_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                valid_len=valid_len)
-    return pl.pallas_call(
+    out, lse_lanes = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -118,14 +128,15 @@ def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             _out_struct((bh, s, d), qb.dtype, qb),
-            _out_struct((bh, s), jnp.float32, qb),
+            _out_struct((bh, s, LANES), jnp.float32, qb),
         ],
         interpret=interpret,
     )(qb, kb, vb)
+    return out, lse_lanes[:, :, 0]
 
 
 def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -135,8 +146,8 @@ def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     iq = pl.program_id(1)
     q = q_ref[:]                                           # [Bq, D] bf16/f32
     do = do_ref[:].astype(jnp.float32)                     # [Bq, D]
-    lse = lse_ref[:][:, None]                              # [Bq, 1] f32
-    delta = delta_ref[:][:, None]                          # [Bq, 1] f32
+    lse = lse_ref[:][:, :1]                                # [Bq, 1] f32
+    delta = delta_ref[:][:, :1]                            # [Bq, 1] f32
     seq_len = k_ref.shape[0]
     n_blocks = (iq + 1) if causal else seq_len // block_k
     padded = valid_len < seq_len
@@ -185,8 +196,8 @@ def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[pl.ds(i * block_q, block_q), :]
         do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, :1]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal or padded:
@@ -227,13 +238,17 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
                     axis=-1)                               # [BH, S]
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
+    # Per-row stats enter the kernels lane-broadcast (see LANES).
+    lse_l = jnp.broadcast_to(lse.astype(jnp.float32)[..., None],
+                             (bh, s, LANES))
+    delta_l = jnp.broadcast_to(delta[..., None], (bh, s, LANES))
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                   block_k=block_k, valid_len=valid_len)
     qspec = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
     kspec = pl.BlockSpec((None, block_k, d), lambda b, i: (b, i, 0))
     full = pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0))
-    row_q = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
-    row_full = pl.BlockSpec((None, s), lambda b, i: (b, 0))
+    row_q = pl.BlockSpec((None, block_q, LANES), lambda b, i: (b, i, 0))
+    row_full = pl.BlockSpec((None, s, LANES), lambda b, i: (b, 0, 0))
     dq = pl.pallas_call(
         functools.partial(_mha_bwd_dq_kernel, **common),
         grid=(bh, s // block_q),
@@ -241,7 +256,7 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
         out_specs=qspec,
         out_shape=_out_struct((bh, s, d), qb.dtype, qb),
         interpret=interpret,
-    )(qb, kb, vb, dob, lse, delta)
+    )(qb, kb, vb, dob, lse_l, delta_l)
     dk, dv = pl.pallas_call(
         functools.partial(_mha_bwd_dkv_kernel, **common),
         grid=(bh, s // block_k),
@@ -250,7 +265,7 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
         out_shape=[_out_struct((bh, s, d), kb.dtype, kb),
                    _out_struct((bh, s, d), vb.dtype, vb)],
         interpret=interpret,
-    )(qb, kb, vb, dob, lse, delta)
+    )(qb, kb, vb, dob, lse_l, delta_l)
     return dq, dk, dv
 
 

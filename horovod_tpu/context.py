@@ -31,7 +31,8 @@ from .ops.device_plane import DevicePlane
 from .runtime import CoreBackend, FusedResponse, PyLocalCore, TensorEntry
 from .utils.env import Config, get_bool
 from .utils.logging import get_logger
-from .wire import DataType, OpType, ReduceOp, numpy_dtype, wire_dtype
+from .wire import (DataType, OpType, ReduceOp, numpy_dtype,
+                   validate_alltoall_splits, wire_dtype)
 
 log = get_logger()
 
@@ -649,21 +650,7 @@ class HorovodContext:
 
     def _exec_alltoall(self, e: TensorEntry, psid: int) -> None:
         n = self._ps_size(psid)
-        splits = e.splits
-        if splits is None:
-            d0 = e.array.shape[0]
-            if d0 % n != 0:
-                raise HorovodInternalError(
-                    f"alltoall without splits requires first dim divisible by "
-                    f"process set size ({d0} vs {n})"
-                )
-            splits = np.full((n,), d0 // n, dtype=np.int64)
-        if len(splits) != n:
-            raise HorovodInternalError(
-                f"alltoall splits must have one entry per process-set rank "
-                f"({len(splits)} given, {n} ranks)")
-        if int(splits.sum()) != e.array.shape[0]:
-            raise HorovodInternalError("alltoall splits do not sum to first dim")
+        splits = validate_alltoall_splits(e.splits, e.array.shape[0], n)
         buf = e.array.reshape(e.array.shape[0], -1)
         out, recv_splits = self.core.alltoall_buffer(buf, splits, psid)
         rest = e.array.shape[1:]

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..exceptions import HorovodInternalError
 from ..utils.logging import get_logger
-from ..wire import DataType, OpType, ReduceOp
+from ..wire import DataType, OpType, ReduceOp, validate_alltoall_splits
 
 log = get_logger()
 
@@ -83,6 +83,8 @@ class DevicePlane:
             "allreduce": 0,       # fused device allreduce dispatches
             "broadcast": 0,       # device broadcast dispatches
             "reducescatter": 0,   # device reducescatter dispatches
+            "allgather": 0,       # device allgather dispatches
+            "alltoall": 0,        # device alltoall dispatches
             "identity": 0,        # single-member identity completions
             "programs_built": 0,  # collective compile-cache misses
             "host_fallback": 0,   # device-resident entries demoted to host
@@ -115,6 +117,15 @@ class DevicePlane:
             return None
         if op == OpType.ALLREDUCE:
             if reduce_op not in _SUPPORTED_REDUCE:
+                return None
+        elif op == OpType.ALLGATHER:
+            # Gathered first dims may differ per rank; the device program
+            # pads to the max (counts are exchanged as metadata at execute
+            # time — bytes stay on device).  Scalars ride the host plane.
+            if getattr(array, "ndim", 0) == 0:
+                return None
+        elif op == OpType.ALLTOALL:
+            if getattr(array, "ndim", 0) == 0:
                 return None
         elif op == OpType.REDUCESCATTER:
             # Device reducescatter serves Sum/Average on evenly divisible
@@ -322,6 +333,138 @@ class DevicePlane:
 
         return self._cached_program(key, build)
 
+    def _allgather_program(self, psid: int, mesh, dtype, counts: tuple,
+                           rest: tuple):
+        """Cached jitted allgather over (k, maxn, R) global arrays: every
+        member's first-dim-padded [1, maxn, R] shard in, the full
+        concatenation [1, total, R] out on every member.  ``counts`` (the
+        per-member true first dims) is static — ragged gathers compile per
+        counts signature, steady-state shapes hit the cache."""
+        key = (psid, "ag", str(np.dtype(dtype)), counts, rest,
+               tuple(d.id for d in mesh.devices.flat))
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax import shard_map
+
+            from .collectives import ensure_varying
+
+            k = int(mesh.devices.size)
+
+            def inner(x):  # [1, maxn, R]: this member's padded rows
+                g = lax.all_gather(x[0], AXIS, axis=0)     # [k, maxn, R]
+                parts = [g[i, :counts[i]] for i in range(k) if counts[i]]
+                out = (jnp.concatenate(parts, axis=0) if parts
+                       else g[:, :0].reshape((0,) + g.shape[2:]))
+                return ensure_varying(out, AXIS)[None]     # [1, total, R]
+
+            return jax.jit(shard_map(inner, mesh=mesh,
+                                     in_specs=P(AXIS, None, None),
+                                     out_specs=P(AXIS, None, None)))
+
+        return self._cached_program(key, build)
+
+    def _alltoall_program(self, psid: int, mesh, dtype, splits_mat: tuple,
+                          restprod: int):
+        """Cached jitted alltoall over (k, d0max, R) global arrays.
+        ``splits_mat`` (row r = member r's per-destination send counts) is
+        static.  Uniform splits lower to one tiled lax.all_to_all; ragged
+        splits pad each (src, dst) chunk to the max count, exchange
+        uniformly, then re-pack — extra wire bytes, but the payload stays
+        on device (the host plane's ragged exchange is the alternative).
+        Output is [1, recvmax, R] per member, sliced to the true receive
+        count by the caller."""
+        key = (psid, "a2a", str(np.dtype(dtype)), splits_mat, restprod,
+               tuple(d.id for d in mesh.devices.flat))
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax import shard_map
+
+            from .collectives import ensure_varying
+
+            k = int(mesh.devices.size)
+            rows = [list(r) for r in splits_mat]
+            recv_counts = [[rows[src][dst] for src in range(k)]
+                           for dst in range(k)]
+            recv_tot = [sum(rc) for rc in recv_counts]
+            recvmax = max(max(recv_tot), 1)
+            uniform = len({c for r in rows for c in r}) == 1
+
+            if uniform:
+                c = rows[0][0]
+
+                def inner(x):  # [1, d0max, R]; d0max == k*c here
+                    swapped = lax.all_to_all(      # row i <- member i's chunk
+                        x[0].reshape(k, c, -1), AXIS, split_axis=0,
+                        concat_axis=0, tiled=False)
+                    out = swapped.reshape(k * c, -1)
+                    return ensure_varying(out, AXIS)[None]
+
+                return jax.jit(shard_map(inner, mesh=mesh,
+                                         in_specs=P(AXIS, None, None),
+                                         out_specs=P(AXIS, None, None)))
+
+            cmax = max(max(c for r in rows for c in r), 1)
+
+            def pack_for(r):
+                offs = np.concatenate([[0], np.cumsum(rows[r])])
+
+                def pack(x):  # [d0max, R] -> [k, cmax, R] padded chunks
+                    chunks = []
+                    for j in range(k):
+                        seg = x[int(offs[j]):int(offs[j + 1])]
+                        pad = cmax - seg.shape[0]
+                        if pad:
+                            z = ensure_varying(
+                                jnp.zeros((pad,) + seg.shape[1:], seg.dtype),
+                                AXIS)
+                            seg = jnp.concatenate([seg, z])
+                        chunks.append(seg)
+                    return jnp.stack(chunks)
+
+                return pack
+
+            def unpack_for(me):
+                def unpack(g):  # [k, cmax, R] rows from each src, padded
+                    parts = [g[src, :recv_counts[me][src]]
+                             for src in range(k) if recv_counts[me][src]]
+                    out = (jnp.concatenate(parts, axis=0) if parts
+                           else g[:, :0].reshape((0,) + g.shape[2:]))
+                    pad = recvmax - out.shape[0]
+                    if pad:
+                        z = ensure_varying(
+                            jnp.zeros((pad,) + out.shape[1:], out.dtype),
+                            AXIS)
+                        out = jnp.concatenate([out, z])
+                    return out
+
+                return unpack
+
+            def inner(x):  # [1, d0max, R]
+                me = lax.axis_index(AXIS)
+                packed = lax.switch(
+                    me, [lambda _, r=r: pack_for(r)(x[0]) for r in range(k)],
+                    None)
+                swapped = lax.all_to_all(packed, AXIS, split_axis=0,
+                                         concat_axis=0, tiled=False)
+                out = lax.switch(
+                    me, [lambda g, r=r: unpack_for(r)(g) for r in range(k)],
+                    swapped)
+                return ensure_varying(out, AXIS)[None]    # [1, recvmax, R]
+
+            return jax.jit(shard_map(inner, mesh=mesh,
+                                     in_specs=P(AXIS, None, None),
+                                     out_specs=P(AXIS, None, None)))
+
+        return self._cached_program(key, build)
+
     def _pack(self):
         """Jitted fuse: concat member tensors flat, optional prescale, pad
         to the bucket length (MemcpyInFusionBuffer analog, on device).
@@ -410,6 +553,10 @@ class DevicePlane:
             self._exec_broadcast(resp, entries[0])
         elif resp.op == OpType.REDUCESCATTER:
             self._exec_reducescatter(resp, entries[0])
+        elif resp.op == OpType.ALLGATHER:
+            self._exec_allgather(resp, entries)
+        elif resp.op == OpType.ALLTOALL:
+            self._exec_alltoall(resp, entries[0])
         else:
             raise HorovodInternalError(
                 f"op {resp.op} is not served by the device plane")
@@ -485,6 +632,109 @@ class DevicePlane:
             (chunk_rows,) + tuple(x.shape[1:]))
         with self._lock:
             self.stats["reducescatter"] += 1
+
+    def _exec_allgather(self, resp, entries: Sequence) -> None:
+        """Device allgather: per-rank first dims are exchanged as int64
+        METADATA over the host ctrl plane (same channel negotiation uses —
+        a few bytes), then the payload rides one cached XLA all_gather.
+        Ragged first dims pad to the max and slice inside the program."""
+        import jax
+        import jax.numpy as jnp
+
+        psid = resp.process_set_id
+        members = self._members(psid)
+        if len(members) == 1:
+            for e in entries:
+                e.result = e.device_array
+            with self._lock:
+                self.stats["identity"] += len(entries)
+            return
+        mesh, ranks, my_dev = self._mesh_for(psid)
+        k = len(ranks)
+        dims = np.ascontiguousarray(
+            [int(e.device_array.shape[0]) for e in entries], dtype=np.int64)
+        stacked, _ = self._core.allgather_buffer(dims, psid)
+        per_rank = np.asarray(stacked, dtype=np.int64).reshape(k, len(entries))
+        for j, e in enumerate(entries):
+            counts = tuple(int(c) for c in per_rank[:, j])
+            maxn = max(max(counts), 1)
+            x = jax.device_put(e.device_array, my_dev)
+            rest = tuple(x.shape[1:])
+            # Explicit row width: a -1 reshape is ambiguous for zero-row
+            # contributions (size 0), which the ragged program supports.
+            restprod = int(np.prod(rest, dtype=np.int64)) if rest else 1
+            row = x.reshape((1, x.shape[0], restprod))
+            if x.shape[0] < maxn:
+                row = self._pad_rows()(row, maxn)
+            garr = self._to_global(mesh, [row])
+            fn = self._allgather_program(psid, mesh, x.dtype, counts, rest)
+            out = fn(garr)
+            e.result = self._shard_on(out, my_dev).reshape(
+                (int(sum(counts)),) + rest)
+        with self._lock:
+            self.stats["allgather"] += 1
+
+    def _exec_alltoall(self, resp, entry) -> None:
+        """Device alltoall: split vectors are exchanged as metadata (as in
+        allgather), then a cached program performs the exchange — one tiled
+        lax.all_to_all when splits are uniform, a pad-to-max exchange when
+        ragged.  Mirrors the host plane's validation and recv_splits."""
+        import jax
+
+        psid = resp.process_set_id
+        members = self._members(psid)
+        k = len(members)
+        x = entry.device_array
+        splits = validate_alltoall_splits(entry.splits, x.shape[0], k)
+        if k == 1:
+            entry.result = x
+            entry.recv_splits = splits.copy()
+            with self._lock:
+                self.stats["identity"] += 1
+            return
+        mesh, ranks, my_dev = self._mesh_for(psid)
+        my_pos = ranks.index(self._core.rank())
+        stacked, _ = self._core.allgather_buffer(splits, psid)
+        mat = np.asarray(stacked, dtype=np.int64).reshape(k, k)
+        if int(mat.sum()) == 0:  # nothing moves anywhere
+            entry.result = x[:0]
+            entry.recv_splits = np.zeros((k,), dtype=np.int64)
+            with self._lock:
+                self.stats["alltoall"] += 1
+            return
+        splits_mat = tuple(tuple(int(c) for c in row) for row in mat)
+        rest = tuple(x.shape[1:])
+        x = jax.device_put(x, my_dev)
+        restprod = int(np.prod(rest, dtype=np.int64)) if rest else 1
+        row = x.reshape((1, x.shape[0], restprod))
+        d0max = max(int(mat.sum(axis=1).max()), 1)
+        if row.shape[1] < d0max:
+            row = self._pad_rows()(row, d0max)
+        garr = self._to_global(mesh, [row])
+        fn = self._alltoall_program(psid, mesh, x.dtype, splits_mat,
+                                    int(row.shape[2]))
+        out = fn(garr)
+        recv = [int(mat[src, my_pos]) for src in range(k)]
+        entry.result = self._shard_on(out, my_dev)[0, :sum(recv)].reshape(
+            (sum(recv),) + rest)
+        entry.recv_splits = np.asarray(recv, dtype=np.int64)
+        with self._lock:
+            self.stats["alltoall"] += 1
+
+    def _pad_rows(self):
+        """Jitted zero-pad of a [1, n, R] row to [1, target, R] (device-side
+        — the no-host-copy guarantee holds through ragged paths too)."""
+        if getattr(self, "_pad_fn", None) is None:
+            import jax
+            import jax.numpy as jnp
+
+            def pad(row, target):
+                n = row.shape[1]
+                z = jnp.zeros((1, target - n, row.shape[2]), row.dtype)
+                return jnp.concatenate([row, z], axis=1)
+
+            self._pad_fn = jax.jit(pad, static_argnums=(1,))
+        return self._pad_fn
 
     def _exec_broadcast(self, resp, entry) -> None:
         import jax

@@ -7,15 +7,20 @@ hand kernel on TPU is attention: this kernel keeps the [S, S] score matrix
 out of HBM entirely (VMEM-blocked online softmax), the classic
 flash-attention trade.
 
-Layout: inputs [batch, seq, heads, head_dim]; the kernel runs on
-[batch*heads, seq, head_dim] with a (BH, seq/block_q) grid; K/V live in
-VMEM whole (fine to ~8k sequence at head_dim 64-128), Q is blocked.
-Causal mode requires block_q == block_k and skips blocks above the
-diagonal, so every processed row has at least one valid key (keeps the
-online-softmax max finite with a -1e30 mask value, no NaN guards needed).
+Layout: inputs [batch, seq, heads, head_dim]; the kernels run on
+[batch*heads, seq, head_dim] with streaming (BH, n_q, n_kv)-style grids:
+K/V (forward) or Q/dO (dK/dV backward) blocks flow through VMEM while the
+online-softmax state (acc/m/l, or the dq/dk/dv partials) persists in f32
+scratch across the innermost grid steps — so no operand is ever VMEM-whole
+and sequence length is HBM-bound, not VMEM-bound.  Causal mode requires
+block_q == block_k; tiles above the diagonal (and tiles entirely in tail
+padding) are predicated off with pl.when, so every processed row has at
+least one valid key (keeps the online-softmax max finite with a -1e30 mask
+value, no NaN guards needed).
 
 Off-TPU (CPU tests) the public wrapper falls back to an identical-math
-dense implementation; the kernel itself is unit-tested in interpret mode.
+dense implementation; the kernels are unit-tested in interpret mode and
+validated on hardware by tools/tpu_flash_validate.py.
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -53,33 +59,53 @@ def _out_struct(shape, dtype, like):
         return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
-                causal: bool, block_q: int, block_k: int, valid_len: int):
-    iq = pl.program_id(1)
-    # Dots run on the MXU in the input dtype (bf16 native rate, 2x the f32
-    # path) with f32 accumulation; softmax math stays f32.  The sm_scale is
-    # folded in after the QK dot so it happens in f32.
-    q = q_ref[:]                                         # [Bq, D]
-    seq_len = k_ref.shape[0]
-    d = q_ref.shape[-1]
-
+def _block_live(iq, jk, causal: bool, block_q: int, block_k: int,
+                valid_len: int, seq_len: int):
+    """Whether the (q-block iq, k-block jk) tile can contribute: on the TPU
+    the grid is sequential and can't be shortened per-row, so dead tiles
+    (above the causal diagonal, or entirely in tail padding) are skipped by
+    predication — the dots never issue, only the pipelined DMA runs."""
+    live = jk * block_k < valid_len
     if causal:
-        n_blocks = iq + 1                                # skip above-diagonal
-    else:
-        n_blocks = seq_len // block_k
+        live = jnp.logical_and(live,
+                               (iq + 1) * block_q - 1 >= jk * block_k)
+    return live
+
+
+def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+                valid_len: int):
+    """Streaming forward: grid (BH, n_q, n_kv), K/V blocks flow through
+    VMEM while acc/m/l persist in scratch across the innermost kv steps
+    (the o/lse output blocks are revisited and written on the last step)."""
+    iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    seq_len = n_kv * block_k
     padded = valid_len < seq_len
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(_block_live(iq, jk, causal, block_q, block_k, valid_len,
+                         seq_len))
+    def _compute():
+        # Dots run on the MXU in the input dtype (bf16 native rate, 2x the
+        # f32 path) with f32 accumulation; softmax math stays f32.  The
+        # sm_scale folds in after the QK dot so it happens in f32.
+        q = q_ref[:]                                      # [Bq, D]
+        k = k_ref[:]                                      # [Bk, D]
+        v = v_ref[:]
         s = jax.lax.dot_general(                          # [Bq, Bk] on MXU
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if causal or padded:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
+            kpos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             if causal:
                 # Padding lives at the tail, so kpos > any real qpos —
@@ -87,34 +113,32 @@ def _mha_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                 s = jnp.where(qpos >= kpos, s, NEG_INF)
             else:
                 s = jnp.where(kpos < valid_len, s, NEG_INF)
+        m = m_ref[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+        m_ref[:] = m_new
 
-    # Carries derive from q (not fresh constants) so they inherit its
-    # varying-manual-axes type when the kernel runs in interpret mode
-    # inside shard_map; on real TPU these are the same zeros.
-    acc0 = (q * 0).astype(jnp.float32)
-    m0 = (q[:, :1] * 0).astype(jnp.float32) + NEG_INF
-    l0 = (q[:, :1] * 0).astype(jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # Log-sum-exp per query row, the residual the backward pass needs to
-    # re-materialize P = exp(S - lse) blockwise without storing [S, S].
-    # Written lane-broadcast ([Bq, LANES]) per the TPU block-shape rule.
-    lse_ref[:] = jnp.broadcast_to(
-        m + jnp.log(jnp.maximum(l, 1e-30)), (block_q, LANES))
+    @pl.when(jk == n_kv - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[:] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # Log-sum-exp per query row, the residual the backward pass needs
+        # to re-materialize P = exp(S - lse) blockwise without storing
+        # [S, S].  Written lane-broadcast ([Bq, LANES]) per the TPU
+        # block-shape rule.
+        lse_ref[:] = jnp.broadcast_to(m_ref[:] + jnp.log(l),
+                                      (block_q, LANES))
 
 
 def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
                     interpret, valid_len):
     """Forward kernel over [BH, S, D] (S already padded): out + row lse."""
     bh, s, d = qb.shape
-    grid = (bh, s // block_q)
+    grid = (bh, s // block_q, s // block_k)
     kernel = functools.partial(_mha_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                valid_len=valid_len)
@@ -122,17 +146,22 @@ def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _out_struct((bh, s, d), qb.dtype, qb),
             _out_struct((bh, s, LANES), jnp.float32, qb),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qb, kb, vb)
@@ -140,27 +169,37 @@ def _flash_fwd_bhsd(qb, kb, vb, sm_scale, causal, block_q, block_k,
 
 
 def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dq_ref, *, sm_scale: float, causal: bool,
+                       dq_ref, acc_ref, *, sm_scale: float, causal: bool,
                        block_q: int, block_k: int, valid_len: int):
-    """dQ for one query block: loop over key blocks, re-materialize P."""
+    """dQ, streaming: grid (BH, n_q, n_kv); K/V blocks flow past a fixed
+    query block while dq accumulates in f32 scratch (the dq output block is
+    revisited and written on the last kv step).  P is re-materialized from
+    the lse residual — the [S, S] score matrix never exists."""
     iq = pl.program_id(1)
-    q = q_ref[:]                                           # [Bq, D] bf16/f32
-    do = do_ref[:].astype(jnp.float32)                     # [Bq, D]
-    lse = lse_ref[:][:, :1]                                # [Bq, 1] f32
-    delta = delta_ref[:][:, :1]                            # [Bq, 1] f32
-    seq_len = k_ref.shape[0]
-    n_blocks = (iq + 1) if causal else seq_len // block_k
+    jk = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    seq_len = n_kv * block_k
     padded = valid_len < seq_len
 
-    def body(j, dq):
-        k = k_ref[pl.ds(j * block_k, block_k), :]
-        v = v_ref[pl.ds(j * block_k, block_k), :]
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(_block_live(iq, jk, causal, block_q, block_k, valid_len,
+                         seq_len))
+    def _compute():
+        q = q_ref[:]                                       # [Bq, D]
+        k = k_ref[:]                                       # [Bk, D]
+        v = v_ref[:]
+        do = do_ref[:].astype(jnp.float32)                 # [Bq, D]
+        lse = lse_ref[:][:, :1]                            # [Bq, 1] f32
+        delta = delta_ref[:][:, :1]                        # [Bq, 1] f32
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal or padded:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
+            kpos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             if causal:
                 s = jnp.where(qpos >= kpos, s, NEG_INF)
@@ -171,37 +210,44 @@ def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale                   # [Bq, Bk]
-        return dq + jnp.dot(ds.astype(k.dtype), k,
-                            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(
-        0, n_blocks, body, (q * 0).astype(jnp.float32))
-    dq_ref[:] = dq.astype(dq_ref.dtype)
+    @pl.when(jk == n_kv - 1)
+    def _flush():
+        dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
 
 
 def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                        block_q: int, block_k: int, valid_len: int):
-    """dK/dV for one key block: loop over query blocks."""
+                        dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale: float,
+                        causal: bool, block_q: int, block_k: int,
+                        valid_len: int):
+    """dK/dV, streaming: grid (BH, n_kv, n_q); Q/dO/stat blocks flow past a
+    fixed key block while dk/dv accumulate in f32 scratch."""
     jk = pl.program_id(1)
-    k = k_ref[:]                                           # [Bk, D]
-    v = v_ref[:]                                           # [Bk, D]
-    seq_len = q_ref.shape[0]
-    n_q_blocks = seq_len // block_q
-    start = jk * block_k // block_q if causal else 0       # skip above diag
+    iq = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    seq_len = n_q * block_q
     padded = valid_len < seq_len
-    d = q_ref.shape[-1]
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, :1]
-        delta = delta_ref[pl.ds(i * block_q, block_q), :][:, :1]
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_live(iq, jk, causal, block_q, block_k, valid_len,
+                         seq_len))
+    def _compute():
+        q = q_ref[:]                                       # [Bq, D]
+        k = k_ref[:]                                       # [Bk, D]
+        v = v_ref[:]
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:][:, :1]
+        delta = delta_ref[:][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal or padded:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -210,23 +256,21 @@ def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             else:
                 s = jnp.where(kpos < valid_len, s, NEG_INF)
         p = jnp.exp(s - lse)                               # [Bq, Bk]
-        dv = dv + jax.lax.dot_general(                     # P^T @ dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(       # P^T @ dO
             p.astype(do_ref.dtype), do.astype(do_ref.dtype),
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(                     # dS^T @ Q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(       # dS^T @ Q
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dk0 = (k * 0).astype(jnp.float32)
-    dv0 = (v * 0).astype(jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (dk0, dv0))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(iq == n_q - 1)
+    def _flush():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
@@ -244,26 +288,32 @@ def _flash_bwd_bhsd(qb, kb, vb, ob, lse, dob, sm_scale, causal, block_q,
     delta_l = jnp.broadcast_to(delta[..., None], (bh, s, LANES))
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                   block_k=block_k, valid_len=valid_len)
-    qspec = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
-    kspec = pl.BlockSpec((None, block_k, d), lambda b, i: (b, i, 0))
-    full = pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0))
-    row_q = pl.BlockSpec((None, block_q, LANES), lambda b, i: (b, i, 0))
-    row_full = pl.BlockSpec((None, s, LANES), lambda b, i: (b, 0, 0))
+    # dq: q-block fixed per outer step, k/v stream on the inner grid dim.
+    q_by_i = pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_by_j = pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0))
+    row_by_i = pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_mha_bwd_dq_kernel, **common),
-        grid=(bh, s // block_q),
-        in_specs=[qspec, full, full, qspec, row_q, row_q],
-        out_specs=qspec,
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[q_by_i, kv_by_j, kv_by_j, q_by_i, row_by_i, row_by_i],
+        out_specs=q_by_i,
         out_shape=_out_struct((bh, s, d), qb.dtype, qb),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qb, kb, vb, dob, lse_l, delta_l)
+    # dk/dv: k-block fixed per outer step, q/do/stats stream inside.
+    q_by_j = pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0))
+    kv_by_i = pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0))
+    row_by_j = pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_mha_bwd_dkv_kernel, **common),
-        grid=(bh, s // block_k),
-        in_specs=[full, kspec, kspec, full, row_full, row_full],
-        out_specs=[kspec, kspec],
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[q_by_j, kv_by_i, kv_by_i, q_by_j, row_by_j, row_by_j],
+        out_specs=[kv_by_i, kv_by_i],
         out_shape=[_out_struct((bh, s, d), kb.dtype, kb),
                    _out_struct((bh, s, d), vb.dtype, vb)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(qb, kb, vb, dob, lse_l, delta_l)
     return dq, dk, dv
@@ -307,56 +357,6 @@ def _flash_bhsd_lse_bwd(sm_scale, causal, block_q, block_k, interpret,
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
 
 
-# Per-core VMEM by TPU generation (v4/v5e/v5p: 128 MiB, v6e: 128 MiB;
-# older v2/v3: 16 MiB/core x2 cores presented as 32).  Half is budgeted for
-# K+V, leaving room for the q/out/acc blocks and double-buffering.
-_VMEM_BYTES_BY_KIND = (
-    ("TPU v6", 128 << 20),
-    ("TPU v5", 128 << 20),
-    ("TPU v4", 128 << 20),
-    ("TPU v3", 32 << 20),
-    ("TPU v2", 32 << 20),
-)
-
-
-def _kv_vmem_budget() -> int:
-    env = os.environ.get("HVD_TPU_FLASH_VMEM_BUDGET_MB")
-    if env:
-        try:
-            budget = int(env)
-        except ValueError:
-            budget = 0
-        if budget <= 0:
-            raise ValueError(
-                f"HVD_TPU_FLASH_VMEM_BUDGET_MB must be a positive integer "
-                f"MiB count, got {env!r}")
-        return budget << 20
-    try:
-        kind = jax.devices()[0].device_kind
-        for prefix, vmem in _VMEM_BYTES_BY_KIND:
-            if kind.startswith(prefix):
-                return vmem // 2
-    except Exception:
-        pass
-    return 64 << 20  # conservative default: v4/v5-class half-VMEM
-
-
-def _check_kv_vmem(s: int, d: int, dtype) -> None:
-    # K and V live whole in VMEM (bandwidth-optimal: fetched once, not once
-    # per query block).  That caps the per-device sequence length; beyond
-    # it, shard the sequence instead (parallel.ring_attention on an sp
-    # axis, whose per-hop chunks come back under the cap).
-    budget = _kv_vmem_budget()
-    kv_bytes = 2 * s * d * jnp.dtype(dtype).itemsize
-    if kv_bytes > budget:
-        raise ValueError(
-            f"flash_attention: K+V for seq_len={s}, head_dim={d} need "
-            f"{kv_bytes / 2**20:.0f} MiB of VMEM (>{budget >> 20} MiB "
-            "budget; override with HVD_TPU_FLASH_VMEM_BUDGET_MB). Shard "
-            "the sequence across devices with "
-            "horovod_tpu.parallel.ring_attention instead.")
-
-
 def dense_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
     """Reference-math dense attention over [B, S, H, D] (fp32 softmax)."""
@@ -394,10 +394,6 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
             return dense_attention_with_lse(q, k, v, causal, scale)
         interpret = False
     sm_scale = d ** -0.5 if scale is None else scale
-    if not interpret:
-        # Interpret mode (CPU tests) has no VMEM; only the real TPU
-        # lowering is bound by it.
-        _check_kv_vmem(s, d, k.dtype)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if causal and block_q != block_k:

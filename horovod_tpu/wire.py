@@ -124,3 +124,26 @@ def numpy_dtype(wire: DataType):
 
 def itemsize(wire: DataType) -> int:
     return _ITEMSIZE[DataType(wire)]
+
+
+def validate_alltoall_splits(splits, d0: int, k: int) -> np.ndarray:
+    """Normalize/validate an alltoall splits vector (shared by the host and
+    device data planes so their semantics cannot diverge).  ``None`` means
+    an even split of the ``d0`` first-dim rows over the ``k`` process-set
+    ranks.  Returns the int64 splits vector; raises on inconsistency."""
+    from .exceptions import HorovodInternalError
+
+    if splits is None:
+        if d0 % max(k, 1) != 0:
+            raise HorovodInternalError(
+                f"alltoall without splits requires first dim divisible by "
+                f"process set size ({d0} vs {k})")
+        return np.full((k,), d0 // max(k, 1), dtype=np.int64)
+    splits = np.ascontiguousarray(np.asarray(splits, dtype=np.int64))
+    if len(splits) != k:
+        raise HorovodInternalError(
+            f"alltoall splits must have one entry per process-set rank "
+            f"({len(splits)} given, {k} ranks)")
+    if int(splits.sum()) != d0:
+        raise HorovodInternalError("alltoall splits do not sum to first dim")
+    return splits

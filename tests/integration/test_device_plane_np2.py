@@ -101,6 +101,56 @@ WORKER = textwrap.dedent("""
     assert np.allclose(np.asarray(m), 12.0), np.asarray(m)
     assert stats["host_fallback"] == (1 if rank == 1 else 0), (rank, stats)
 
+    # Allgather on the device plane: equal dims, then ragged dims (rank 0
+    # contributes 1 row, rank 1 three rows) — the payload stays a
+    # jax.Array, only int64 counts cross the host ctrl channel.
+    ag = hvd.allgather(jnp.full((2, 3), float(rank), jnp.float32),
+                       name="devag")
+    assert isinstance(ag, jax.Array), type(ag)
+    expect_ag = np.repeat([0.0, 1.0], 2)[:, None] * np.ones(3)
+    assert np.allclose(np.asarray(ag), expect_ag), np.asarray(ag)
+    assert stats.get("allgather", 0) == 1, stats
+    nrag = 1 if rank == 0 else 3
+    agr = hvd.allgather(jnp.full((nrag, 2), float(rank), jnp.float32),
+                        name="devag.ragged")
+    expect_ragged = np.concatenate(
+        [np.zeros((1, 2)), np.ones((3, 2))]).astype(np.float32)
+    assert np.allclose(np.asarray(agr), expect_ragged), np.asarray(agr)
+    assert stats.get("allgather", 0) == 2, stats
+    # Zero-row contribution from rank 0 (regression: -1 reshapes are
+    # ambiguous on size-0 arrays).
+    nz = 0 if rank == 0 else 2
+    agz = hvd.allgather(jnp.full((nz, 2), 9.0, jnp.float32),
+                        name="devag.zero")
+    assert np.allclose(np.asarray(agz), 9.0 * np.ones((2, 2))), agz
+    assert np.asarray(agz).shape == (2, 2), agz.shape
+
+    # Alltoall on the device plane: uniform splits (one all_to_all), then
+    # ragged splits (pad-to-max exchange).  recv_splits mirror the host
+    # plane's contract.
+    send = jnp.arange(4.0, dtype=jnp.float32).reshape(4, 1) + 10.0 * rank
+    a2a, rsp = hvd.alltoall(send, name="deva2a")
+    assert isinstance(a2a, jax.Array), type(a2a)
+    expect_a2a = (np.concatenate([np.arange(2.0), np.arange(2.0) + 10.0])
+                  + 2.0 * rank).reshape(4, 1).astype(np.float32)
+    assert np.allclose(np.asarray(a2a), expect_a2a), np.asarray(a2a)
+    assert np.array_equal(np.asarray(rsp), [2, 2]), rsp
+    assert stats.get("alltoall", 0) == 1, stats
+    # Ragged: rank 0 sends [1, 2] rows, rank 1 sends [3, 0].
+    my_splits = [1, 2] if rank == 0 else [3, 0]
+    sendr = jnp.full((3, 2), float(rank + 1), jnp.float32)
+    ar, rspr = hvd.alltoall(sendr, splits=my_splits, name="deva2a.ragged")
+    if rank == 0:
+        expect_r = np.concatenate([np.ones((1, 2)), 2.0 * np.ones((3, 2))])
+        expect_split = [1, 3]
+    else:
+        expect_r = np.ones((2, 2))
+        expect_split = [2, 0]
+    assert np.allclose(np.asarray(ar), expect_r.astype(np.float32)), (
+        rank, np.asarray(ar))
+    assert np.array_equal(np.asarray(rspr), expect_split), rspr
+    assert stats.get("alltoall", 0) == 2, stats
+
     # join(): device traffic keeps flowing while rank 1 is joined — the
     # coordinator demotes via-join responses to the host plane so the
     # joined rank can zero-participate.

@@ -188,7 +188,12 @@ def test_adopt_rejects_tracer_and_numpy():
     plane = DevicePlane(_FakeCore(1), None)
     assert plane.adopt(np.ones(4, np.float32), OpType.ALLREDUCE,
                        ReduceOp.SUM, 0) is None
-    assert plane.adopt(jnp.ones(4), OpType.ALLTOALL, ReduceOp.SUM, 0) is None
+    # allgather/alltoall ride the plane for >=1-d arrays; scalars don't
+    # (no first dim to gather/split over — host plane semantics apply).
+    assert plane.adopt(jnp.ones(4), OpType.ALLTOALL, ReduceOp.SUM, 0) is not None
+    assert plane.adopt(jnp.ones(4), OpType.ALLGATHER, ReduceOp.SUM, 0) is not None
+    assert plane.adopt(jnp.float32(1.0), OpType.ALLGATHER,
+                       ReduceOp.SUM, 0) is None
     assert plane.adopt(jnp.ones(4), OpType.ALLREDUCE,
                        ReduceOp.ADASUM, 0) is None
 
@@ -284,3 +289,110 @@ def test_sim_pack_prescale_unpack_postscale():
     np.testing.assert_allclose(np.asarray(packed)[0, 4:], 0.0)
     res = plane._unpack()(packed, 0.5, ((4,),))
     np.testing.assert_allclose(np.asarray(res[0]), 3.0)
+
+
+def test_sim_allgather_program_uniform():
+    """Device allgather, equal first dims: every member receives the full
+    concatenation (reference analog: NCCLAllgather; SURVEY.md §2.2)."""
+    plane = DevicePlane(_FakeCore(4), None)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs), (AXIS,))
+    rows = [jnp.full((1, 2, 3), float(r), jnp.float32) for r in range(4)]
+    garr = plane._to_global(mesh, rows)
+    fn = plane._allgather_program(0, mesh, jnp.float32, (2, 2, 2, 2), (3,))
+    out = fn(garr)
+    expect = np.repeat(np.arange(4, dtype=np.float32), 2)[:, None] * np.ones(3)
+    for d in devs:
+        got = np.asarray(plane._shard_on(out, d)).reshape(8, 3)
+        np.testing.assert_allclose(got, expect)
+
+
+def test_sim_allgather_program_ragged():
+    """Ragged first dims (1, 3, 0, 2): members pad to the max, the program
+    slices per-member counts back out; a zero-row member contributes
+    nothing."""
+    plane = DevicePlane(_FakeCore(4), None)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs), (AXIS,))
+    counts = (1, 3, 0, 2)
+    maxn = 3
+    rows = []
+    for r, c in enumerate(counts):
+        row = jnp.full((1, c, 1), float(r), jnp.float32)
+        pad = jnp.zeros((1, maxn - c, 1), jnp.float32)
+        rows.append(jnp.concatenate([row, pad], axis=1))
+    garr = plane._to_global(mesh, rows)
+    fn = plane._allgather_program(0, mesh, jnp.float32, counts, (1,))
+    out = fn(garr)
+    expect = np.concatenate(
+        [np.full((c,), float(r)) for r, c in enumerate(counts)])[:, None]
+    for d in devs:
+        np.testing.assert_allclose(
+            np.asarray(plane._shard_on(out, d)).reshape(6, 1), expect)
+
+
+def test_sim_alltoall_program_uniform():
+    """Uniform splits lower to one tiled lax.all_to_all: member r sends
+    chunk j (valued 10*r + j) to member j."""
+    plane = DevicePlane(_FakeCore(4), None)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs), (AXIS,))
+    k = 4
+    rows = []
+    for r in range(k):
+        chunks = [jnp.full((2, 1), 10.0 * r + j, jnp.float32)
+                  for j in range(k)]
+        rows.append(jnp.concatenate(chunks)[None])    # [1, 8, 1]
+    garr = plane._to_global(mesh, rows)
+    splits_mat = tuple(tuple(2 for _ in range(k)) for _ in range(k))
+    fn = plane._alltoall_program(0, mesh, jnp.float32, splits_mat, 1)
+    out = fn(garr)
+    for j, d in enumerate(devs):
+        got = np.asarray(plane._shard_on(out, d)).reshape(-1)
+        expect = np.repeat([10.0 * r + j for r in range(k)], 2)
+        np.testing.assert_allclose(got, expect)
+
+
+def test_sim_alltoall_program_ragged():
+    """Ragged splits: member r sends r+j rows valued 10*r+j to member j;
+    the pad-to-max exchange reassembles exact (unpadded) per-source
+    counts in source order."""
+    plane = DevicePlane(_FakeCore(3), None)
+    devs = jax.devices()[:3]
+    mesh = Mesh(np.asarray(devs), (AXIS,))
+    k = 3
+    splits_mat = tuple(tuple(r + j for j in range(k)) for r in range(k))
+    d0s = [sum(row) for row in splits_mat]
+    d0max = max(d0s)
+    rows = []
+    for r in range(k):
+        chunks = [jnp.full((r + j, 1), 10.0 * r + j, jnp.float32)
+                  for j in range(k)]
+        row = jnp.concatenate([c for c in chunks if c.size] or
+                              [jnp.zeros((0, 1), jnp.float32)])
+        pad = jnp.zeros((d0max - row.shape[0], 1), jnp.float32)
+        rows.append(jnp.concatenate([row, pad])[None])
+    garr = plane._to_global(mesh, rows)
+    fn = plane._alltoall_program(0, mesh, jnp.float32, splits_mat, 1)
+    out = fn(garr)
+    for j, d in enumerate(devs):
+        recv = [splits_mat[r][j] for r in range(k)]
+        got = np.asarray(plane._shard_on(out, d)).reshape(-1)[:sum(recv)]
+        expect = np.concatenate(
+            [np.full((splits_mat[r][j],), 10.0 * r + j) for r in range(k)])
+        np.testing.assert_allclose(got, expect)
+
+
+def test_np1_allgather_alltoall_device_identity(hvd_single, transfer_guard):
+    """np=1: allgather returns the tensor itself, alltoall splits to self —
+    both complete on the device plane with no host copy."""
+    hvd = hvd_single
+    x = jnp.arange(6, dtype=jnp.float32).reshape(3, 2)
+    transfer_guard()
+    g = hvd.allgather(x, name="dp.ag")
+    a, recv = hvd.alltoall(x, name="dp.a2a")
+    jax.config.update("jax_transfer_guard", "allow")
+    assert isinstance(g, jax.Array) and isinstance(a, jax.Array)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(recv), [3])

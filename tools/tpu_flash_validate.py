@@ -261,14 +261,62 @@ def perf():
     return rows
 
 
+def sweep():
+    """Block-size sweep at seq 4096, bf16 — picks the kernel defaults."""
+    rows = []
+    b, h, d = 4, 8, 128
+    s = 4096
+    q, k, v = mk(b, s, h, d, jnp.bfloat16, key=8)
+    for bq in (128, 256, 512, 1024):
+        for bk in (128, 256, 512, 1024):
+            for causal in (False, True):
+                if causal and bq != bk:
+                    continue
+                try:
+                    f = jax.jit(functools.partial(
+                        flash_attention, causal=causal, block_q=bq,
+                        block_k=bk))
+                    g = jax.jit(jax.grad(
+                        lambda q, k, v: jnp.sum(flash_attention(
+                            q, k, v, causal, block_q=bq,
+                            block_k=bk).astype(jnp.float32)),
+                        argnums=(0, 1, 2)))
+                    tf = _time(f, q, k, v, iters=10)
+                    tg = _time(g, q, k, v, iters=5)
+                except Exception as e:
+                    print(json.dumps({"section": "sweep_skip", "bq": bq,
+                                      "bk": bk, "causal": causal,
+                                      "error": str(e)[:160]}), flush=True)
+                    continue
+                fl = 4.0 * b * h * s * s * d * (0.5 if causal else 1.0)
+                rows.append({"bq": bq, "bk": bk, "causal": causal,
+                             "fwd_ms": tf * 1e3, "fwdbwd_ms": tg * 1e3,
+                             "fwd_tflops": fl / tf / 1e12})
+                print(json.dumps({"section": "sweep_row", **rows[-1]}),
+                      flush=True)
+    log("sweep", rows=rows)
+
+
 def main():
     dev = jax.devices()[0]
     print(json.dumps({"section": "device", "kind": dev.device_kind,
                       "backend": jax.default_backend()}), flush=True)
-    fwd_numerics()
-    bwd_numerics()
-    lse_pair_vjp()
-    ring_composition()
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    sections = {"fwd": fwd_numerics, "bwd": bwd_numerics,
+                "lse": lse_pair_vjp, "ring": ring_composition,
+                "sweep": sweep}
+    if only == "sweep":
+        sweep()
+        print("RESULT " + json.dumps({"sweep_done": True}), flush=True)
+        return 0
+    if only and only != "perf":
+        sections[only]()
+        print("RESULT " + json.dumps({"numerics_ok": not FAILED,
+                                      "failed": FAILED}), flush=True)
+        return 0 if not FAILED else 1
+    if not only:
+        for fn in sections.values():
+            fn()
     rows = perf()
     import math
 

@@ -639,7 +639,6 @@ class DevicePlane:
         a few bytes), then the payload rides one cached XLA all_gather.
         Ragged first dims pad to the max and slice inside the program."""
         import jax
-        import jax.numpy as jnp
 
         psid = resp.process_set_id
         members = self._members(psid)

@@ -26,7 +26,6 @@ validated on hardware by tools/tpu_flash_validate.py.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax

@@ -1,0 +1,38 @@
+"""PyTorch binding: ``import horovod_tpu.torch as hvd``.
+
+Reference: horovod/torch/__init__.py (SURVEY.md §2.3-2.4) — the full torch
+public API (handle-based async collectives with true in-place semantics,
+grad-hook DistributedOptimizer, broadcast_parameters/optimizer_state/
+object, Compression, SyncBatchNorm, elastic TorchState/ElasticSampler)
+over this framework's core runtime: the same C++ negotiation spine, fusion
+buffer, response cache, and host TCP/shm data plane the JAX binding's eager
+path uses.  Torch tensors in this build are CPU-resident, so the host data
+plane is the natural (and reference-matching: CPU ops ran MPI/Gloo) home;
+a torch program and a JAX program launched by the same ``horovodrun`` can
+interoperate rank-for-rank.
+"""
+
+from __future__ import annotations
+
+# Shared runtime surface (init/shutdown/rank/size/... are framework-neutral).
+from ..basics import (cross_rank, cross_size, init, initialized,  # noqa: F401
+                      is_homogeneous, is_initialized, local_rank, local_size,
+                      mpi_built, mpi_enabled, mpi_threads_supported,
+                      nccl_built, num_devices, rank, shutdown, size,
+                      start_timeline, stop_timeline, tpu_built)
+from ..process_sets import (ProcessSet, add_process_set,  # noqa: F401
+                            global_process_set, remove_process_set)
+from . import elastic  # noqa: F401
+from .compression import Compression  # noqa: F401
+from .functions import (broadcast_object, broadcast_optimizer_state,  # noqa: F401
+                        broadcast_parameters)
+from .mpi_ops import (Adasum, Average, Max, Min, Product, Sum,  # noqa: F401
+                      allgather, allgather_async, allreduce, allreduce_,
+                      allreduce_async, allreduce_async_, alltoall,
+                      alltoall_async, barrier, broadcast, broadcast_,
+                      broadcast_async, broadcast_async_, grouped_allreduce,
+                      grouped_allreduce_, grouped_allreduce_async,
+                      grouped_allreduce_async_, join, poll, reducescatter,
+                      reducescatter_async, synchronize)
+from .optimizer import DistributedOptimizer  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401

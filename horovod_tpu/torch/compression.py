@@ -1,0 +1,63 @@
+"""Gradient compression for the torch binding.
+
+Reference: horovod/torch/compression.py (Compression.none / Compression.fp16);
+SURVEY.md §2.4.  Same algebra as the JAX binding's compression module: the
+compressor halves wire bytes by casting float32/float64 gradients to a
+16-bit dtype before the allreduce and restoring the original dtype after.
+``bf16`` is the TPU-native addition (wider exponent range than fp16 — the
+dtype the rest of this framework prefers on the wire).
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: torch.dtype = torch.float16
+
+    @classmethod
+    def compress(cls, tensor: torch.Tensor):
+        if tensor.dtype in (torch.float32, torch.float64):
+            return tensor.to(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor: torch.Tensor, ctx):
+        return tensor if ctx is None else tensor.to(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = torch.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = torch.bfloat16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression.{none,fp16}`` (+ TPU bf16)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,92 @@
+"""Elastic training state for torch models.
+
+Reference: horovod/torch/elastic/state.py (TorchState with per-handler
+model/optimizer sync) and horovod/torch/elastic/sampler.py; SURVEY.md §2.4,
+§3.5.  The retry loop itself (``@hvd.elastic.run``) and the sampler are
+shared with the JAX binding — elastic membership logic is framework-
+agnostic; only the snapshot/broadcast of framework objects differs.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import torch
+
+from ..elastic import run  # noqa: F401  (re-export: @hvd.elastic.run)
+from ..elastic.state import ElasticSampler, ObjectState  # noqa: F401
+from .functions import (broadcast_object, broadcast_optimizer_state,
+                        broadcast_parameters)
+
+
+class TorchState(ObjectState):
+    """Elastic state over torch modules/optimizers plus scalar attributes.
+
+    ``TorchState(model=model, optimizer=opt, epoch=0, batch=0)`` — module
+    and optimizer snapshots are deep-copied state_dicts (host CPU memory,
+    surviving any device teardown); ``sync()`` broadcasts rank 0's live
+    state to all ranks after a rendezvous round.
+    """
+
+    def __init__(self, model: torch.nn.Module = None,
+                 optimizer: torch.optim.Optimizer = None, **kwargs):
+        self._handled: Dict[str, Any] = {}
+        if model is not None:
+            self._handled["model"] = model
+        if optimizer is not None:
+            self._handled["optimizer"] = optimizer
+        # Extra modules/optimizers may arrive as kwargs (reference allows
+        # arbitrary names); route them by type.
+        plain = {}
+        for k, v in kwargs.items():
+            if isinstance(v, (torch.nn.Module, torch.optim.Optimizer)):
+                self._handled[k] = v
+            else:
+                plain[k] = v
+        self._handled_saved: Dict[str, Any] = {}
+        super().__init__(**plain)
+
+    def __getattr__(self, name: str):
+        handled = self.__dict__.get("_handled", {})
+        if name in handled:
+            return handled[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value) -> None:
+        # Reassigning a handled object (state.model = rebuilt_model in a
+        # reset callback) must update the handler, not shadow it in the
+        # instance dict — a shadowed module would train live while
+        # save/restore/sync kept operating on the dead one.
+        handled = self.__dict__.get("_handled")
+        if handled is not None and name in handled:
+            handled[name] = value
+        else:
+            super().__setattr__(name, value)
+
+    # -- snapshots ----------------------------------------------------------
+    def save(self) -> None:
+        super().save()
+        self._handled_saved = {
+            k: copy.deepcopy(v.state_dict())
+            for k, v in self._handled.items()}
+
+    def restore(self) -> None:
+        super().restore()
+        for k, snap in self._handled_saved.items():
+            self._handled[k].load_state_dict(copy.deepcopy(snap))
+
+    # -- cross-rank sync ----------------------------------------------------
+    def sync(self) -> None:
+        for k, v in self._handled.items():
+            if isinstance(v, torch.nn.Module):
+                broadcast_parameters(v.state_dict(), root_rank=0)
+            else:
+                broadcast_optimizer_state(v, root_rank=0)
+        plain = self._public_attrs()
+        if plain:
+            synced = broadcast_object(plain, root_rank=0,
+                                      name="elastic.torch_state")
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()

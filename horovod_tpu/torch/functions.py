@@ -1,0 +1,150 @@
+"""Parameter/optimizer-state/object broadcast for torch models.
+
+Reference: horovod/torch/functions.py (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object); SURVEY.md §2.4, §3.3 (the
+``hvd.broadcast_parameters(model.state_dict(), root_rank=0)`` idiom every
+reference training script starts with).
+
+Tensors broadcast in place through the grouped (atomic) negotiation path so
+a model's full state crosses in as few fused cycles as possible; non-tensor
+values ride the two-phase pickled-object broadcast.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Iterable, Optional, Tuple, Union
+
+import numpy as np
+import torch
+
+from ..process_sets import ProcessSet
+from . import mpi_ops
+
+
+class _TensorPlaceholder:
+    """Shape/dtype stand-in for a tensor inside the pickled phase-1
+    optimizer-state structure (the tensor itself rides phase 2)."""
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
+def broadcast_parameters(params: Union[dict, Iterable[Tuple[str, Any]]],
+                         root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None) -> None:
+    """Broadcast model parameters from ``root_rank`` in place.
+
+    Accepts ``model.state_dict()`` or ``model.named_parameters()`` exactly
+    like the reference.
+    """
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        if not isinstance(p, torch.Tensor):
+            raise ValueError(
+                f"broadcast_parameters got a non-tensor entry {name!r}; "
+                "broadcast non-tensor state with broadcast_object")
+        handles.append(mpi_ops.broadcast_async_(
+            p, root_rank, name=f"broadcast.params.{name}",
+            process_set=process_set))
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None
+                              ) -> None:
+    """Broadcast an optimizer's state from ``root_rank``.
+
+    The reference walks state_dict broadcasting tensors natively and
+    scalars via pickled callbacks.  Same split here: the (possibly empty on
+    non-root!) state dict is replaced wholesale by rank 0's pickled
+    structure first, then every tensor inside it is re-broadcast natively
+    so large moment buffers do not ride the pickle path.
+    """
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError(
+            "cannot broadcast torch.optim.LBFGS state "
+            "(reference has the same restriction)")
+
+    from .. import basics
+
+    state = optimizer.state_dict()
+    # Phase 1: structure only (param groups, scalar state like step
+    # counters).  Tensors are replaced by shape/dtype placeholders before
+    # pickling — Adam moments are ~2x model size and ride phase 2's native
+    # broadcast instead; non-root ranks (possibly with EMPTY state from a
+    # fresh optimizer) materialize zeros of the right geometry to receive
+    # into.
+    def _strip(v):
+        if isinstance(v, torch.Tensor):
+            return _TensorPlaceholder(tuple(v.shape), v.dtype)
+        if isinstance(v, dict):
+            return {k: _strip(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(_strip(x) for x in v)
+        return v
+
+    def _fill(v):
+        if isinstance(v, _TensorPlaceholder):
+            return torch.zeros(v.shape, dtype=v.dtype)
+        if isinstance(v, dict):
+            return {k: _fill(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(_fill(x) for x in v)
+        return v
+
+    synced = broadcast_object(_strip(state), root_rank,
+                              name="broadcast.opt_state.struct",
+                              process_set=process_set)
+    if basics.rank() != root_rank:
+        optimizer.load_state_dict(_fill(synced))
+
+    # Phase 2: native in-place broadcast of every tensor in the live state.
+    handles = []
+    for pid, pstate in sorted(optimizer.state_dict()["state"].items()):
+        for key, value in sorted(pstate.items()):
+            if isinstance(value, torch.Tensor):
+                handles.append(mpi_ops.broadcast_async_(
+                    value, root_rank,
+                    name=f"broadcast.opt_state.{pid}.{key}",
+                    process_set=process_set))
+    for h in handles:
+        mpi_ops.synchronize(h)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object (two-phase: size then
+    payload, the reference's protocol)."""
+    name = name or "broadcast.object"
+    from .. import basics
+
+    if basics.rank() == root_rank:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = torch.from_numpy(
+            np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
+        sz = torch.tensor([payload.numel()], dtype=torch.int64)
+    else:
+        payload = None
+        sz = torch.zeros(1, dtype=torch.int64)
+
+    sz = mpi_ops.broadcast(sz, root_rank, name=f"{name}.sz",
+                           process_set=process_set)
+    if payload is None:
+        payload = torch.empty(int(sz[0]), dtype=torch.uint8)
+    payload = mpi_ops.broadcast(payload, root_rank, name=f"{name}.payload",
+                                process_set=process_set)
+    return pickle.loads(payload.numpy().tobytes())

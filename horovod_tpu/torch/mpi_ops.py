@@ -1,0 +1,407 @@
+"""Torch-flavored collective ops over the same core runtime.
+
+Reference analogs: horovod/torch/mpi_ops.py (allreduce/allreduce_async_/
+synchronize/poll, the HandleManager pattern of handle_manager.cc) and
+horovod/torch/adapter_v2.cc (TorchTensor bridging); SURVEY.md §2.3-2.4.
+
+Torch tensors here are host-resident (CPU build), so every op rides the
+eager spine — negotiation over the socket controller, fusion, response
+cache, and the host TCP/shm data plane — exactly the path the reference's
+CPU (MPI/Gloo) ops take.  Unlike the JAX binding, torch tensors are
+mutable, so the in-place ``*_``` variants have true reference semantics:
+the reduced result is written back into the input tensor's storage.
+
+The handle contract matches the reference: ``*_async`` returns an int
+handle; ``synchronize(handle)`` blocks and returns the output tensor
+(writing in place first when the op was an in-place variant);
+``poll(handle)`` is a non-blocking completion test.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import torch
+
+from ..context import HorovodContext
+from ..process_sets import ProcessSet, _resolve_psid
+from ..wire import OpType, ReduceOp
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    """Host numpy view of a torch tensor (zero-copy when contiguous).
+
+    bfloat16 has no numpy native dtype; it crosses as a uint16
+    bit-reinterpretation viewed as ml_dtypes.bfloat16, which the wire/data
+    plane already reduce natively (16-bit reductions, wire.py dtype table).
+    """
+    t = tensor.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    if t.dtype == torch.bfloat16:
+        if _BF16 is None:
+            return t.float().numpy()
+        return t.view(torch.uint16).numpy().view(_BF16)
+    return t.numpy()
+
+
+def _from_numpy(arr: np.ndarray) -> torch.Tensor:
+    arr = np.ascontiguousarray(arr)
+    if _BF16 is not None and arr.dtype == _BF16:
+        return torch.from_numpy(arr.view(np.uint16).copy()).view(
+            torch.bfloat16)
+    return torch.from_numpy(arr.copy())
+
+
+def _write_back(target: torch.Tensor, arr: np.ndarray) -> torch.Tensor:
+    out = _from_numpy(arr)
+    if out.shape != target.shape:
+        if out.numel() == target.numel():
+            # The wire flattens 0-dim scalars to shape (1,); same payload.
+            out = out.reshape(target.shape)
+        else:
+            # allgather/alltoall change dim 0; in-place parity is only
+            # offered for shape-preserving ops, so this is an internal error.
+            raise RuntimeError(
+                f"in-place write-back shape mismatch: {out.shape} vs "
+                f"{tuple(target.shape)}")
+    # no_grad: in-place targets may be requires-grad leaves
+    # (broadcast_parameters over named_parameters hands us nn.Parameters);
+    # a tracked copy_ into a leaf raises in autograd.
+    with torch.no_grad():
+        target.copy_(out.to(target.dtype))
+    return target
+
+
+class _HandleTable:
+    """Maps core handles to torch-side completion actions (the reference's
+    handle_manager.cc role): the in-place target to write back into, or
+    None for out-of-place ops."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[int, Optional[torch.Tensor]] = {}
+
+    def register(self, handle: int, target: Optional[torch.Tensor]) -> int:
+        with self._lock:
+            self._entries[handle] = target
+        return handle
+
+    def pop(self, handle: int) -> Optional[torch.Tensor]:
+        with self._lock:
+            return self._entries.pop(handle, None)
+
+
+_handles = _HandleTable()
+
+# Reference-parity ReduceOp aliases (horovod.torch exposes these names).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+Adasum = ReduceOp.ADASUM
+
+
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    if average is not None:
+        if op is not None:
+            raise ValueError(
+                "specify either op or the deprecated average=, not both")
+        return ReduceOp.AVERAGE if average else ReduceOp.SUM
+    return ReduceOp.AVERAGE if op is None else op
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+
+def _allreduce_enqueue(tensor: torch.Tensor, name: Optional[str],
+                       op: ReduceOp, prescale_factor: float,
+                       postscale_factor: float,
+                       process_set: Optional[ProcessSet],
+                       inplace: bool) -> int:
+    h = HorovodContext.instance().enqueue(
+        _to_numpy(tensor), OpType.ALLREDUCE, name=name, reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_resolve_psid(process_set))
+    return _handles.register(h, tensor if inplace else None)
+
+
+def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None,
+                    op: Optional[ReduceOp] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    return _allreduce_enqueue(tensor, name, _resolve_op(op, average),
+                              prescale_factor, postscale_factor,
+                              process_set, inplace=False)
+
+
+def allreduce_async_(tensor: torch.Tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[ReduceOp] = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0,
+                     process_set: Optional[ProcessSet] = None) -> int:
+    """In-place async allreduce: ``synchronize`` writes the reduction back
+    into ``tensor`` (reference: allreduce_async_ in torch/mpi_ops.py)."""
+    return _allreduce_enqueue(tensor, name, _resolve_op(op, average),
+                              prescale_factor, postscale_factor,
+                              process_set, inplace=True)
+
+
+def allreduce(tensor: torch.Tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=None,
+              op: Optional[ReduceOp] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    """Average (default) or otherwise reduce ``tensor`` across ranks,
+    returning a new tensor."""
+    from .compression import Compression
+
+    compression = compression or Compression.none
+    compressed, ctx = compression.compress(tensor)
+    h = allreduce_async(compressed, average=average, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+    return compression.decompress(synchronize(h), ctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op: Optional[ReduceOp] = None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+               process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    """In-place synchronous allreduce."""
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
+                            average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[ReduceOp] = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: Optional[ProcessSet] = None,
+                            _inplace: bool = False) -> List[int]:
+    rop = _resolve_op(op, average)
+    ctx = HorovodContext.instance()
+    gkey = ctx.group_key_for(name)
+    handles = []
+    for i, t in enumerate(tensors):
+        h = ctx.enqueue(_to_numpy(t), OpType.ALLREDUCE,
+                        name=f"{name}.{i}" if name else None, reduce_op=rop,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set_id=_resolve_psid(process_set),
+                        group_key=gkey, group_size=len(tensors))
+        handles.append(_handles.register(h, t if _inplace else None))
+    return handles
+
+
+def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
+                             average: Optional[bool] = None,
+                             name: Optional[str] = None,
+                             op: Optional[ReduceOp] = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0,
+                             process_set: Optional[ProcessSet] = None
+                             ) -> List[int]:
+    return grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set, _inplace=True)
+
+
+def grouped_allreduce(tensors: Sequence[torch.Tensor],
+                      average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      process_set: Optional[ProcessSet] = None
+                      ) -> List[torch.Tensor]:
+    return [synchronize(h) for h in grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)]
+
+
+def grouped_allreduce_(tensors: Sequence[torch.Tensor],
+                       average: Optional[bool] = None,
+                       name: Optional[str] = None,
+                       op: Optional[ReduceOp] = None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0,
+                       process_set: Optional[ProcessSet] = None
+                       ) -> List[torch.Tensor]:
+    return [synchronize(h) for h in grouped_allreduce_async_(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)]
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    h = HorovodContext.instance().enqueue(
+        _to_numpy(tensor), OpType.ALLGATHER, name=name,
+        process_set_id=_resolve_psid(process_set))
+    return _handles.register(h, None)
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    """Concatenate each rank's tensor along dim 0 (ranks may differ in
+    dim 0, reference semantics)."""
+    return synchronize(allgather_async(tensor, name=name,
+                                       process_set=process_set))
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    h = HorovodContext.instance().enqueue(
+        _to_numpy(tensor), OpType.BROADCAST, name=name, root_rank=root_rank,
+        process_set_id=_resolve_psid(process_set))
+    return _handles.register(h, None)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> int:
+    h = HorovodContext.instance().enqueue(
+        _to_numpy(tensor), OpType.BROADCAST, name=name, root_rank=root_rank,
+        process_set_id=_resolve_psid(process_set))
+    return _handles.register(h, tensor)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                       process_set=process_set))
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None,
+               process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name=name,
+                                        process_set=process_set))
+
+
+# ---------------------------------------------------------------------------
+# alltoall / reducescatter
+# ---------------------------------------------------------------------------
+
+
+def alltoall_async(tensor: torch.Tensor, splits=None,
+                   name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    if splits is not None and isinstance(splits, torch.Tensor):
+        splits = splits.numpy()
+    h = HorovodContext.instance().enqueue(
+        _to_numpy(tensor), OpType.ALLTOALL, name=name, splits=splits,
+        process_set_id=_resolve_psid(process_set))
+    return _handles.register(h, None)
+
+
+def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None
+             ) -> Tuple[torch.Tensor, torch.Tensor]:
+    """Distribute slices of dim 0 to all ranks; returns
+    ``(received_tensor, received_splits)`` like the reference."""
+    return synchronize(alltoall_async(tensor, splits=splits, name=name,
+                                      process_set=process_set))
+
+
+def reducescatter_async(tensor: torch.Tensor,
+                        op: ReduceOp = ReduceOp.AVERAGE,
+                        name: Optional[str] = None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    h = HorovodContext.instance().enqueue(
+        _to_numpy(tensor), OpType.REDUCESCATTER, name=name, reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_id=_resolve_psid(process_set))
+    return _handles.register(h, None)
+
+
+def reducescatter(tensor: torch.Tensor, op: ReduceOp = ReduceOp.AVERAGE,
+                  name: Optional[str] = None,
+                  prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0,
+                  process_set: Optional[ProcessSet] = None) -> torch.Tensor:
+    return synchronize(reducescatter_async(
+        tensor, op=op, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
+
+
+# ---------------------------------------------------------------------------
+# barrier / join / handles
+# ---------------------------------------------------------------------------
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    from .. import mpi_ops as _jax_mpi_ops
+
+    _jax_mpi_ops.barrier(process_set=process_set)
+
+
+def join() -> int:
+    from .. import mpi_ops as _jax_mpi_ops
+
+    return _jax_mpi_ops.join()
+
+
+def synchronize(handle: int):
+    """Block until the op behind ``handle`` completes.  Writes in-place
+    targets back into their original storage, converts eager results to
+    torch, and passes the alltoall (tensor, splits) pair through."""
+    # Pop before waiting: a raising collective (elastic failure, shutdown)
+    # must not leak the table entry and its strong tensor reference.
+    target = _handles.pop(handle)
+    result = HorovodContext.instance().synchronize(handle)
+    if isinstance(result, tuple):  # alltoall: (data, recv_splits)
+        data, rsplits = result
+        return (_from_numpy(np.asarray(data)),
+                torch.from_numpy(np.asarray(rsplits).copy()))
+    arr = np.asarray(result)
+    if target is not None:
+        return _write_back(target, arr)
+    return _from_numpy(arr)
+
+
+def poll(handle: int) -> bool:
+    """True if the async op behind ``handle`` has completed.  A handle that
+    was already synchronized (retired from the core's table) is complete by
+    definition — reference poll semantics."""
+    try:
+        return HorovodContext.instance().poll(handle)
+    except ValueError:
+        return True

@@ -1,0 +1,204 @@
+"""Grad-hook DistributedOptimizer for torch models.
+
+Reference: horovod/torch/optimizer.py — _DistributedOptimizer registers a
+hook per parameter that fires when autograd finishes accumulating that
+parameter's gradient and immediately enqueues an async in-place allreduce;
+``step()`` synchronizes every outstanding handle and then runs the wrapped
+optimizer.  That overlap of communication with the remainder of backward is
+the Horovod paper's core trick, and it maps 1:1 onto this framework's eager
+spine (negotiation + fusion happen in the background while backprop still
+runs).  SURVEY.md §2.4, §3.3.
+
+``backward_passes_per_step`` aggregates N backward passes locally before
+reducing (reference: gradient accumulation for large effective batches);
+the enqueued allreduce carries ``prescale_factor=1/N`` so the reduced
+gradient is the average over passes as well as ranks.
+
+Implementation note: like the reference, the factory builds a dynamic
+subclass of the wrapped optimizer's own class, so the returned object
+isinstance-checks as (e.g.) ``torch.optim.SGD`` and keeps working with LR
+schedulers and other code that inspects the optimizer type.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, Optional, Tuple
+
+import torch
+
+from ..process_sets import ProcessSet
+from . import mpi_ops
+from .compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op=mpi_ops.Average,
+                 gradient_predivide_factor: float = 1.0,
+                 process_set: Optional[ProcessSet] = None):
+        super(self.__class__, self).__init__(params)
+
+        if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average")
+
+        named_parameters = list(named_parameters or [])
+        all_params = [p for group in self.param_groups
+                      for p in group["params"]]
+        if named_parameters:
+            named = {id(p): name for name, p in named_parameters}
+            dups = len(named_parameters) - len(
+                {name for name, _ in named_parameters})
+            if dups:
+                raise ValueError("named_parameters contains duplicate names")
+        else:
+            named = {}
+        # Names must MATCH across ranks for negotiation, so the fallback is
+        # positional, not id()-based (reference uses the same scheme).
+        self._param_names = {
+            id(p): named.get(id(p), f"allreduce.noname.{i}")
+            for i, p in enumerate(all_params)}
+
+        self._compression = compression
+        self._bpps = max(1, int(backward_passes_per_step))
+        self._op = op
+        self._predivide = float(gradient_predivide_factor)
+        self._process_set = process_set
+        self._handles: dict = {}  # param id -> (handle, compression ctx)
+        self._passes: dict = {}  # param id -> accumulation count
+        self._should_sync = True
+        self._hook_registered = []
+        self._register_hooks(all_params)
+
+    # -- hooks --------------------------------------------------------------
+
+    def _register_hooks(self, params: Iterable[torch.nn.Parameter]) -> None:
+        for p in params:
+            if p.requires_grad:
+                h = p.register_post_accumulate_grad_hook(self._make_hook())
+                self._hook_registered.append(h)
+
+    def _make_hook(self):
+        def hook(p: torch.nn.Parameter) -> None:
+            pid = id(p)
+            self._passes[pid] = self._passes.get(pid, 0) + 1
+            if self._passes[pid] >= self._bpps:
+                self._passes[pid] = 0
+                self._allreduce_grad_async(p)
+
+        return hook
+
+    def _allreduce_grad_async(self, p: torch.nn.Parameter) -> None:
+        pid = id(p)
+        if pid in self._handles:
+            # A second reduce before step() consumed the first means the
+            # user ran more backward passes than backward_passes_per_step;
+            # drain the stale handle so the new one wins (reference raises
+            # in assert-mode, absorbs otherwise).  A retired handle (the
+            # collective failed and an elastic reset already swept the
+            # core table) just drops.
+            try:
+                mpi_ops.synchronize(self._handles.pop(pid)[0])
+            except ValueError:
+                pass
+        op, prescale, postscale = self._op, 1.0 / self._bpps, 1.0
+        if self._predivide != 1.0:
+            # Reference semantics: split the 1/size of Average into
+            # pre/post parts around the summation for numerical range
+            # control; op becomes Sum with explicit scaling.
+            op = mpi_ops.Sum
+            prescale /= self._predivide
+            postscale = self._predivide / _set_size(self._process_set)
+        compressed, ctx = self._compression.compress(p.grad)
+        h = mpi_ops.allreduce_async_(
+            compressed, name=self._param_names[pid], op=op,
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=self._process_set)
+        self._handles[pid] = (h, ctx, compressed, p)
+
+    # -- public surface (reference parity) ---------------------------------
+
+    def synchronize(self) -> None:
+        """Wait for every outstanding gradient allreduce and write the
+        reduced (decompressed) gradients back into ``p.grad``.
+
+        Handles are always cleared, even when a collective raises: the
+        elastic retry loop catches the error, restores state, and re-runs
+        the step — the optimizer must come back usable, not wedged on
+        stale handles from the failed round."""
+        entries = list(self._handles.items())
+        try:
+            for pid, (h, ctx, compressed, p) in entries:
+                reduced = mpi_ops.synchronize(h)  # in-place: `compressed`
+                restored = self._compression.decompress(reduced, ctx)
+                if restored.data_ptr() != p.grad.data_ptr():
+                    with torch.no_grad():
+                        p.grad.copy_(restored.to(p.grad.dtype))
+        except BaseException:
+            # Sweep the not-yet-synchronized handles out of the module
+            # write-back table too — they hold strong gradient-tensor
+            # references and mpi_ops.synchronize will never run for them.
+            for _, (h, *_rest) in entries:
+                mpi_ops._handles.pop(h)
+            raise
+        finally:
+            self._handles.clear()
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Inside this context, ``step()`` skips the implicit synchronize —
+        for callers that invoked :meth:`synchronize` manually (reference:
+        optimizer.skip_synchronize)."""
+        self._should_sync = False
+        try:
+            yield
+        finally:
+            self._should_sync = True
+
+    def step(self, closure=None):
+        # A missed hook (e.g. a parameter that got no gradient this step)
+        # simply has no handle; the reference likewise reduces only what
+        # backward produced.
+        if self._should_sync:
+            self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad called with allreduces in flight; call step() "
+                "or synchronize() first (reference raises the same way)")
+        self._passes = {}
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def _set_size(process_set: Optional[ProcessSet]) -> int:
+    # ProcessSet.size(), not len(ranks): the global set resolves its
+    # membership lazily and keeps ranks = [].
+    if process_set is not None:
+        return process_set.size()
+    from .. import basics
+
+    return basics.size()
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[
+                             Iterable[Tuple[str, torch.nn.Parameter]]] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=mpi_ops.Average,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set: Optional[ProcessSet] = None
+                         ) -> torch.optim.Optimizer:
+    """Wrap a torch optimizer so gradients are averaged across ranks during
+    backward (reference factory: horovod/torch/optimizer.py
+    DistributedOptimizer)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor,
+               process_set)

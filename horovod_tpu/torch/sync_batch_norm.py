@@ -1,0 +1,136 @@
+"""Cross-rank synchronized BatchNorm for torch models.
+
+Reference: horovod/torch/sync_batch_norm.py (SyncBatchNorm riding
+hvd.allreduce for the stats); SURVEY.md §2.4.  Training-mode statistics are
+the global batch's: each rank reduces [sum, sum-of-squares, count] with one
+summed allreduce, normalizes with the global mean/var, and the backward
+reduces the two per-channel gradient sums the chain rule needs.  Eval mode
+uses running stats with no communication, and a world of one degrades to
+ordinary BatchNorm exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from ..process_sets import ProcessSet
+from . import mpi_ops
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, eps, momentum, running_mean,
+                running_var, process_set, name):
+        # Channel-wise sums over every non-channel dim, globally reduced.
+        # Stats accumulate in float32 regardless of input dtype: fp16 sums
+        # and sum-of-squares overflow at ordinary batch sizes (count alone
+        # exceeds fp16 range past 65504 elements/channel).
+        dims = [0] + list(range(2, x.dim()))
+        xf = x.float()
+        local_count = x.numel() // x.size(1)
+        stats = torch.cat([
+            xf.sum(dims), (xf * xf).sum(dims),
+            torch.tensor([float(local_count)], dtype=torch.float32)])
+        stats = mpi_ops.allreduce(stats, op=mpi_ops.Sum,
+                                  name=f"{name}.fwd",
+                                  process_set=process_set)
+        c = x.size(1)
+        count = stats[-1].clamp_min(1.0)
+        mean = stats[:c] / count
+        var = stats[c:2 * c] / count - mean * mean
+        var = var.clamp_min(0.0)
+
+        if running_mean is not None:
+            with torch.no_grad():
+                # Unbiased var for running stats, biased for normalization
+                # (torch BatchNorm semantics).
+                n = float(count)
+                unbiased = var * (n / max(n - 1.0, 1.0))
+                running_mean.mul_(1 - momentum).add_(momentum * mean)
+                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+
+        shape = [1, c] + [1] * (x.dim() - 2)
+        inv_std = torch.rsqrt(var + eps)
+        xhat = (xf - mean.view(shape)) * inv_std.view(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.view(shape).float()
+        if bias is not None:
+            out = out + bias.view(shape).float()
+        ctx.save_for_backward(xhat, inv_std, weight, count)
+        ctx.process_set = process_set
+        ctx.name = name
+        return out.to(x.dtype)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        xhat, inv_std, weight, count = ctx.saved_tensors
+        dims = [0] + list(range(2, grad_out.dim()))
+        c = grad_out.size(1)
+        shape = [1, c] + [1] * (grad_out.dim() - 2)
+
+        go = grad_out.float()
+        g = go if weight is None else go * weight.view(shape).float()
+        # The two cross-rank sums the chain rule through global mean/var
+        # needs; one fused allreduce.
+        sums = torch.cat([g.sum(dims), (g * xhat).sum(dims)])
+        sums = mpi_ops.allreduce(sums, op=mpi_ops.Sum,
+                                 name=f"{ctx.name}.bwd",
+                                 process_set=ctx.process_set)
+        mean_g = (sums[:c] / count).view(shape)
+        mean_gx = (sums[c:] / count).view(shape)
+        grad_x = ((g - mean_g - xhat * mean_gx)
+                  * inv_std.view(shape)).to(grad_out.dtype)
+
+        grad_w = ((go * xhat).sum(dims).to(weight.dtype)
+                  if weight is not None else None)
+        grad_b = (go.sum(dims) if ctx.needs_input_grad[2] else None)
+        return grad_x, grad_w, grad_b, None, None, None, None, None, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in ``nn.BatchNorm*d`` replacement whose training statistics are
+    computed over the global batch across all ranks of ``process_set``."""
+
+    _instances = 0
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 track_running_stats: bool = True,
+                 process_set: Optional[ProcessSet] = None):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        self._process_set = process_set
+        # Collective names must match across ranks: construction order is
+        # the contract (same model built the same way on every rank), the
+        # same assumption DistributedOptimizer's positional fallback makes.
+        self._name = f"sync_bn.{SyncBatchNorm._instances}"
+        SyncBatchNorm._instances += 1
+
+    def _check_input_dim(self, x) -> None:
+        if x.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {x.dim()}D)")
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        self._check_input_dim(x)
+        from .. import basics
+
+        world = (self._process_set.size() if self._process_set
+                 else (basics.size() if basics.is_initialized() else 1))
+        if not self.training or world == 1:
+            return super().forward(x)
+        if self.momentum is None:
+            raise ValueError(
+                "SyncBatchNorm requires a fixed momentum (cumulative "
+                "moving average is not supported; reference restriction)")
+        if self.track_running_stats and self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)  # torch _BatchNorm parity
+        return _SyncBatchNormFn.apply(
+            x, self.weight, self.bias, self.eps, self.momentum,
+            self.running_mean if self.track_running_stats else None,
+            self.running_var if self.track_running_stats else None,
+            self._process_set, self._name)

@@ -305,6 +305,10 @@ def main():
     sections = {"fwd": fwd_numerics, "bwd": bwd_numerics,
                 "lse": lse_pair_vjp, "ring": ring_composition,
                 "sweep": sweep}
+    if only and only != "perf" and only not in sections:
+        print(f"unknown section {only!r}; valid: "
+              f"{', '.join(list(sections) + ['perf'])}", file=sys.stderr)
+        return 2
     if only == "sweep":
         sweep()
         print("RESULT " + json.dumps({"sweep_done": True}), flush=True)
@@ -315,8 +319,12 @@ def main():
                                       "failed": FAILED}), flush=True)
         return 0 if not FAILED else 1
     if not only:
-        for fn in sections.values():
-            fn()
+        # The block-size sweep is a standalone tuning mode ("sweep" arg),
+        # not part of routine validation — it adds many minutes of
+        # hardware compiles and feeds nothing into the RESULT summary.
+        for name, fn in sections.items():
+            if name != "sweep":
+                fn()
     rows = perf()
     import math
 

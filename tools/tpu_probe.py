@@ -1,0 +1,73 @@
+"""Standing TPU-tunnel probe (VERDICT r4 #3: "keep the standing probe").
+
+Runs a tiny device-enumeration + matmul in a SUBPROCESS with a hard
+timeout, so a wedged tunnel can never hang the caller.  Appends one JSON
+line per probe to ``/tmp/tpu_probe.jsonl`` and exits 0 iff the chip both
+enumerated AND executed a matmul.
+
+The subprocess is the important part: libtpu is single-owner and a
+half-dead tunnel answers ``jax.devices()`` but wedges on the first
+executable load — both failure modes observed in rounds 2-5.  Holding
+the chip in a long-lived prober would also starve the real work, so the
+probe releases it immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROBE_SRC = r"""
+import time, json
+t0 = time.time()
+import jax
+devs = jax.devices()
+t_enum = time.time() - t0
+import jax.numpy as jnp
+y = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+t_exec = time.time() - t0
+print("PROBE_OK " + json.dumps({
+    "platform": devs[0].platform,
+    "device_kind": getattr(devs[0], "device_kind", "?"),
+    "enum_s": round(t_enum, 1),
+    "exec_s": round(t_exec, 1),
+}), flush=True)
+"""
+
+
+def probe(timeout_s: float = 240.0) -> dict:
+    t0 = time.time()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the real chip, not CPU
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        ok_line = next((ln for ln in out.stdout.splitlines()
+                        if ln.startswith("PROBE_OK ")), None)
+        if ok_line and out.returncode == 0:
+            rec = {"ok": True, **json.loads(ok_line[len("PROBE_OK "):])}
+        else:
+            tail = (out.stdout + out.stderr).strip().splitlines()[-3:]
+            rec = {"ok": False, "rc": out.returncode, "tail": tail}
+    except subprocess.TimeoutExpired:
+        rec = {"ok": False, "rc": "timeout", "timeout_s": timeout_s}
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rec["wall_s"] = round(time.time() - t0, 1)
+    return rec
+
+
+def main():
+    timeout_s = float(sys.argv[1]) if len(sys.argv) > 1 else 240.0
+    rec = probe(timeout_s)
+    with open("/tmp/tpu_probe.jsonl", "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    sys.exit(0 if rec["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

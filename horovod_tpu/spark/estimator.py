@@ -93,35 +93,41 @@ class JaxEstimator:
         FileSystem explicitly."""
         if filesystem == "store":
             filesystem = self.store.filesystem()
-        worker_args = (self.model, self.loss, self.optimizer, None, None,
-                       self.batch_size, self.epochs, self.seed,
-                       train_path, tuple(self.feature_cols),
-                       tuple(self.label_cols), filesystem)
-        if self.backend == "spark":
-            from . import run as spark_run
-
-            out = spark_run(_train_worker, args=worker_args,
-                            num_proc=self.num_proc)[0]
-        else:
-            out = _train_worker(*worker_args)
+        out = self._dispatch(
+            (self.model, self.loss, self._worker_optimizer(), None, None,
+             self.batch_size, self.epochs, self.seed, train_path,
+             tuple(self.feature_cols), tuple(self.label_cols), filesystem))
         return self._finish(out)
 
     def _fit_arrays(self, x: np.ndarray, y: np.ndarray) -> "JaxModel":
-        worker_args = (self.model, self.loss, self.optimizer, x, y,
-                       self.batch_size, self.epochs, self.seed)
+        out = self._dispatch(
+            (self.model, self.loss, self._worker_optimizer(), x, y,
+             self.batch_size, self.epochs, self.seed))
+        return self._finish(out)
+
+    # -- subclass hooks -----------------------------------------------------
+    # _WORKER is bound after the worker functions are defined (module
+    # bottom): it must be a plain module-level function so the spark
+    # backend can pickle it to executors.
+
+    def _worker_optimizer(self):
+        """What to ship workers as the optimizer argument (an optax
+        transformation is directly picklable; torch overrides)."""
+        return self.optimizer
+
+    def _dispatch(self, worker_args):
+        worker = type(self)._WORKER
         if self.backend == "spark":
             from . import run as spark_run
 
-            out = spark_run(_train_worker, args=worker_args,
-                            num_proc=self.num_proc)[0]
-        else:
-            out = _train_worker(*worker_args)
-        return self._finish(out)
+            return spark_run(worker, args=worker_args,
+                             num_proc=self.num_proc)[0]
+        return worker(*worker_args)
 
-    def _finish(self, out) -> "JaxModel":
-        params, history = out
-        ckpt = self.store.get_checkpoint_path(self.run_id)
-        self.store.write(ckpt, pickle.dumps(params))
+    def _write_artifacts(self, payload: Any, history) -> dict:
+        """Checkpoint + metadata through the Store; returns the metadata."""
+        self.store.write(self.store.get_checkpoint_path(self.run_id),
+                         pickle.dumps(payload))
         import json
 
         meta = {
@@ -133,6 +139,11 @@ class JaxEstimator:
         }
         self.store.write(self.store.get_metadata_path(self.run_id),
                          json.dumps(meta).encode())
+        return meta
+
+    def _finish(self, out) -> "JaxModel":
+        params, history = out
+        meta = self._write_artifacts(params, history)
         return JaxModel(self.model, params, metadata=meta)
 
 
@@ -157,6 +168,42 @@ class JaxModel:
         return cls(model, params)
 
 
+def _make_epoch_batches(x, y, batch_size, rank, size,
+                        train_path: Optional[str],
+                        feature_cols: Tuple[str, ...],
+                        label_cols: Tuple[str, ...], fs_spec):
+    """Rank-sharded batch source shared by the JAX and torch workers:
+    in-memory slices or Parquet row groups."""
+
+    def epoch_batches():
+        if train_path is not None:
+            from .data import ParquetShardReader
+
+            reader = ParquetShardReader(train_path, rank, size, batch_size,
+                                        filesystem=fs_spec)
+            for batch in reader.batches():
+                bx = np.column_stack([batch[c] for c in feature_cols]) \
+                    if len(feature_cols) > 1 else batch[feature_cols[0]]
+                by = np.column_stack([batch[c] for c in label_cols]) \
+                    if len(label_cols) > 1 else batch[label_cols[0]]
+                yield bx, by
+            return
+        per_rank = len(x) // max(size, 1)
+        if per_rank == 0:
+            raise ValueError(
+                f"dataset of {len(x)} samples cannot be sharded over "
+                f"{size} workers")
+        # Trim to whole batches when possible; otherwise train on the
+        # full (smaller-than-batch) shard rather than skipping training.
+        n = per_rank // batch_size * batch_size or per_rank
+        xs = x[rank * per_rank:rank * per_rank + n]
+        ys = y[rank * per_rank:rank * per_rank + n]
+        for i in range(0, len(xs), batch_size):
+            yield xs[i:i + batch_size], ys[i:i + batch_size]
+
+    return epoch_batches
+
+
 def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
                   seed, train_path: Optional[str] = None,
                   feature_cols: Tuple[str, ...] = ("features",),
@@ -175,40 +222,21 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
         hvd.init(build_mesh=False)
     try:
         rank, size = hvd.rank(), hvd.size()
+        epoch_batches = _make_epoch_batches(
+            x, y, batch_size, rank, size, train_path, feature_cols,
+            label_cols, fs_spec)
 
-        def epoch_batches():
-            if train_path is not None:
-                from .data import ParquetShardReader
-
-                reader = ParquetShardReader(train_path, rank, size,
-                                            batch_size,
-                                            filesystem=fs_spec)
-                for batch in reader.batches():
-                    bx = np.column_stack([batch[c] for c in feature_cols]) \
-                        if len(feature_cols) > 1 else batch[feature_cols[0]]
-                    by = np.column_stack([batch[c] for c in label_cols]) \
-                        if len(label_cols) > 1 else batch[label_cols[0]]
-                    yield bx, by
-                return
-            per_rank = len(x) // max(size, 1)
-            if per_rank == 0:
-                raise ValueError(
-                    f"dataset of {len(x)} samples cannot be sharded over "
-                    f"{size} workers")
-            # Trim to whole batches when possible; otherwise train on the
-            # full (smaller-than-batch) shard rather than skipping training.
-            n = per_rank // batch_size * batch_size or per_rank
-            xs = x[rank * per_rank:rank * per_rank + n]
-            ys = y[rank * per_rank:rank * per_rank + n]
-            for i in range(0, len(xs), batch_size):
-                yield xs[i:i + batch_size], ys[i:i + batch_size]
-
-        first = next(iter(epoch_batches()), None)
+        # The emptiness/shape probe's reader is kept: epoch 0 resumes from
+        # it instead of re-reading (and re-decoding) the first Parquet
+        # batch of every shard.
+        probe_rest = iter(epoch_batches())
+        first = next(probe_rest, None)
         if first is None:
             raise ValueError(
                 f"rank {rank}: empty training shard — the dataset has fewer "
                 f"row groups than workers; materialize with more partitions "
                 f"or reduce num_proc")
+        probed = (first, probe_rest)
         params = model.init(jax.random.PRNGKey(seed),
                             jnp.asarray(first[0][:1]))
         params = hvd.broadcast_parameters(params, root_rank=0)
@@ -223,7 +251,13 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
         history = []
         for epoch in range(epochs):
             epoch_loss, nb = 0.0, 0
-            batches = epoch_batches()
+            if probed is not None:
+                import itertools
+
+                batches = itertools.chain([probed[0]], probed[1])
+                probed = None
+            else:
+                batches = epoch_batches()
             step = 0
             # Lockstep guard: Parquet shards may hold different batch
             # counts per rank, and gradient averaging is collective — all
@@ -251,3 +285,173 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
     finally:
         if owns_init:
             hvd.shutdown()
+
+
+class TorchEstimator(JaxEstimator):
+    """Spark-ML-shaped estimator for torch models
+    (reference: horovod/spark/torch/estimator.py TorchEstimator).
+
+    Args mirror :class:`JaxEstimator` with torch types: ``model`` is an
+    ``nn.Module``, ``loss`` a callable ``loss(output, target) -> scalar``
+    tensor, ``optimizer`` a torch optimizer INSTANCE constructed against
+    the driver-side model (the reference's contract) — workers rebuild it
+    as ``type(optimizer)(model.parameters(), **optimizer.defaults)``.
+    """
+
+    def _worker_optimizer(self):
+        # A torch optimizer instance holds references to the DRIVER model's
+        # parameters; workers rebuild it against their own copy.  Per-group
+        # hyperparameter overrides ship as (options, param_count) pairs —
+        # the worker model's parameter order matches the driver's (same
+        # pickled module), so counts recover the group membership.
+        groups = [({k: v for k, v in g.items() if k != "params"},
+                   len(g["params"]))
+                  for g in self.optimizer.param_groups]
+        return (type(self.optimizer), self.optimizer.defaults, groups)
+
+    def _finish(self, out) -> "TorchModel":
+        state_dict, history = out  # numpy-valued (see _torch_train_worker)
+        meta = self._write_artifacts(state_dict, history)
+        self.model.load_state_dict(_state_to_torch(state_dict))
+        return TorchModel(self.model, metadata=meta)
+
+
+class TorchModel:
+    """Trained torch model wrapper (reference: TorchModel transformer)."""
+
+    def __init__(self, model: Any, metadata: Optional[dict] = None):
+        self.model = model
+        self.metadata = metadata or {}
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            return self.model(
+                torch.as_tensor(np.asarray(x, np.float32))).numpy()
+
+    @classmethod
+    def load(cls, model: Any, store: Store,
+             run_id: str = "run") -> "TorchModel":
+        state_dict = pickle.loads(
+            store.read(store.get_checkpoint_path(run_id)))
+        model.load_state_dict(_state_to_torch(state_dict))
+        return cls(model)
+
+
+def _state_to_torch(state_dict: dict) -> dict:
+    """Numpy-valued state dict (the worker/Store wire format) → tensors."""
+    import torch
+
+    return {k: torch.as_tensor(v) if not isinstance(v, torch.Tensor) else v
+            for k, v in state_dict.items()}
+
+
+def _to_torch(arr, floating: bool = False):
+    """Batch → torch tensor.  Always copies (Parquet batches may be
+    read-only buffers torch cannot wrap).  ``floating=True`` casts to
+    float32 (model inputs); labels keep their dtype so integer-target
+    losses (CrossEntropyLoss) see Long, matching the JAX worker's
+    pass-through."""
+    import torch
+
+    a = np.array(arr, np.float32) if floating else np.array(arr)
+    return torch.from_numpy(a)
+
+
+def _torch_train_worker(model, loss_fn, opt_spec, x, y, batch_size, epochs,
+                        seed, train_path: Optional[str] = None,
+                        feature_cols: Tuple[str, ...] = ("features",),
+                        label_cols: Tuple[str, ...] = ("label",),
+                        fs_spec=None) -> Any:
+    """Torch per-worker loop: same sharding and lockstep guard as the JAX
+    worker, gradient averaging through the torch binding's grad-hook
+    DistributedOptimizer; returns (state_dict, history)."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    owns_init = not hvd.is_initialized()
+    if owns_init:
+        hvd.init(build_mesh=False)
+    try:
+        rank, size = hvd.rank(), hvd.size()
+        epoch_batches = _make_epoch_batches(
+            x, y, batch_size, rank, size, train_path, feature_cols,
+            label_cols, fs_spec)
+        # Emptiness probe; epoch 0 resumes from it (see the JAX worker).
+        probe_rest = iter(epoch_batches())
+        first = next(probe_rest, None)
+        if first is None:
+            raise ValueError(
+                f"rank {rank}: empty training shard — the dataset has "
+                f"fewer row groups than workers; materialize with more "
+                f"partitions or reduce num_proc")
+        probed = (first, probe_rest)
+
+        torch.manual_seed(seed)
+        opt_cls, opt_defaults, opt_groups = opt_spec
+        params = list(model.parameters())
+        if sum(n for _, n in opt_groups) != len(params):
+            raise ValueError(
+                f"optimizer covers {sum(n for _, n in opt_groups)} "
+                f"parameters but the model has {len(params)}; "
+                f"TorchEstimator requires the optimizer to span "
+                f"model.parameters() in order")
+        rebuilt_groups, i = [], 0
+        for opts, n in opt_groups:
+            rebuilt_groups.append({"params": params[i:i + n], **opts})
+            i += n
+        optimizer = hvd.DistributedOptimizer(
+            opt_cls(rebuilt_groups, **opt_defaults),
+            named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+        model.train()
+        history = []
+        for epoch in range(epochs):
+            epoch_loss, nb = 0.0, 0
+            if probed is not None:
+                import itertools
+
+                batches = itertools.chain([probed[0]], probed[1])
+                probed = None
+            else:
+                batches = epoch_batches()
+            step = 0
+            # Same lockstep guard as the JAX worker: uneven Parquet shards
+            # must agree per step whether to continue.
+            while True:
+                batch = next(batches, None)
+                cont = hvd.allreduce(
+                    torch.tensor([1.0 if batch is not None else 0.0]),
+                    op=hvd.Min, name=f"est.cont.{epoch}.{step}")
+                if float(cont[0]) < 1.0:
+                    break
+                bx, by = batch
+                optimizer.zero_grad()
+                loss = loss_fn(model(_to_torch(bx, floating=True)),
+                               _to_torch(by))
+                loss.backward()
+                optimizer.step()
+                epoch_loss += float(loss.detach())
+                nb += 1
+                step += 1
+            history.append(epoch_loss / max(nb, 1))
+        # Numpy-valued state across the process boundary: torch tensors
+        # pickled through mp queues share storages by fd via the sender's
+        # resource_sharer socket, which dies with the worker — the driver's
+        # lazy unpickle then fails with FileNotFoundError (observed flaky).
+        return ({k: v.detach().cpu().numpy().copy()
+                 for k, v in model.state_dict().items()}, history)
+    finally:
+        if owns_init:
+            hvd.shutdown()
+
+
+# Worker bindings: module-level functions (picklable to spark executors),
+# bound here because they are defined after the estimator classes.
+JaxEstimator._WORKER = staticmethod(_train_worker)
+TorchEstimator._WORKER = staticmethod(_torch_train_worker)

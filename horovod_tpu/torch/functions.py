@@ -12,11 +12,8 @@ values ride the two-phase pickled-object broadcast.
 
 from __future__ import annotations
 
-import io
-import pickle
 from typing import Any, Iterable, Optional, Tuple, Union
 
-import numpy as np
 import torch
 
 from ..process_sets import ProcessSet
@@ -127,24 +124,13 @@ def broadcast_object(obj: Any, root_rank: int = 0,
                      name: Optional[str] = None,
                      process_set: Optional[ProcessSet] = None) -> Any:
     """Broadcast an arbitrary picklable object (two-phase: size then
-    payload, the reference's protocol)."""
-    name = name or "broadcast.object"
-    from .. import basics
+    payload, the reference's protocol).
 
-    if basics.rank() == root_rank:
-        buf = io.BytesIO()
-        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-        payload = torch.from_numpy(
-            np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
-        sz = torch.tensor([payload.numel()], dtype=torch.int64)
-    else:
-        payload = None
-        sz = torch.zeros(1, dtype=torch.int64)
+    Delegates to the framework-neutral core implementation so a torch rank
+    and a JAX rank in the same job negotiate matching wire names — the
+    object payload is numpy on the wire either way.
+    """
+    from ..functions import broadcast_object as _core_broadcast_object
 
-    sz = mpi_ops.broadcast(sz, root_rank, name=f"{name}.sz",
-                           process_set=process_set)
-    if payload is None:
-        payload = torch.empty(int(sz[0]), dtype=torch.uint8)
-    payload = mpi_ops.broadcast(payload, root_rank, name=f"{name}.payload",
-                                process_set=process_set)
-    return pickle.loads(payload.numpy().tobytes())
+    return _core_broadcast_object(obj, root_rank=root_rank, name=name,
+                                  process_set=process_set)

@@ -84,21 +84,25 @@ def _write_back(target: torch.Tensor, arr: np.ndarray) -> torch.Tensor:
 
 class _HandleTable:
     """Maps core handles to torch-side completion actions (the reference's
-    handle_manager.cc role): the in-place target to write back into, or
-    None for out-of-place ops."""
+    handle_manager.cc role): the in-place target to write back into (None
+    for out-of-place ops), plus the torch dtype the result must come back
+    as — collectives preserve dtype, and the bf16 fallback path (no
+    ml_dtypes: tensors cross as float32) would otherwise silently change
+    the output dtype."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._entries: dict[int, Optional[torch.Tensor]] = {}
+        self._entries: dict = {}
 
-    def register(self, handle: int, target: Optional[torch.Tensor]) -> int:
+    def register(self, handle: int, target: Optional[torch.Tensor],
+                 want_dtype: Optional[torch.dtype] = None) -> int:
         with self._lock:
-            self._entries[handle] = target
+            self._entries[handle] = (target, want_dtype)
         return handle
 
-    def pop(self, handle: int) -> Optional[torch.Tensor]:
+    def pop(self, handle: int):
         with self._lock:
-            return self._entries.pop(handle, None)
+            return self._entries.pop(handle, (None, None))
 
 
 _handles = _HandleTable()
@@ -135,7 +139,8 @@ def _allreduce_enqueue(tensor: torch.Tensor, name: Optional[str],
         _to_numpy(tensor), OpType.ALLREDUCE, name=name, reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_resolve_psid(process_set))
-    return _handles.register(h, tensor if inplace else None)
+    return _handles.register(h, tensor if inplace else None,
+                             tensor.dtype)
 
 
 def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
@@ -210,7 +215,8 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
                         postscale_factor=postscale_factor,
                         process_set_id=_resolve_psid(process_set),
                         group_key=gkey, group_size=len(tensors))
-        handles.append(_handles.register(h, t if _inplace else None))
+        handles.append(
+            _handles.register(h, t if _inplace else None, t.dtype))
     return handles
 
 
@@ -266,7 +272,7 @@ def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
     h = HorovodContext.instance().enqueue(
         _to_numpy(tensor), OpType.ALLGATHER, name=name,
         process_set_id=_resolve_psid(process_set))
-    return _handles.register(h, None)
+    return _handles.register(h, None, tensor.dtype)
 
 
 def allgather(tensor: torch.Tensor, name: Optional[str] = None,
@@ -288,7 +294,7 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int,
     h = HorovodContext.instance().enqueue(
         _to_numpy(tensor), OpType.BROADCAST, name=name, root_rank=root_rank,
         process_set_id=_resolve_psid(process_set))
-    return _handles.register(h, None)
+    return _handles.register(h, None, tensor.dtype)
 
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
@@ -297,7 +303,7 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
     h = HorovodContext.instance().enqueue(
         _to_numpy(tensor), OpType.BROADCAST, name=name, root_rank=root_rank,
         process_set_id=_resolve_psid(process_set))
-    return _handles.register(h, tensor)
+    return _handles.register(h, tensor, tensor.dtype)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int,
@@ -327,7 +333,7 @@ def alltoall_async(tensor: torch.Tensor, splits=None,
     h = HorovodContext.instance().enqueue(
         _to_numpy(tensor), OpType.ALLTOALL, name=name, splits=splits,
         process_set_id=_resolve_psid(process_set))
-    return _handles.register(h, None)
+    return _handles.register(h, None, tensor.dtype)
 
 
 def alltoall(tensor: torch.Tensor, splits=None, name: Optional[str] = None,
@@ -349,7 +355,7 @@ def reducescatter_async(tensor: torch.Tensor,
         _to_numpy(tensor), OpType.REDUCESCATTER, name=name, reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set_id=_resolve_psid(process_set))
-    return _handles.register(h, None)
+    return _handles.register(h, None, tensor.dtype)
 
 
 def reducescatter(tensor: torch.Tensor, op: ReduceOp = ReduceOp.AVERAGE,
@@ -385,16 +391,35 @@ def synchronize(handle: int):
     torch, and passes the alltoall (tensor, splits) pair through."""
     # Pop before waiting: a raising collective (elastic failure, shutdown)
     # must not leak the table entry and its strong tensor reference.
-    target = _handles.pop(handle)
+    target, want_dtype = _handles.pop(handle)
     result = HorovodContext.instance().synchronize(handle)
+
+    def _restore(t: torch.Tensor) -> torch.Tensor:
+        # Collectives preserve dtype; the no-ml_dtypes bf16 fallback
+        # crosses as float32 and must come back as bf16.
+        return t if want_dtype in (None, t.dtype) else t.to(want_dtype)
+
     if isinstance(result, tuple):  # alltoall: (data, recv_splits)
         data, rsplits = result
-        return (_from_numpy(np.asarray(data)),
+        return (_restore(_from_numpy(np.asarray(data))),
                 torch.from_numpy(np.asarray(rsplits).copy()))
     arr = np.asarray(result)
     if target is not None:
         return _write_back(target, arr)
-    return _from_numpy(arr)
+    return _restore(_from_numpy(arr))
+
+
+def retire(handle: int) -> None:
+    """Wait out the op behind ``handle`` and DISCARD its result: no
+    in-place write-back, no conversion.  For draining a stale handle whose
+    target buffer has since been reused (e.g. autograd re-accumulated into
+    p.grad) — a normal synchronize would clobber the new contents with the
+    old reduction.  Unknown/already-retired handles are a no-op."""
+    _handles.pop(handle)
+    try:
+        HorovodContext.instance().synchronize(handle)
+    except ValueError:
+        pass
 
 
 def poll(handle: int) -> bool:

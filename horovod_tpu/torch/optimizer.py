@@ -62,6 +62,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             id(p): named.get(id(p), f"allreduce.noname.{i}")
             for i, p in enumerate(all_params)}
 
+        self._requires_update = [p for group in self.param_groups
+                                 for p in group["params"]
+                                 if p.requires_grad]
         self._compression = compression
         self._bpps = max(1, int(backward_passes_per_step))
         self._op = op
@@ -97,13 +100,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # A second reduce before step() consumed the first means the
             # user ran more backward passes than backward_passes_per_step;
             # drain the stale handle so the new one wins (reference raises
-            # in assert-mode, absorbs otherwise).  A retired handle (the
-            # collective failed and an elastic reset already swept the
-            # core table) just drops.
-            try:
-                mpi_ops.synchronize(self._handles.pop(pid)[0])
-            except ValueError:
-                pass
+            # in assert-mode, absorbs otherwise).  retire(), not
+            # synchronize(): the stale op's in-place target IS p.grad,
+            # which autograd has since re-accumulated — a write-back would
+            # clobber the fresh gradient with the old reduction.
+            mpi_ops.retire(self._handles.pop(pid)[0])
         op, prescale, postscale = self._op, 1.0 / self._bpps, 1.0
         if self._predivide != 1.0:
             # Reference semantics: split the 1/size of Average into
@@ -125,10 +126,23 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         """Wait for every outstanding gradient allreduce and write the
         reduced (decompressed) gradients back into ``p.grad``.
 
+        Parameters whose hook did NOT fire this round (data-dependent
+        control flow skipped them, or backward_passes_per_step has not
+        been reached) are reduced here with their current — possibly
+        zero — gradient, so every rank enqueues the SAME collective set
+        per step (the reference's missing-parameter handling; without it
+        a rank that skipped a branch deadlocks the ranks that didn't).
+
         Handles are always cleared, even when a collective raises: the
         elastic retry loop catches the error, restores state, and re-runs
         the step — the optimizer must come back usable, not wedged on
         stale handles from the failed round."""
+        for p in self._requires_update:
+            if id(p) not in self._handles:
+                if p.grad is None:
+                    p.grad = torch.zeros_like(p)
+                self._passes[id(p)] = 0
+                self._allreduce_grad_async(p)
         entries = list(self._handles.items())
         try:
             for pid, (h, ctx, compressed, p) in entries:
@@ -159,9 +173,6 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._should_sync = True
 
     def step(self, closure=None):
-        # A missed hook (e.g. a parameter that got no gradient this step)
-        # simply has no handle; the reference likewise reduces only what
-        # backward produced.
         if self._should_sync:
             self.synchronize()
         return super(self.__class__, self).step(closure)

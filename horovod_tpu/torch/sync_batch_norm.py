@@ -86,7 +86,8 @@ class _SyncBatchNormFn(torch.autograd.Function):
                   * inv_std.view(shape)).to(grad_out.dtype)
 
         grad_w = ((go * xhat).sum(dims).to(weight.dtype)
-                  if weight is not None else None)
+                  if weight is not None and ctx.needs_input_grad[1]
+                  else None)
         grad_b = (go.sum(dims) if ctx.needs_input_grad[2] else None)
         return grad_x, grad_w, grad_b, None, None, None, None, None, None
 
@@ -100,15 +101,25 @@ class SyncBatchNorm(_BatchNorm):
     def __init__(self, num_features: int, eps: float = 1e-5,
                  momentum: float = 0.1, affine: bool = True,
                  track_running_stats: bool = True,
-                 process_set: Optional[ProcessSet] = None):
+                 process_set: Optional[ProcessSet] = None,
+                 name: Optional[str] = None):
         super().__init__(num_features, eps, momentum, affine,
                          track_running_stats)
         self._process_set = process_set
-        # Collective names must match across ranks: construction order is
-        # the contract (same model built the same way on every rank), the
-        # same assumption DistributedOptimizer's positional fallback makes.
-        self._name = f"sync_bn.{SyncBatchNorm._instances}"
-        SyncBatchNorm._instances += 1
+        # Collective names must match across ranks.  The default contract
+        # is construction order (same model built the same way on every
+        # rank — the assumption DistributedOptimizer's positional fallback
+        # makes).  The counter is process-lifetime, so ranks with
+        # ASYMMETRIC construction histories (one rank builds an extra
+        # throwaway model, or an elastic rebuild on survivors vs a fresh
+        # process on joiners) MUST pin ``name=`` explicitly — e.g. the
+        # module's state-dict path — or the forward allreduce names
+        # diverge and negotiation stalls.
+        if name is not None:
+            self._name = f"sync_bn.{name}"
+        else:
+            self._name = f"sync_bn.{SyncBatchNorm._instances}"
+            SyncBatchNorm._instances += 1
 
     def _check_input_dim(self, x) -> None:
         if x.dim() < 2:

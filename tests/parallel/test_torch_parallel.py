@@ -132,6 +132,51 @@ def _torch_optimizer_worker():
     return r
 
 
+def _torch_asymmetric_grad_worker():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+
+    # Two-head model; rank 1's data skips head B entirely, so B's hook
+    # never fires there.  step() must still converge: synchronize()
+    # reduces un-hooked params with zero grads, keeping the enqueued
+    # collective set identical across ranks (no deadlock, no step skew).
+    torch.manual_seed(9)
+    shared = torch.nn.Linear(3, 3)
+    head_a = torch.nn.Linear(3, 1)
+    head_b = torch.nn.Linear(3, 1)
+    params = list(shared.parameters()) + list(head_a.parameters()) + \
+        list(head_b.parameters())
+    named = [(f"p{i}", p) for i, p in enumerate(params)]
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(params, lr=0.1), named_parameters=named)
+    for mod in (shared, head_a, head_b):
+        hvd.broadcast_parameters(mod.state_dict(), root_rank=0)
+
+    x = torch.randn(4, 3)
+    for _ in range(3):
+        opt.zero_grad()
+        h = shared(x)
+        out = head_a(h).sum()
+        if r == 0:  # only rank 0 exercises head B
+            out = out + head_b(h).sum()
+        out.backward()
+        opt.step()  # would deadlock without missing-param handling
+
+    # All ranks ended with identical parameters, including head B's
+    # (rank 1 contributed zeros; average moved it by half rank 0's grad).
+    for i, p in enumerate(params):
+        g = hvd.allgather(p.detach().reshape(1, -1), name=f"t.asym.{i}")
+        np.testing.assert_allclose(g[0].numpy(), g[-1].numpy(), rtol=1e-6)
+
+    hvd.shutdown()
+    return r
+
+
 def _torch_syncbn_worker():
     import numpy as np
     import torch
@@ -222,6 +267,10 @@ def test_torch_collectives_np2():
 
 def test_torch_optimizer_np2():
     assert run(_torch_optimizer_worker, np=2) == [0, 1]
+
+
+def test_torch_asymmetric_grads_np2():
+    assert run(_torch_asymmetric_grad_worker, np=2) == [0, 1]
 
 
 def test_torch_syncbn_np2():

@@ -131,6 +131,23 @@ def test_backward_passes_per_step_accumulates(hvd_torch):
                                -np.ones((1, 3)), rtol=1e-6)
 
 
+def test_extra_backward_pass_grad_not_clobbered(hvd_torch):
+    # Two backward passes before step() with bpps=1: the second hook must
+    # retire the stale in-flight allreduce WITHOUT writing its old
+    # reduction back into p.grad (which now holds g1+g2).
+    model = torch.nn.Linear(4, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(0.0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters())
+    model(torch.ones(1, 4)).sum().backward()       # g1 = 1s
+    model(2 * torch.ones(1, 4)).sum().backward()   # g2 = 2s, accum -> 3s
+    opt.step()
+    np.testing.assert_allclose(model.weight.detach().numpy(),
+                               -3.0 * np.ones((1, 4)), rtol=1e-6)
+
+
 def test_zero_grad_with_inflight_handles_raises(hvd_torch):
     model = torch.nn.Linear(2, 1)
     opt = hvd.DistributedOptimizer(

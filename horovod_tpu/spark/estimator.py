@@ -226,17 +226,10 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
             x, y, batch_size, rank, size, train_path, feature_cols,
             label_cols, fs_spec)
 
-        # The emptiness/shape probe's reader is kept: epoch 0 resumes from
-        # it instead of re-reading (and re-decoding) the first Parquet
-        # batch of every shard.
-        probe_rest = iter(epoch_batches())
-        first = next(probe_rest, None)
-        if first is None:
-            raise ValueError(
-                f"rank {rank}: empty training shard — the dataset has fewer "
-                f"row groups than workers; materialize with more partitions "
-                f"or reduce num_proc")
-        probed = (first, probe_rest)
+        cont = _make_cont(lambda flag, name: float(np.asarray(
+            hvd.allreduce(np.array([flag], np.float32), op=hvd.Min,
+                          name=name))[0]))
+        first, epoch_iters = _probe_epochs(epoch_batches, epochs, rank)
         params = model.init(jax.random.PRNGKey(seed),
                             jnp.asarray(first[0][:1]))
         params = hvd.broadcast_parameters(params, root_rank=0)
@@ -249,29 +242,9 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
                 lambda q: loss_fn(model.apply(q, bx), by))(p)
 
         history = []
-        for epoch in range(epochs):
+        for epoch, batches in epoch_iters:
             epoch_loss, nb = 0.0, 0
-            if probed is not None:
-                import itertools
-
-                batches = itertools.chain([probed[0]], probed[1])
-                probed = None
-            else:
-                batches = epoch_batches()
-            step = 0
-            # Lockstep guard: Parquet shards may hold different batch
-            # counts per rank, and gradient averaging is collective — all
-            # ranks must agree per step whether to continue (the classic
-            # uneven-shard hang the reference solves with hvd.join()).
-            while True:
-                batch = next(batches, None)
-                cont = hvd.allreduce(
-                    np.array([1.0 if batch is not None else 0.0],
-                             np.float32),
-                    op=hvd.Min, name=f"est.cont.{epoch}.{step}")
-                if float(np.asarray(cont)[0]) < 1.0:
-                    break
-                bx, by = batch
+            for bx, by in _lockstep(batches, epoch, cont):
                 loss, grads = grads_fn(params, jnp.asarray(bx),
                                        jnp.asarray(by))
                 # Eager update: engages the core's fusion/negotiation path.
@@ -279,12 +252,69 @@ def _train_worker(model, loss_fn, optimizer, x, y, batch_size, epochs,
                 params = optax.apply_updates(params, updates)
                 epoch_loss += float(loss)
                 nb += 1
-                step += 1
             history.append(epoch_loss / max(nb, 1))
         return jax.device_get(params), history
     finally:
         if owns_init:
             hvd.shutdown()
+
+
+def _probe_epochs(epoch_batches, epochs: int, rank: int):
+    """Emptiness-probe + per-epoch batch iterators, shared by the JAX and
+    torch workers.
+
+    Returns ``(first_batch, iterator of (epoch, batches))``; epoch 0
+    resumes from the probe's reader instead of re-reading (and
+    re-decoding) the first Parquet batch of every shard.  Raises on an
+    empty shard."""
+    import itertools
+
+    probe_rest = iter(epoch_batches())
+    first = next(probe_rest, None)
+    if first is None:
+        raise ValueError(
+            f"rank {rank}: empty training shard — the dataset has fewer "
+            f"row groups than workers; materialize with more partitions "
+            f"or reduce num_proc")
+
+    def epoch_iters():
+        for epoch in range(epochs):
+            if epoch == 0:
+                yield epoch, itertools.chain([first], probe_rest)
+            else:
+                yield epoch, epoch_batches()
+
+    return first, epoch_iters()
+
+
+def _make_cont(allreduce_min):
+    """Per-step continue agreement shared by both workers.
+
+    Lockstep guard: Parquet shards may hold different batch counts per
+    rank, and gradient averaging is collective — all ranks must agree per
+    step whether to continue (the classic uneven-shard hang the reference
+    solves with hvd.join()).  ``allreduce_min(flag, name) -> float`` is
+    the binding-specific Min allreduce; the WIRE NAME lives only here, so
+    a mixed torch/JAX job always negotiates matching names."""
+
+    def cont(have_batch, epoch, step):
+        return allreduce_min(1.0 if have_batch else 0.0,
+                             f"est.cont.{epoch}.{step}") >= 1.0
+
+    return cont
+
+
+def _lockstep(batches, epoch: int, cont) -> "Any":
+    """Yield batches while EVERY rank still has one; ``cont(have, epoch,
+    step)`` runs the per-step continue agreement (a Min allreduce in the
+    caller's binding)."""
+    step = 0
+    while True:
+        batch = next(batches, None)
+        if not cont(batch is not None, epoch, step):
+            break
+        yield batch
+        step += 1
 
 
 class TorchEstimator(JaxEstimator):
@@ -295,18 +325,31 @@ class TorchEstimator(JaxEstimator):
     ``nn.Module``, ``loss`` a callable ``loss(output, target) -> scalar``
     tensor, ``optimizer`` a torch optimizer INSTANCE constructed against
     the driver-side model (the reference's contract) — workers rebuild it
-    as ``type(optimizer)(model.parameters(), **optimizer.defaults)``.
+    from its class, defaults, and per-group (options, member shapes),
+    slicing ``model.parameters()`` in order with shape verification.
     """
 
     def _worker_optimizer(self):
         # A torch optimizer instance holds references to the DRIVER model's
-        # parameters; workers rebuild it against their own copy.  Per-group
-        # hyperparameter overrides ship as (options, param_count) pairs —
-        # the worker model's parameter order matches the driver's (same
-        # pickled module), so counts recover the group membership.
-        groups = [({k: v for k, v in g.items() if k != "params"},
-                   len(g["params"]))
-                  for g in self.optimizer.param_groups]
+        # parameters; workers rebuild it against their own copy.  Group
+        # membership ships as PARAMETER NAMES (state-dict keys) — the
+        # worker rebinds by name lookup, so group order and same-shaped
+        # layers can never mis-bind hyperparameters, and a group member
+        # that is not a model parameter fails loudly on the driver.
+        by_id = {id(p): n for n, p in self.model.named_parameters()}
+        groups = []
+        for gi, g in enumerate(self.optimizer.param_groups):
+            names = []
+            for p in g["params"]:
+                if id(p) not in by_id:
+                    raise ValueError(
+                        f"optimizer param group {gi} contains a tensor "
+                        f"that is not a parameter of the estimator's "
+                        f"model; TorchEstimator optimizers must be built "
+                        f"from model.parameters()")
+                names.append(by_id[id(p)])
+            groups.append(
+                ({k: v for k, v in g.items() if k != "params"}, names))
         return (type(self.optimizer), self.optimizer.defaults, groups)
 
     def _finish(self, out) -> "TorchModel":
@@ -328,8 +371,7 @@ class TorchModel:
 
         self.model.eval()
         with torch.no_grad():
-            return self.model(
-                torch.as_tensor(np.asarray(x, np.float32))).numpy()
+            return self.model(_to_torch(x, features=True)).numpy()
 
     @classmethod
     def load(cls, model: Any, store: Store,
@@ -348,15 +390,42 @@ def _state_to_torch(state_dict: dict) -> dict:
             for k, v in state_dict.items()}
 
 
-def _to_torch(arr, floating: bool = False):
+def _rebuild_optimizer(opt_spec, model):
+    """Worker-side optimizer rebuild from (class, defaults, groups) where
+    each group is (options, member parameter names); see
+    _worker_optimizer.  Name-keyed rebinding: immune to group order and
+    same-shaped layers."""
+    opt_cls, opt_defaults, opt_groups = opt_spec
+    named = dict(model.named_parameters())
+    covered = [n for _, names in opt_groups for n in names]
+    missing = [n for n in covered if n not in named]
+    if missing:
+        raise ValueError(
+            f"optimizer param groups reference parameters absent from the "
+            f"worker model: {missing}")
+    if len(covered) != len(named):
+        raise ValueError(
+            f"optimizer covers {len(covered)} parameters but the model "
+            f"has {len(named)}; TorchEstimator requires the optimizer to "
+            f"span model.parameters()")
+    rebuilt = [{"params": [named[n] for n in names], **opts}
+               for opts, names in opt_groups]
+    return opt_cls(rebuilt, **opt_defaults)
+
+
+def _to_torch(arr, features: bool = False):
     """Batch → torch tensor.  Always copies (Parquet batches may be
-    read-only buffers torch cannot wrap).  ``floating=True`` casts to
-    float32 (model inputs); labels keep their dtype so integer-target
-    losses (CrossEntropyLoss) see Long, matching the JAX worker's
-    pass-through."""
+    read-only buffers torch cannot wrap).  ``features=True`` narrows
+    float64 to float32 (torch models default to f32) but PRESERVES
+    integer dtypes — embedding inputs must stay Long; labels always keep
+    their dtype so integer-target losses (CrossEntropyLoss) see Long,
+    matching the JAX worker's pass-through."""
     import torch
 
-    a = np.array(arr, np.float32) if floating else np.array(arr)
+    a = np.array(arr)
+    if features and np.issubdtype(a.dtype, np.floating) \
+            and a.dtype != np.float32:
+        a = a.astype(np.float32)
     return torch.from_numpy(a)
 
 
@@ -380,65 +449,30 @@ def _torch_train_worker(model, loss_fn, opt_spec, x, y, batch_size, epochs,
         epoch_batches = _make_epoch_batches(
             x, y, batch_size, rank, size, train_path, feature_cols,
             label_cols, fs_spec)
-        # Emptiness probe; epoch 0 resumes from it (see the JAX worker).
-        probe_rest = iter(epoch_batches())
-        first = next(probe_rest, None)
-        if first is None:
-            raise ValueError(
-                f"rank {rank}: empty training shard — the dataset has "
-                f"fewer row groups than workers; materialize with more "
-                f"partitions or reduce num_proc")
-        probed = (first, probe_rest)
+
+        cont = _make_cont(lambda flag, name: float(hvd.allreduce(
+            torch.tensor([flag]), op=hvd.Min, name=name)[0]))
+        _, epoch_iters = _probe_epochs(epoch_batches, epochs, rank)
 
         torch.manual_seed(seed)
-        opt_cls, opt_defaults, opt_groups = opt_spec
-        params = list(model.parameters())
-        if sum(n for _, n in opt_groups) != len(params):
-            raise ValueError(
-                f"optimizer covers {sum(n for _, n in opt_groups)} "
-                f"parameters but the model has {len(params)}; "
-                f"TorchEstimator requires the optimizer to span "
-                f"model.parameters() in order")
-        rebuilt_groups, i = [], 0
-        for opts, n in opt_groups:
-            rebuilt_groups.append({"params": params[i:i + n], **opts})
-            i += n
         optimizer = hvd.DistributedOptimizer(
-            opt_cls(rebuilt_groups, **opt_defaults),
+            _rebuild_optimizer(opt_spec, model),
             named_parameters=model.named_parameters())
         hvd.broadcast_parameters(model.state_dict(), root_rank=0)
         hvd.broadcast_optimizer_state(optimizer, root_rank=0)
 
         model.train()
         history = []
-        for epoch in range(epochs):
+        for epoch, batches in epoch_iters:
             epoch_loss, nb = 0.0, 0
-            if probed is not None:
-                import itertools
-
-                batches = itertools.chain([probed[0]], probed[1])
-                probed = None
-            else:
-                batches = epoch_batches()
-            step = 0
-            # Same lockstep guard as the JAX worker: uneven Parquet shards
-            # must agree per step whether to continue.
-            while True:
-                batch = next(batches, None)
-                cont = hvd.allreduce(
-                    torch.tensor([1.0 if batch is not None else 0.0]),
-                    op=hvd.Min, name=f"est.cont.{epoch}.{step}")
-                if float(cont[0]) < 1.0:
-                    break
-                bx, by = batch
+            for bx, by in _lockstep(batches, epoch, cont):
                 optimizer.zero_grad()
-                loss = loss_fn(model(_to_torch(bx, floating=True)),
+                loss = loss_fn(model(_to_torch(bx, features=True)),
                                _to_torch(by))
                 loss.backward()
                 optimizer.step()
                 epoch_loss += float(loss.detach())
                 nb += 1
-                step += 1
             history.append(epoch_loss / max(nb, 1))
         # Numpy-valued state across the process boundary: torch tensors
         # pickled through mp queues share storages by fd via the sender's

@@ -450,3 +450,87 @@ def test_elastic_min_np_not_met_fails_cleanly():
         assert "could not reach min_np=2" in proc.stderr, proc.stderr
         # Clean failure, not a partial success: no worker reached the end.
         assert "epoch=30" not in proc.stdout
+
+
+TORCH_WORKER_SCRIPT = textwrap.dedent("""
+    import os, sys
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch.elastic import TorchState
+
+    hvd.init(build_mesh=False)
+
+    torch.manual_seed(40 + hvd.rank())  # diverged init; sync() aligns
+    model = torch.nn.Linear(4, 2)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    state = TorchState(model=model, optimizer=opt, epoch=0)
+
+    KILL_EPOCH = int(os.environ.get("TEST_KILL_EPOCH", "-1"))
+    KILL_FLAG = os.environ.get("TEST_KILL_FLAG", "")
+    EPOCHS = int(os.environ.get("TEST_EPOCHS", "6"))
+
+    torch.manual_seed(7)  # same data everywhere
+    x = torch.randn(16, 4)
+    y = torch.randn(16, 2)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < EPOCHS:
+            if (state.epoch == KILL_EPOCH and hvd.rank() == hvd.size() - 1
+                    and hvd.size() > 1 and KILL_FLAG
+                    and not os.path.exists(KILL_FLAG)):
+                open(KILL_FLAG, "w").write("died")
+                os.kill(os.getpid(), 9)
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            state.epoch += 1
+            state.commit()
+        return float(loss.detach())
+
+    loss = train(state)
+    w = model.weight.detach().reshape(1, -1)
+    g = hvd.allgather(w, name="final.w")
+    in_sync = bool(np.allclose(g[0].numpy(), g[-1].numpy()))
+    print(f"RESULT rank={hvd.rank()} size={hvd.size()} "
+          f"epoch={state.epoch} loss={loss:.4f} in_sync={in_sync}")
+    hvd.shutdown()
+""")
+
+
+def test_elastic_torch_worker_failure_recovers():
+    """Torch-binding elastic loop: TorchState commit/restore/sync through a
+    mid-training SIGKILL; training resumes, completes, and ends with
+    identical parameters on every rank."""
+    with tempfile.NamedTemporaryFile(suffix=".flag", delete=True) as tf:
+        flag = tf.name
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "torch_worker.py")
+        with open(script, "w") as f:
+            f.write(TORCH_WORKER_SCRIPT)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["TEST_KILL_EPOCH"] = "2"
+        env["TEST_KILL_FLAG"] = flag
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+               "--min-np", "1", "-np", "2", "-H", "localhost:2",
+               "--verbose", sys.executable, script]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=240, env=env, cwd=td)
+    try:
+        os.unlink(flag)
+    except OSError:
+        pass
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "epoch=6" in proc.stdout
+    assert "in_sync=True" in proc.stdout

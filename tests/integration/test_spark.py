@@ -324,6 +324,83 @@ def test_torch_estimator_int_labels_and_param_groups(tmp_path):
         trained.metadata["loss_history"][0]
 
 
+def test_torch_estimator_out_of_order_groups_bind_by_name(tmp_path):
+    """Param groups listed out of model.parameters() order still bind
+    hyperparameters to the right layers: the worker rebuild is keyed by
+    parameter NAME, not position (same-shaped layers included)."""
+    import torch
+
+    from horovod_tpu.spark import FilesystemStore
+    from horovod_tpu.spark.estimator import TorchEstimator
+
+    # Two SAME-shaPED layers — positional/shape-based rebinding could not
+    # tell them apart.
+    model = torch.nn.Sequential(torch.nn.Linear(4, 4), torch.nn.Linear(4, 4))
+    # Head listed first — reversed relative to model.parameters().
+    opt = torch.optim.SGD([{"params": model[1].parameters(), "lr": 0.1},
+                           {"params": model[0].parameters(), "lr": 0.0}],
+                          lr=0.05)
+    w0 = model[0].weight.detach().clone()
+    h0 = model[1].weight.detach().clone()
+    est = TorchEstimator(
+        model=model, loss=torch.nn.functional.mse_loss,
+        optimizer=opt, batch_size=4, epochs=2,
+        store=FilesystemStore(str(tmp_path)), backend="local",
+        run_id="tgroups2")
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    est.fit(x, y)
+    assert torch.equal(model[0].weight.detach(), w0)  # lr=0 layer frozen
+    assert not torch.equal(model[1].weight.detach(), h0)  # lr=0.1 moved
+
+    # A foreign tensor in a group fails loudly on the driver.
+    model2 = torch.nn.Linear(4, 4)
+    stray = torch.nn.Parameter(torch.zeros(3))
+    opt2 = torch.optim.SGD(
+        [{"params": list(model2.parameters()) + [stray]}], lr=0.1)
+    est2 = TorchEstimator(
+        model=model2, loss=torch.nn.functional.mse_loss, optimizer=opt2,
+        batch_size=4, epochs=1, store=FilesystemStore(str(tmp_path)),
+        backend="local", run_id="tbad")
+    with pytest.raises(ValueError, match="not a parameter"):
+        est2.fit(x, y)
+
+
+def test_torch_estimator_integer_features_embedding(tmp_path):
+    """Integer features (token ids into nn.Embedding) must keep their
+    dtype through the worker and predict paths."""
+    import torch
+
+    from horovod_tpu.spark import FilesystemStore
+    from horovod_tpu.spark.estimator import TorchEstimator
+
+    class TinyEmb(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = torch.nn.Embedding(10, 8)
+            self.head = torch.nn.Linear(8, 2)
+
+        def forward(self, ids):
+            return self.head(self.emb(ids).mean(dim=1))
+
+    torch.manual_seed(0)
+    model = TinyEmb()
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 10, size=(32, 5)).astype(np.int64)
+    y = (x.sum(axis=1) % 2).astype(np.int64)
+
+    est = TorchEstimator(
+        model=model, loss=torch.nn.functional.cross_entropy,
+        optimizer=torch.optim.Adam(model.parameters(), lr=0.05),
+        batch_size=8, epochs=5, store=FilesystemStore(str(tmp_path)),
+        backend="local", run_id="temb")
+    trained = est.fit(x, y)
+    out = trained.predict(x[:4])
+    assert out.shape == (4, 2)
+    hist = trained.metadata["loss_history"]
+    assert hist[-1] < hist[0]
+
+
 def test_torch_estimator_local_backend(tmp_path):
     """Local (in-process) backend: the degenerate single-worker path the
     reference test suite uses with local-mode Spark."""

@@ -67,6 +67,26 @@ def _torch_ops_worker():
     got = hvd.broadcast_object({"rank": r, "tag": "root"}, root_rank=0)
     assert got == {"rank": 0, "tag": "root"}
 
+    # Adasum reduction through the torch surface (host pairwise tree).
+    a = hvd.allreduce(torch.full((4,), float(r + 1)), op=hvd.Adasum,
+                      name="t.adasum")
+    assert torch.isfinite(a).all()
+
+    # Process-set-restricted collective: ranks {0} and {1} reduce alone.
+    # Registration is collective — every rank registers the same sets in
+    # the same order (the reference's contract).
+    ps0 = hvd.add_process_set([0])
+    ps1 = hvd.add_process_set([1])
+    mine = ps0 if r == 0 else ps1
+    solo = hvd.allreduce(torch.full((2,), float(r + 1)), op=hvd.Sum,
+                         name=f"t.ps.{r}", process_set=mine)
+    np.testing.assert_allclose(solo.numpy(), float(r + 1))
+    # Global collective after the subset ops: keeps ranks from racing
+    # into shutdown while a peer's subset negotiation is in flight (the
+    # test_multiprocess.py process-set pattern).
+    out = hvd.allreduce(torch.ones(2), op=hvd.Sum, name="t.ps.global")
+    np.testing.assert_allclose(out.numpy(), 2.0)
+
     hvd.shutdown()
     return r
 

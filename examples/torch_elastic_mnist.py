@@ -1,0 +1,74 @@
+"""Elastic torch training: survives worker loss / host change.
+
+Reference analog: horovod examples/elastic/pytorch_mnist_elastic.py —
+the same TorchState + ElasticSampler + @hvd.elastic.run idiom over the
+torch binding.
+
+Run under the elastic launcher:
+  horovodrun -np 2 --min-np 1 -H localhost:2 python examples/torch_elastic_mnist.py
+"""
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+
+
+def main():
+    hvd.init(build_mesh=False)
+
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Flatten(), torch.nn.Linear(784, 64), torch.nn.ReLU(),
+        torch.nn.Linear(64, 10))
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.Adam(model.parameters(), lr=1e-3),
+        named_parameters=model.named_parameters())
+
+    state = TorchState(model=model, optimizer=optimizer, epoch=0, batch=0)
+    sampler = ElasticSampler(dataset_size=2048, shuffle=True)
+    state.register_reset_callbacks([sampler.reset])
+
+    rng = np.random.RandomState(0)
+    data_x = torch.from_numpy(rng.rand(2048, 28, 28).astype(np.float32))
+    data_y = torch.from_numpy(rng.randint(0, 10, 2048).astype(np.int64))
+
+    batch_size = 32
+
+    @hvd.elastic.run
+    def train(state):
+        loss = torch.tensor(0.0)  # a restore may resume past the epoch's
+        # last batch (zero inner iterations); the epoch-end allreduce
+        # must still see a bound, rank-consistent value.
+        while state.epoch < 3:
+            sampler.set_epoch(state.epoch)
+            idx = np.fromiter(iter(sampler), dtype=np.int64)
+            for b in range(state.batch, len(idx) // batch_size):
+                rows = idx[b * batch_size:(b + 1) * batch_size]
+                optimizer.zero_grad()
+                loss = F.cross_entropy(model(data_x[rows]), data_y[rows])
+                loss.backward()
+                optimizer.step()
+                state.batch = b + 1
+                if state.batch % 16 == 0:
+                    # Commit at batch boundaries you are willing to roll
+                    # back to (the reference's cadence guidance).
+                    state.commit()
+            avg = hvd.allreduce(loss.detach(), op=hvd.Average,
+                                name=f"loss.{state.epoch}")
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {float(avg):.4f} "
+                      f"(world size {hvd.size()})")
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+        return float(loss.detach())
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

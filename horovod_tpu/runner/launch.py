@@ -171,6 +171,17 @@ def check_build(out=sys.stdout) -> None:
     print("Horovod-TPU v%s:" % hvd.__version__, file=out)
     print("Available Frameworks:", file=out)
     print("    [X] JAX", file=out)
+    try:
+        # Probe the BINDING, not just torch: a broken torch install (or a
+        # version the binding cannot work with) must show as unavailable
+        # in the diagnostic users run to debug exactly that.
+        import horovod_tpu.torch  # noqa: F401
+
+        torch_ok = True
+    except ImportError:
+        torch_ok = False
+    print("    [%s] PyTorch (horovod_tpu.torch)" % ("X" if torch_ok else " "),
+          file=out)
     print("Available Controllers:", file=out)
     print("    [X] TPU socket controller (gloo-equivalent)", file=out)
     print("    [%s] native C++ core" % ("X" if hvd.native_core_built() else " "),

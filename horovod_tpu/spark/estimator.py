@@ -325,9 +325,21 @@ class TorchEstimator(JaxEstimator):
     ``nn.Module``, ``loss`` a callable ``loss(output, target) -> scalar``
     tensor, ``optimizer`` a torch optimizer INSTANCE constructed against
     the driver-side model (the reference's contract) — workers rebuild it
-    from its class, defaults, and per-group (options, member shapes),
-    slicing ``model.parameters()`` in order with shape verification.
+    from its class, defaults, and per-group (options, member parameter
+    NAMES), rebinding by name lookup so group order and same-shaped
+    layers can never mis-bind hyperparameters.
+
+    ``feature_dtype`` (keyword, default ``"float32"``): dtype features
+    are cast to before the model — the reference estimators' petastorm
+    behavior, and what float models need when Parquet stores integer
+    columns.  Pass ``feature_dtype=None`` to preserve the stored dtype
+    (required for embedding token ids).  Labels always keep their dtype.
     """
+
+    def __init__(self, *args, feature_dtype: Optional[str] = "float32",
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.feature_dtype = feature_dtype
 
     def _worker_optimizer(self):
         # A torch optimizer instance holds references to the DRIVER model's
@@ -350,11 +362,18 @@ class TorchEstimator(JaxEstimator):
                 names.append(by_id[id(p)])
             groups.append(
                 ({k: v for k, v in g.items() if k != "params"}, names))
-        return (type(self.optimizer), self.optimizer.defaults, groups)
+        # The "optimizer" slot of the shared worker-args tuple carries the
+        # full torch worker spec (estimator knobs the JAX worker has no
+        # analog for ride along here).
+        return {"cls": type(self.optimizer),
+                "defaults": self.optimizer.defaults,
+                "groups": groups,
+                "feature_dtype": self.feature_dtype}
 
     def _finish(self, out) -> "TorchModel":
         state_dict, history = out  # numpy-valued (see _torch_train_worker)
         meta = self._write_artifacts(state_dict, history)
+        meta["feature_dtype"] = self.feature_dtype
         self.model.load_state_dict(_state_to_torch(state_dict))
         return TorchModel(self.model, metadata=meta)
 
@@ -371,15 +390,17 @@ class TorchModel:
 
         self.model.eval()
         with torch.no_grad():
-            return self.model(_to_torch(x, features=True)).numpy()
+            return self.model(_to_torch(
+                x, feature_dtype=self.metadata.get("feature_dtype",
+                                                   "float32"))).numpy()
 
     @classmethod
-    def load(cls, model: Any, store: Store,
-             run_id: str = "run") -> "TorchModel":
+    def load(cls, model: Any, store: Store, run_id: str = "run",
+             feature_dtype: Optional[str] = "float32") -> "TorchModel":
         state_dict = pickle.loads(
             store.read(store.get_checkpoint_path(run_id)))
         model.load_state_dict(_state_to_torch(state_dict))
-        return cls(model)
+        return cls(model, metadata={"feature_dtype": feature_dtype})
 
 
 def _state_to_torch(state_dict: dict) -> dict:
@@ -390,12 +411,13 @@ def _state_to_torch(state_dict: dict) -> dict:
             for k, v in state_dict.items()}
 
 
-def _rebuild_optimizer(opt_spec, model):
-    """Worker-side optimizer rebuild from (class, defaults, groups) where
-    each group is (options, member parameter names); see
+def _rebuild_optimizer(opt_spec: dict, model):
+    """Worker-side optimizer rebuild from the shipped spec dict (class,
+    defaults, groups of (options, member parameter names)); see
     _worker_optimizer.  Name-keyed rebinding: immune to group order and
     same-shaped layers."""
-    opt_cls, opt_defaults, opt_groups = opt_spec
+    opt_cls, opt_defaults, opt_groups = (
+        opt_spec["cls"], opt_spec["defaults"], opt_spec["groups"])
     named = dict(model.named_parameters())
     covered = [n for _, names in opt_groups for n in names]
     missing = [n for n in covered if n not in named]
@@ -413,19 +435,19 @@ def _rebuild_optimizer(opt_spec, model):
     return opt_cls(rebuilt, **opt_defaults)
 
 
-def _to_torch(arr, features: bool = False):
+def _to_torch(arr, feature_dtype: Optional[str] = None):
     """Batch → torch tensor.  Always copies (Parquet batches may be
-    read-only buffers torch cannot wrap).  ``features=True`` narrows
-    float64 to float32 (torch models default to f32) but PRESERVES
-    integer dtypes — embedding inputs must stay Long; labels always keep
-    their dtype so integer-target losses (CrossEntropyLoss) see Long,
-    matching the JAX worker's pass-through."""
+    read-only buffers torch cannot wrap).  ``feature_dtype`` casts
+    features to that dtype (default estimator behavior: "float32", what
+    float models need when Parquet stores integer columns); ``None``
+    preserves the stored dtype — embedding token ids must stay Long.
+    Labels always pass through with ``None`` so integer-target losses
+    (CrossEntropyLoss) see Long, matching the JAX worker."""
     import torch
 
     a = np.array(arr)
-    if features and np.issubdtype(a.dtype, np.floating) \
-            and a.dtype != np.float32:
-        a = a.astype(np.float32)
+    if feature_dtype is not None and a.dtype != np.dtype(feature_dtype):
+        a = a.astype(feature_dtype)
     return torch.from_numpy(a)
 
 
@@ -455,6 +477,7 @@ def _torch_train_worker(model, loss_fn, opt_spec, x, y, batch_size, epochs,
         _, epoch_iters = _probe_epochs(epoch_batches, epochs, rank)
 
         torch.manual_seed(seed)
+        feat_dt = opt_spec.get("feature_dtype", "float32")
         optimizer = hvd.DistributedOptimizer(
             _rebuild_optimizer(opt_spec, model),
             named_parameters=model.named_parameters())
@@ -467,8 +490,9 @@ def _torch_train_worker(model, loss_fn, opt_spec, x, y, batch_size, epochs,
             epoch_loss, nb = 0.0, 0
             for bx, by in _lockstep(batches, epoch, cont):
                 optimizer.zero_grad()
-                loss = loss_fn(model(_to_torch(bx, features=True)),
-                               _to_torch(by))
+                loss = loss_fn(
+                    model(_to_torch(bx, feature_dtype=feat_dt)),
+                    _to_torch(by))
                 loss.backward()
                 optimizer.step()
                 epoch_loss += float(loss.detach())

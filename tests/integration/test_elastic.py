@@ -527,10 +527,15 @@ def test_elastic_torch_worker_failure_recovers():
                "--verbose", sys.executable, script]
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=240, env=env, cwd=td)
+    killed = os.path.exists(flag)
     try:
         os.unlink(flag)
     except OSError:
         pass
+    assert killed, "kill hook never fired"
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "epoch=6" in proc.stdout
-    assert "in_sync=True" in proc.stdout
+    # Re-formation back to 2 ranks (a 1-rank finish would make in_sync
+    # trivially true) and parameter lockstep on both.
+    assert "size=2" in proc.stdout
+    assert proc.stdout.count("in_sync=True") == 2, proc.stdout

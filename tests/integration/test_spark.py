@@ -393,12 +393,33 @@ def test_torch_estimator_integer_features_embedding(tmp_path):
         model=model, loss=torch.nn.functional.cross_entropy,
         optimizer=torch.optim.Adam(model.parameters(), lr=0.05),
         batch_size=8, epochs=5, store=FilesystemStore(str(tmp_path)),
-        backend="local", run_id="temb")
+        backend="local", run_id="temb", feature_dtype=None)
     trained = est.fit(x, y)
     out = trained.predict(x[:4])
     assert out.shape == (4, 2)
     hist = trained.metadata["loss_history"]
     assert hist[-1] < hist[0]
+
+
+def test_torch_estimator_int_features_default_cast(tmp_path):
+    """Default feature_dtype="float32": integer feature columns feed float
+    models without a dtype-mismatch error (the reference estimators'
+    petastorm cast behavior)."""
+    import torch
+
+    from horovod_tpu.spark import FilesystemStore
+    from horovod_tpu.spark.estimator import TorchEstimator
+
+    model = torch.nn.Linear(3, 1)
+    x = np.random.RandomState(0).randint(0, 5, size=(24, 3)).astype(np.int64)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    est = TorchEstimator(
+        model=model, loss=torch.nn.functional.mse_loss,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.01),
+        batch_size=8, epochs=2, store=FilesystemStore(str(tmp_path)),
+        backend="local", run_id="tintfeat")
+    trained = est.fit(x, y)
+    assert trained.predict(x[:4]).shape == (4, 1)
 
 
 def test_torch_estimator_local_backend(tmp_path):

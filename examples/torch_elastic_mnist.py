@@ -27,9 +27,16 @@ def main():
         torch.optim.Adam(model.parameters(), lr=1e-3),
         named_parameters=model.named_parameters())
 
-    state = TorchState(model=model, optimizer=optimizer, epoch=0, batch=0)
+    # Mid-epoch resume rides the SAMPLER's state, the reference idiom: a
+    # committed state_dict of processed indices travels inside TorchState;
+    # on restore/re-formation the sampler reloads it and reshards only the
+    # REMAINING samples over the (possibly new) world — nothing is
+    # repeated, nothing is skipped.
     sampler = ElasticSampler(dataset_size=2048, shuffle=True)
-    state.register_reset_callbacks([sampler.reset])
+    state = TorchState(model=model, optimizer=optimizer, epoch=0,
+                       sampler_state=sampler.state_dict())
+    state.register_reset_callbacks(
+        [lambda: sampler.load_state_dict(state.sampler_state)])
 
     rng = np.random.RandomState(0)
     data_x = torch.from_numpy(rng.rand(2048, 28, 28).astype(np.float32))
@@ -39,22 +46,25 @@ def main():
 
     @hvd.elastic.run
     def train(state):
-        loss = torch.tensor(0.0)  # a restore may resume past the epoch's
-        # last batch (zero inner iterations); the epoch-end allreduce
-        # must still see a bound, rank-consistent value.
+        # Roll the sampler back to the last commit (train() re-runs from
+        # the top after a restore; uncommitted progress must unwind).
+        sampler.load_state_dict(state.sampler_state)
+        loss = torch.tensor(0.0)  # a resume may land at an epoch boundary
+        # (zero remaining batches); the epoch-end allreduce must still see
+        # a bound, rank-consistent value.
         while state.epoch < 3:
-            sampler.set_epoch(state.epoch)
-            idx = np.fromiter(iter(sampler), dtype=np.int64)
-            for b in range(state.batch, len(idx) // batch_size):
-                rows = idx[b * batch_size:(b + 1) * batch_size]
+            for b in range(len(sampler) // batch_size):
+                rows = np.asarray(sampler.local_indices[
+                    b * batch_size:(b + 1) * batch_size])
                 optimizer.zero_grad()
                 loss = F.cross_entropy(model(data_x[rows]), data_y[rows])
                 loss.backward()
                 optimizer.step()
-                state.batch = b + 1
-                if state.batch % 16 == 0:
+                sampler.record_batch(b, batch_size)
+                if (b + 1) % 16 == 0:
                     # Commit at batch boundaries you are willing to roll
                     # back to (the reference's cadence guidance).
+                    state.sampler_state = sampler.state_dict()
                     state.commit()
             avg = hvd.allreduce(loss.detach(), op=hvd.Average,
                                 name=f"loss.{state.epoch}")
@@ -62,7 +72,8 @@ def main():
                 print(f"epoch {state.epoch}: loss {float(avg):.4f} "
                       f"(world size {hvd.size()})")
             state.epoch += 1
-            state.batch = 0
+            sampler.set_epoch(state.epoch)
+            state.sampler_state = sampler.state_dict()
             state.commit()
         return float(loss.detach())
 

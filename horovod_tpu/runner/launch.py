@@ -178,7 +178,10 @@ def check_build(out=sys.stdout) -> None:
         import horovod_tpu.torch  # noqa: F401
 
         torch_ok = True
-    except ImportError:
+    except Exception:
+        # Not just ImportError: a torch wheel broken at the shared-library
+        # level raises OSError mid-import, and this diagnostic must report
+        # "[ ] PyTorch" rather than die with a traceback.
         torch_ok = False
     print("    [%s] PyTorch (horovod_tpu.torch)" % ("X" if torch_ok else " "),
           file=out)

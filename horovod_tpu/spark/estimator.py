@@ -124,8 +124,10 @@ class JaxEstimator:
                              num_proc=self.num_proc)[0]
         return worker(*worker_args)
 
-    def _write_artifacts(self, payload: Any, history) -> dict:
-        """Checkpoint + metadata through the Store; returns the metadata."""
+    def _write_artifacts(self, payload: Any, history, **extra) -> dict:
+        """Checkpoint + metadata through the Store; returns the metadata.
+        ``extra`` keys are persisted in the metadata JSON (so load() can
+        recover subclass knobs like feature_dtype)."""
         self.store.write(self.store.get_checkpoint_path(self.run_id),
                          pickle.dumps(payload))
         import json
@@ -136,6 +138,7 @@ class JaxEstimator:
             "batch_size": self.batch_size,
             "loss_history": [float(v) for v in history],
             "model": type(self.model).__name__,
+            **extra,
         }
         self.store.write(self.store.get_metadata_path(self.run_id),
                          json.dumps(meta).encode())
@@ -372,8 +375,8 @@ class TorchEstimator(JaxEstimator):
 
     def _finish(self, out) -> "TorchModel":
         state_dict, history = out  # numpy-valued (see _torch_train_worker)
-        meta = self._write_artifacts(state_dict, history)
-        meta["feature_dtype"] = self.feature_dtype
+        meta = self._write_artifacts(state_dict, history,
+                                     feature_dtype=self.feature_dtype)
         self.model.load_state_dict(_state_to_torch(state_dict))
         return TorchModel(self.model, metadata=meta)
 
@@ -395,12 +398,21 @@ class TorchModel:
                                                    "float32"))).numpy()
 
     @classmethod
-    def load(cls, model: Any, store: Store, run_id: str = "run",
-             feature_dtype: Optional[str] = "float32") -> "TorchModel":
+    def load(cls, model: Any, store: Store,
+             run_id: str = "run") -> "TorchModel":
         state_dict = pickle.loads(
             store.read(store.get_checkpoint_path(run_id)))
         model.load_state_dict(_state_to_torch(state_dict))
-        return cls(model, metadata={"feature_dtype": feature_dtype})
+        # The run's persisted metadata carries feature_dtype (and the loss
+        # history); an embedding model trained with feature_dtype=None must
+        # predict with integer ids preserved after a reload too.
+        import json
+
+        try:
+            meta = json.loads(store.read(store.get_metadata_path(run_id)))
+        except Exception:
+            meta = {}
+        return cls(model, metadata=meta)
 
 
 def _state_to_torch(state_dict: dict) -> dict:

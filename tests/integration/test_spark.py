@@ -400,6 +400,15 @@ def test_torch_estimator_integer_features_embedding(tmp_path):
     hist = trained.metadata["loss_history"]
     assert hist[-1] < hist[0]
 
+    # Reload from the Store: the persisted metadata carries
+    # feature_dtype=None, so token ids stay Long after a load too.
+    from horovod_tpu.spark.estimator import TorchModel
+
+    torch.manual_seed(0)
+    reloaded = TorchModel.load(TinyEmb(), est.store, "temb")
+    assert reloaded.metadata.get("feature_dtype") is None
+    np.testing.assert_allclose(reloaded.predict(x[:4]), out, rtol=1e-6)
+
 
 def test_torch_estimator_int_features_default_cast(tmp_path):
     """Default feature_dtype="float32": integer feature columns feed float

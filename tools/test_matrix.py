@@ -104,6 +104,57 @@ WORKLOAD = textwrap.dedent("""
 """)
 
 
+TORCH_WORKLOAD = textwrap.dedent("""
+    import os
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+
+    x = torch.full((33,), float(r + 1))
+    total = s * (s + 1) / 2.0
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Sum, name="m.sum").numpy(), total)
+    np.testing.assert_allclose(
+        hvd.allreduce_(x.clone(), op=hvd.Average, name="m.avg").numpy(),
+        total / s)
+
+    # fusion sweep through the grad-hook shape: many small in-place ops
+    ts = [torch.full((8,), float(i + r)) for i in range(40)]
+    handles = [hvd.allreduce_async_(t, op=hvd.Sum, name=f"m.f.{i}")
+               for i, t in enumerate(ts)]
+    for i, h in enumerate(handles):
+        hvd.synchronize(h)
+        np.testing.assert_allclose(ts[i].numpy(),
+                                   s * i + s * (s - 1) / 2.0)
+
+    # cache steady state
+    for it in range(20):
+        out = hvd.allreduce(torch.full((16,), float(r)), op=hvd.Sum,
+                            name="m.cached")
+        np.testing.assert_allclose(out.numpy(), s * (s - 1) / 2.0)
+
+    # ragged allgather + broadcast + equal-splits alltoall
+    g = hvd.allgather(torch.full((r + 1, 2), float(r)), name="m.ag")
+    assert tuple(g.shape) == (s * (s + 1) // 2, 2), g.shape
+    for root in range(s):
+        out = hvd.broadcast(torch.full((5,), float(r), dtype=torch.float64),
+                            root_rank=root, name=f"m.bc.{root}")
+        np.testing.assert_allclose(out.numpy(), float(root))
+    data = (torch.arange(2 * s, dtype=torch.float32) + 10 * r).reshape(-1, 1)
+    out, _ = hvd.alltoall(data, splits=[2] * s, name="m.a2a")
+    assert tuple(out.shape) == (2 * s, 1)
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"WORKLOAD-OK rank={r}", flush=True)
+""")
+
+
 def combos(quick: bool):
     cores = ["native", "purepy"]
     nps = [1, 2, 3]
@@ -112,11 +163,13 @@ def combos(quick: bool):
     planes = ["shm", "tcp", "tcp0"]
     if quick:
         # One covering set instead of the full product.
-        yield ("native", 3, "on", "on", "shm")
-        yield ("native", 2, "off", "off", "tcp")
-        yield ("native", 3, "on", "off", "tcp0")
-        yield ("native", 1, "on", "off", "shm")
-        yield ("purepy", 1, "off", "on", "shm")
+        yield ("jax", "native", 3, "on", "on", "shm")
+        yield ("jax", "native", 2, "off", "off", "tcp")
+        yield ("jax", "native", 3, "on", "off", "tcp0")
+        yield ("jax", "native", 1, "on", "off", "shm")
+        yield ("jax", "purepy", 1, "off", "on", "shm")
+        yield ("torch", "native", 2, "on", "on", "shm")
+        yield ("torch", "native", 3, "off", "off", "tcp")
         return
     for core, np_, f, c, p in itertools.product(cores, nps, fusion, cache,
                                                 planes):
@@ -124,11 +177,19 @@ def combos(quick: bool):
             continue  # pure-python core is single-process by contract
         if np_ == 1 and p != "shm":
             continue  # no data plane at np=1; plane axis is meaningless
-        yield (core, np_, f, c, p)
+        yield ("jax", core, np_, f, c, p)
+    # Torch-binding covering subset (same core spine underneath; a full
+    # product would double the wall time for little marginal coverage).
+    yield ("torch", "native", 2, "on", "on", "shm")
+    yield ("torch", "native", 2, "off", "off", "tcp")
+    yield ("torch", "native", 2, "on", "off", "tcp0")
+    yield ("torch", "native", 3, "on", "on", "tcp")
+    yield ("torch", "native", 3, "off", "on", "shm")
+    yield ("torch", "native", 1, "on", "on", "shm")
 
 
-def run_combo(core: str, np_: int, fusion: str, cache: str, plane: str,
-              script: str, timeout: float) -> tuple:
+def run_combo(core: str, np_: int, fusion: str, cache: str,
+              plane: str, script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -172,14 +233,17 @@ def main() -> int:
 
     failures = []
     with tempfile.TemporaryDirectory() as td:
-        script = os.path.join(td, "workload.py")
-        with open(script, "w") as f:
-            f.write(WORKLOAD)
+        scripts = {}
+        for binding, text in (("jax", WORKLOAD), ("torch", TORCH_WORKLOAD)):
+            scripts[binding] = os.path.join(td, f"workload_{binding}.py")
+            with open(scripts[binding], "w") as f:
+                f.write(text)
         for combo in combos(args.quick):
-            core, np_, fusion, cache, plane = combo
-            label = (f"core={core:<7} np={np_} fusion={fusion:<3} "
-                     f"cache={cache:<3} plane={plane}")
-            ok, dt, detail = run_combo(*combo, script=script,
+            binding, core, np_, fusion, cache, plane = combo
+            label = (f"bind={binding:<5} core={core:<7} np={np_} "
+                     f"fusion={fusion:<3} cache={cache:<3} plane={plane}")
+            ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
+                                       script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
                   flush=True)

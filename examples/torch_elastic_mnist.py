@@ -27,16 +27,14 @@ def main():
         torch.optim.Adam(model.parameters(), lr=1e-3),
         named_parameters=model.named_parameters())
 
-    # Mid-epoch resume rides the SAMPLER's state, the reference idiom: a
-    # committed state_dict of processed indices travels inside TorchState;
-    # on restore/re-formation the sampler reloads it and reshards only the
-    # REMAINING samples over the (possibly new) world — nothing is
-    # repeated, nothing is skipped.
+    # Mid-epoch resume rides the SAMPLER's state, the reference idiom:
+    # TorchState snapshots/restores its state_dict alongside the model and
+    # optimizer, and sync() UNIONS the processed-index sets across ranks
+    # before resharding the remaining samples over the (possibly new)
+    # world — nothing repeats, nothing is skipped.
     sampler = ElasticSampler(dataset_size=2048, shuffle=True)
-    state = TorchState(model=model, optimizer=optimizer, epoch=0,
-                       sampler_state=sampler.state_dict())
-    state.register_reset_callbacks(
-        [lambda: sampler.load_state_dict(state.sampler_state)])
+    state = TorchState(model=model, optimizer=optimizer, sampler=sampler,
+                       epoch=0)
 
     rng = np.random.RandomState(0)
     data_x = torch.from_numpy(rng.rand(2048, 28, 28).astype(np.float32))
@@ -46,9 +44,6 @@ def main():
 
     @hvd.elastic.run
     def train(state):
-        # Roll the sampler back to the last commit (train() re-runs from
-        # the top after a restore; uncommitted progress must unwind).
-        sampler.load_state_dict(state.sampler_state)
         loss = torch.tensor(0.0)  # a resume may land at an epoch boundary
         # (zero remaining batches); the epoch-end allreduce must still see
         # a bound, rank-consistent value.
@@ -64,7 +59,6 @@ def main():
                 if (b + 1) % 16 == 0:
                     # Commit at batch boundaries you are willing to roll
                     # back to (the reference's cadence guidance).
-                    state.sampler_state = sampler.state_dict()
                     state.commit()
             avg = hvd.allreduce(loss.detach(), op=hvd.Average,
                                 name=f"loss.{state.epoch}")
@@ -73,7 +67,6 @@ def main():
                       f"(world size {hvd.size()})")
             state.epoch += 1
             sampler.set_epoch(state.epoch)
-            state.sampler_state = sampler.state_dict()
             state.commit()
         return float(loss.detach())
 

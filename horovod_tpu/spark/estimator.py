@@ -410,7 +410,10 @@ class TorchModel:
 
         try:
             meta = json.loads(store.read(store.get_metadata_path(run_id)))
-        except Exception:
+        except FileNotFoundError:
+            # Missing metadata (pre-feature_dtype runs) degrades to the
+            # defaults; corrupt JSON or real I/O errors must surface — a
+            # silent float32 fallback would change predictions.
             meta = {}
         return cls(model, metadata=meta)
 

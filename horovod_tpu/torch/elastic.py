@@ -20,6 +20,39 @@ from .functions import (broadcast_object, broadcast_optimizer_state,
                         broadcast_parameters)
 
 
+def _sync_sampler(sampler: ElasticSampler, name: str) -> None:
+    """Union the processed-index sets across ranks, then reshard the
+    REMAINING samples over the (possibly new) world.
+
+    This is the part a rank-0 broadcast gets wrong: every rank processed
+    a DIFFERENT shard, so broadcasting one rank's set would put the
+    others' already-trained samples back into the pool (reference:
+    horovod/torch/elastic's sampler state handler performs the same
+    union-allgather).  Rank-0's epoch is not authoritative either — a
+    straggler may be a committed epoch behind — so the max epoch wins.
+    """
+    from . import mpi_ops
+
+    mine = torch.tensor(sorted(sampler.processed_indices),
+                        dtype=torch.int64)
+    # Fixed-shape gather: pad to the global max count with -1 (a ragged
+    # zero-length contribution is the edge case this avoids).
+    n_max = int(mpi_ops.allreduce(
+        torch.tensor([mine.numel()], dtype=torch.int64), op=mpi_ops.Max,
+        name=f"elastic.{name}.n")[0])
+    union: set = set()
+    if n_max > 0:
+        padded = torch.full((n_max,), -1, dtype=torch.int64)
+        padded[:mine.numel()] = mine
+        gathered = mpi_ops.allgather(padded, name=f"elastic.{name}.proc")
+        union = {int(v) for v in gathered.tolist() if v >= 0}
+    epoch = int(mpi_ops.allreduce(
+        torch.tensor([sampler.epoch], dtype=torch.int64), op=mpi_ops.Max,
+        name=f"elastic.{name}.epoch")[0])
+    sampler.load_state_dict({"epoch": epoch,
+                             "processed_indices": sorted(union)})
+
+
 class TorchState(ObjectState):
     """Elastic state over torch modules/optimizers plus scalar attributes.
 
@@ -30,17 +63,22 @@ class TorchState(ObjectState):
     """
 
     def __init__(self, model: torch.nn.Module = None,
-                 optimizer: torch.optim.Optimizer = None, **kwargs):
+                 optimizer: torch.optim.Optimizer = None,
+                 sampler: ElasticSampler = None, **kwargs):
         self._handled: Dict[str, Any] = {}
         if model is not None:
             self._handled["model"] = model
         if optimizer is not None:
             self._handled["optimizer"] = optimizer
-        # Extra modules/optimizers may arrive as kwargs (reference allows
-        # arbitrary names); route them by type.
+        if sampler is not None:
+            self._handled["sampler"] = sampler
+        # Extra modules/optimizers/samplers may arrive as kwargs
+        # (reference allows arbitrary names); route them by type — all
+        # three expose the state_dict/load_state_dict snapshot interface.
         plain = {}
         for k, v in kwargs.items():
-            if isinstance(v, (torch.nn.Module, torch.optim.Optimizer)):
+            if isinstance(v, (torch.nn.Module, torch.optim.Optimizer,
+                              ElasticSampler)):
                 self._handled[k] = v
             else:
                 plain[k] = v
@@ -81,6 +119,8 @@ class TorchState(ObjectState):
         for k, v in self._handled.items():
             if isinstance(v, torch.nn.Module):
                 broadcast_parameters(v.state_dict(), root_rank=0)
+            elif isinstance(v, ElasticSampler):
+                _sync_sampler(v, k)
             else:
                 broadcast_optimizer_state(v, root_rank=0)
         plain = self._public_attrs()

@@ -245,6 +245,43 @@ def _torch_syncbn_worker():
     return r
 
 
+def _torch_sampler_union_worker():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+
+    # Each rank processed a DIFFERENT part of its own shard; sync() must
+    # union the sets (a rank-0 broadcast would resurrect rank 1's
+    # processed samples) and reshard only the remainder.
+    sampler = ElasticSampler(dataset_size=24, shuffle=False, seed=3)
+    model = torch.nn.Linear(2, 1)
+    state = TorchState(model=model, sampler=sampler, epoch=0)
+    sampler.record_batch(0, 4)  # first 4 of this rank's shard
+    mine_processed = set(int(i) for i in sampler.local_indices[:4])
+    state.sync()
+
+    # Union holds both ranks' processed sets...
+    all_processed = hvd.allgather(
+        torch.tensor(sorted(mine_processed), dtype=torch.int64),
+        name="t.union.chk")
+    expected_union = set(all_processed.tolist())
+    assert sampler.processed_indices == expected_union, (
+        sampler.processed_indices, expected_union)
+    # ...and the resharded remainder excludes every processed sample.
+    assert not (set(int(i) for i in sampler.local_indices)
+                & expected_union)
+    # Remainder is evenly resharded: 24 - 8 processed = 16 over 2 ranks.
+    assert len(sampler) == (24 - 4 * s) // s
+
+    hvd.shutdown()
+    return r
+
+
 def _torch_elastic_state_worker():
     import torch
 
@@ -299,3 +336,7 @@ def test_torch_syncbn_np2():
 
 def test_torch_elastic_state_np2():
     assert run(_torch_elastic_state_worker, np=2) == [0, 1]
+
+
+def test_torch_sampler_union_np2():
+    assert run(_torch_sampler_union_worker, np=2) == [0, 1]

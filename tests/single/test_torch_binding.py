@@ -194,6 +194,21 @@ def test_broadcast_optimizer_state(hvd_torch):
     assert state and all("exp_avg" in s for s in state.values())
 
 
+def test_timeline_records_torch_ops(hvd_torch, tmp_path):
+    """The Chrome-trace timeline (SURVEY §5) captures torch-binding
+    collectives by name — same core spine, same observability."""
+    import json
+
+    path = str(tmp_path / "timeline.json")
+    hvd.start_timeline(path, mark_cycles=True)
+    hvd.allreduce_(torch.ones(4), op=hvd.Sum, name="torch.tl.0")
+    hvd.stop_timeline()
+    with open(path) as f:
+        events = json.load(f)
+    assert any(ev.get("args", {}).get("tensor") == "torch.tl.0"
+               for ev in events if ev.get("ph") == "B")
+
+
 def test_sync_batch_norm_single_rank_matches_bn(hvd_torch):
     torch.manual_seed(1)
     x = torch.randn(8, 3, 4, 4)

@@ -408,13 +408,14 @@ class TorchModel:
         # predict with integer ids preserved after a reload too.
         import json
 
-        try:
-            meta = json.loads(store.read(store.get_metadata_path(run_id)))
-        except FileNotFoundError:
-            # Missing metadata (pre-feature_dtype runs) degrades to the
-            # defaults; corrupt JSON or real I/O errors must surface — a
-            # silent float32 fallback would change predictions.
-            meta = {}
+        # exists() is part of the Store contract on every backend (an
+        # HDFS missing-path error need not be FileNotFoundError); a
+        # missing metadata file (pre-feature_dtype runs) degrades to the
+        # defaults, while corrupt JSON or real I/O errors surface — a
+        # silent float32 fallback would change predictions.
+        meta_path = store.get_metadata_path(run_id)
+        meta = (json.loads(store.read(meta_path))
+                if store.exists(meta_path) else {})
         return cls(model, metadata=meta)
 
 

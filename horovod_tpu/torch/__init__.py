@@ -24,8 +24,8 @@ from ..process_sets import (ProcessSet, add_process_set,  # noqa: F401
                             global_process_set, remove_process_set)
 from . import elastic  # noqa: F401
 from .compression import Compression  # noqa: F401
-from .functions import (broadcast_object, broadcast_optimizer_state,  # noqa: F401
-                        broadcast_parameters)
+from .functions import (allgather_object, broadcast_object,  # noqa: F401
+                        broadcast_optimizer_state, broadcast_parameters)
 from .mpi_ops import (Adasum, Average, Max, Min, Product, Sum,  # noqa: F401
                       allgather, allgather_async, allreduce, allreduce_,
                       allreduce_async, allreduce_async_, alltoall,

@@ -24,32 +24,32 @@ def _sync_sampler(sampler: ElasticSampler, name: str) -> None:
     """Union the processed-index sets across ranks, then reshard the
     REMAINING samples over the (possibly new) world.
 
-    This is the part a rank-0 broadcast gets wrong: every rank processed
-    a DIFFERENT shard, so broadcasting one rank's set would put the
-    others' already-trained samples back into the pool (reference:
+    This is the part a plain rank-0 broadcast gets wrong: every rank
+    processed a DIFFERENT shard, so broadcasting one rank's set would put
+    the others' already-trained samples back into the pool (reference:
     horovod/torch/elastic's sampler state handler performs the same
-    union-allgather).  Rank-0's epoch is not authoritative either — a
-    straggler may be a committed epoch behind — so the max epoch wins.
-    """
-    from . import mpi_ops
+    union-allgather).
 
-    mine = torch.tensor(sorted(sampler.processed_indices),
-                        dtype=torch.int64)
-    # Fixed-shape gather: pad to the global max count with -1 (a ragged
-    # zero-length contribution is the edge case this avoids).
-    n_max = int(mpi_ops.allreduce(
-        torch.tensor([mine.numel()], dtype=torch.int64), op=mpi_ops.Max,
-        name=f"elastic.{name}.n")[0])
+    Epoch authority is RANK 0, matching ObjectState.sync's broadcast of
+    plain attrs (state.epoch) — two authorities would let the training
+    loop run a mislabeled epoch.  Contributions from ranks at a DIFFERENT
+    committed epoch are excluded from the union: their indices belong to
+    another epoch's permutation, and unioning them would silently skip
+    those samples for the whole epoch.  A rank ahead of rank 0 simply
+    rolls back and repeats part of the epoch — elastic recovery repeats,
+    never skips.
+    """
+    from .functions import allgather_object
+
+    entries = allgather_object(
+        (sampler.epoch, sorted(sampler.processed_indices)),
+        name=f"elastic.{name}.state")
+    epoch0 = entries[0][0]
     union: set = set()
-    if n_max > 0:
-        padded = torch.full((n_max,), -1, dtype=torch.int64)
-        padded[:mine.numel()] = mine
-        gathered = mpi_ops.allgather(padded, name=f"elastic.{name}.proc")
-        union = {int(v) for v in gathered.tolist() if v >= 0}
-    epoch = int(mpi_ops.allreduce(
-        torch.tensor([sampler.epoch], dtype=torch.int64), op=mpi_ops.Max,
-        name=f"elastic.{name}.epoch")[0])
-    sampler.load_state_dict({"epoch": epoch,
+    for ep, idxs in entries:
+        if ep == epoch0:
+            union.update(idxs)
+    sampler.load_state_dict({"epoch": epoch0,
                              "processed_indices": sorted(union)})
 
 

@@ -120,6 +120,16 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
         mpi_ops.synchronize(h)
 
 
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> list:
+    """Gather one picklable object per rank into a rank-ordered list
+    (reference: hvd.allgather_object).  Delegates to the framework-neutral
+    core so wire names match a JAX rank's in mixed jobs."""
+    from ..functions import allgather_object as _core_allgather_object
+
+    return _core_allgather_object(obj, name=name, process_set=process_set)
+
+
 def broadcast_object(obj: Any, root_rank: int = 0,
                      name: Optional[str] = None,
                      process_set: Optional[ProcessSet] = None) -> Any:

@@ -34,11 +34,14 @@ def _mixed_worker():
                           name="mix.bc")
         np.testing.assert_allclose(np.asarray(b), 7.0)
 
-        from horovod_tpu.functions import broadcast_object
+        from horovod_tpu.functions import allgather_object, broadcast_object
 
         obj = broadcast_object({"from": "jax-rank0"}, root_rank=0,
                                name="mix.obj")
         assert obj == {"from": "jax-rank0"}
+
+        objs = allgather_object({"rank": r}, name="mix.gobj")
+        assert objs == [{"rank": 0}, {"rank": 1}]
 
         # 16-bit wire path across bindings.
         if _has_bf16():
@@ -69,6 +72,9 @@ def _mixed_worker():
         obj = hvd.broadcast_object(None, root_rank=0, name="mix.obj")
         assert obj == {"from": "jax-rank0"}
 
+        objs = hvd.allgather_object({"rank": r}, name="mix.gobj")
+        assert objs == [{"rank": 0}, {"rank": 1}]
+
         dt = torch.bfloat16 if _has_bf16() else torch.float16
         h = hvd.allreduce(torch.full((4,), 2.0, dtype=dt),
                           op=hvd.Average, name="mix.b16")
@@ -87,5 +93,92 @@ def _has_bf16() -> bool:
         return False
 
 
+def _mixed_soak_worker():
+    """Randomized op/shape/dtype sequence, alternating bindings per op and
+    per rank: rank r dispatches op i through torch when (i + r) is even,
+    through the JAX/numpy eager path otherwise — so most steps negotiate
+    BETWEEN bindings.  The sequence is seeded identically on all ranks
+    (the reference's cross-rank naming contract); results are checked
+    against numpy expectations."""
+    import numpy as np
+    import torch
+
+    import horovod_tpu as hj
+    import horovod_tpu.torch as ht
+
+    hj.init(build_mesh=False)
+    r, s = hj.rank(), hj.size()
+    rng = np.random.RandomState(1234)  # identical stream on every rank
+
+    for i in range(40):
+        op = ["ar", "ag", "bc", "rs"][rng.randint(4)]
+        dt = [np.float32, np.float64, np.float16, np.int64][rng.randint(4)]
+        ndim = rng.randint(1, 3)
+        shape = tuple(int(v) for v in rng.randint(1, 9, size=ndim))
+        if op == "rs":
+            shape = (2 * shape[0],) + shape[1:]  # even dim0: clean split
+        base = rng.randint(0, 5, size=shape).astype(dt)
+        use_torch = (i + r) % 2 == 0
+        name = f"soak.{i}"
+
+        if op == "ar":
+            mine = (base + r).astype(dt)
+            want = sum((base + rr).astype(dt) for rr in range(s))
+            if use_torch:
+                got = ht.allreduce(torch.from_numpy(mine.copy()),
+                                   op=ht.Sum, name=name).numpy()
+            else:
+                got = np.asarray(hj.allreduce(mine, op=hj.Sum, name=name))
+            np.testing.assert_allclose(
+                got.astype(np.float64), want.astype(np.float64))
+        elif op == "ag":
+            rows = r + 1  # ragged first dim
+            mine = np.full((rows,) + shape, r, dtype=dt)
+            want_rows = s * (s + 1) // 2
+            if use_torch:
+                got = ht.allgather(torch.from_numpy(mine.copy()),
+                                   name=name).numpy()
+            else:
+                got = np.asarray(hj.allgather(mine, name=name))
+            assert got.shape == (want_rows,) + shape
+            off = 0
+            for rr in range(s):
+                np.testing.assert_allclose(
+                    got[off:off + rr + 1].astype(np.float64), float(rr))
+                off += rr + 1
+        elif op == "bc":
+            root = int(rng.randint(s))
+            mine = (base + r).astype(dt)
+            want = (base + root).astype(dt)
+            if use_torch:
+                got = ht.broadcast(torch.from_numpy(mine.copy()), root,
+                                   name=name).numpy()
+            else:
+                got = np.asarray(hj.broadcast(mine, root, name=name))
+            np.testing.assert_allclose(
+                got.astype(np.float64), want.astype(np.float64))
+        else:  # rs
+            mine = (base + r).astype(dt)
+            total = sum((base + rr).astype(dt) for rr in range(s))
+            per = shape[0] // s
+            want = total[r * per:(r + 1) * per]
+            if use_torch:
+                got = ht.reducescatter(torch.from_numpy(mine.copy()),
+                                       op=ht.Sum, name=name).numpy()
+            else:
+                got = np.asarray(hj.reducescatter(mine, op=hj.Sum,
+                                                  name=name))
+            np.testing.assert_allclose(
+                got.astype(np.float64), want.astype(np.float64))
+
+    hj.barrier()
+    hj.shutdown()
+    return r
+
+
 def test_mixed_torch_jax_job_np2():
     assert run(_mixed_worker, np=2) == [0, 1]
+
+
+def test_mixed_binding_randomized_soak_np2():
+    assert run(_mixed_soak_worker, np=2) == [0, 1]

@@ -278,6 +278,23 @@ def _torch_sampler_union_worker():
     # Remainder is evenly resharded: 24 - 8 processed = 16 over 2 ranks.
     assert len(sampler) == (24 - 4 * s) // s
 
+    # Straggler epochs: rank 1 committed into epoch 1 (its processed set
+    # belongs to another permutation) while rank 0 is late in epoch 0.
+    # Rank 0's epoch is the single authority; rank 1's epoch-1 indices
+    # must NOT poison epoch 0's remaining pool (they'd be skipped), and
+    # both ranks end aligned on epoch 0.
+    s2 = ElasticSampler(dataset_size=24, shuffle=False, seed=5)
+    if r == 1:
+        s2.set_epoch(1)
+    s2.record_batch(0, 4)
+    rank0_epoch0 = hvd.broadcast_object(
+        sorted(s2.processed_indices) if r == 0 else None, root_rank=0,
+        name="t.union.r0")
+    state2 = TorchState(model=torch.nn.Linear(2, 1), sampler=s2, epoch=0)
+    state2.sync()
+    assert s2.epoch == 0
+    assert s2.processed_indices == set(rank0_epoch0)
+
     hvd.shutdown()
     return r
 

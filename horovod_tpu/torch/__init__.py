@@ -15,6 +15,7 @@ interoperate rank-for-rank.
 from __future__ import annotations
 
 # Shared runtime surface (init/shutdown/rank/size/... are framework-neutral).
+from .. import __version__  # noqa: F401
 from ..basics import (cross_rank, cross_size, init, initialized,  # noqa: F401
                       is_homogeneous, is_initialized, local_rank, local_size,
                       mpi_built, mpi_enabled, mpi_threads_supported,

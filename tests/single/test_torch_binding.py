@@ -33,6 +33,20 @@ def test_allreduce_identity_and_inplace(hvd_torch):
     assert torch.equal(y, x)
 
 
+def test_bf16_bridge_bit_exact():
+    # The uint16 bit-reinterpretation bridge must be lossless for every
+    # bit pattern, including negatives, subnormals, inf, and NaN payloads
+    # (no init needed: pure conversion).
+    from horovod_tpu.torch.mpi_ops import _from_numpy, _to_numpy
+
+    bits = torch.randint(0, 2 ** 16, (4096,), dtype=torch.int32) \
+        .to(torch.uint16)
+    t = bits.view(torch.bfloat16)
+    back = _from_numpy(_to_numpy(t))
+    assert back.dtype == torch.bfloat16
+    assert torch.equal(back.view(torch.uint16), bits)
+
+
 def test_dtypes_roundtrip(hvd_torch):
     for dt in (torch.float64, torch.float32, torch.float16, torch.bfloat16,
                torch.int32, torch.int64, torch.uint8):

@@ -62,6 +62,18 @@ def _scale(arr: np.ndarray, factor: float) -> np.ndarray:
     return (arr.astype(np.float64) * factor).astype(arr.dtype)
 
 
+def _rows2d(a: np.ndarray) -> np.ndarray:
+    """View as (rows, row_width) for the row-oriented plane calls.
+
+    Not ``reshape(n, -1)``: numpy cannot infer -1 when n == 0, and a
+    zero-row contribution is legal for ragged allgather (a rank whose
+    sparse gradient touched no rows still participates)."""
+    if a.ndim == 0:
+        return a.reshape(1, 1)
+    row = int(np.prod(a.shape[1:], dtype=np.int64)) if a.ndim > 1 else 1
+    return a.reshape(a.shape[0], row)
+
+
 class _FusionBuffer:
     """Reusable pack/unpack buffer for the host data plane.
 
@@ -598,10 +610,7 @@ class HorovodContext:
         if len(entries) == 1:
             e = entries[0]
             stacked, counts = self.core.allgather_buffer(
-                e.array.reshape(e.array.shape[0] if e.array.ndim else 1, -1)
-                if e.array.ndim else e.array.reshape(1, 1),
-                psid,
-            )
+                _rows2d(e.array), psid)
             rest = e.array.shape[1:] if e.array.ndim else ()
             e.result = np.asarray(stacked).reshape(
                 (int(np.sum(counts)),) + tuple(rest))
@@ -651,7 +660,7 @@ class HorovodContext:
     def _exec_alltoall(self, e: TensorEntry, psid: int) -> None:
         n = self._ps_size(psid)
         splits = validate_alltoall_splits(e.splits, e.array.shape[0], n)
-        buf = e.array.reshape(e.array.shape[0], -1)
+        buf = _rows2d(e.array)
         out, recv_splits = self.core.alltoall_buffer(buf, splits, psid)
         rest = e.array.shape[1:]
         e.result = np.asarray(out).reshape((int(np.sum(recv_splits)),) + tuple(rest))

@@ -124,3 +124,14 @@ def _resolve_psid(process_set: Optional[ProcessSet]) -> int:
     if process_set.process_set_id is None:
         raise ValueError("process set is not registered; call add_process_set()")
     return process_set.process_set_id
+
+
+def effective_size(process_set: Optional[ProcessSet] = None) -> int:
+    """World size of ``process_set`` (ProcessSet.size(), which resolves the
+    global set's lazy membership — never len(ranks)), or the job size when
+    None."""
+    if process_set is not None:
+        return process_set.size()
+    from . import basics
+
+    return basics.size()

@@ -31,9 +31,13 @@ from .mpi_ops import (Adasum, Average, Max, Min, Product, Sum,  # noqa: F401
                       allgather, allgather_async, allreduce, allreduce_,
                       allreduce_async, allreduce_async_, alltoall,
                       alltoall_async, barrier, broadcast, broadcast_,
-                      broadcast_async, broadcast_async_, grouped_allreduce,
+                      broadcast_async, broadcast_async_, grouped_allgather,
+                      grouped_allgather_async, grouped_allreduce,
                       grouped_allreduce_, grouped_allreduce_async,
-                      grouped_allreduce_async_, join, poll, reducescatter,
-                      reducescatter_async, synchronize)
+                      grouped_allreduce_async_, grouped_reducescatter,
+                      grouped_reducescatter_async, join, poll,
+                      reducescatter, reducescatter_async, sparse_allreduce,
+                      sparse_allreduce_async, sparse_synchronize,
+                      synchronize)
 from .optimizer import DistributedOptimizer  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401

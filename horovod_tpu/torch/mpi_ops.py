@@ -196,6 +196,27 @@ def allreduce_(tensor: torch.Tensor, average: Optional[bool] = None,
         process_set=process_set))
 
 
+def _grouped_enqueue(tensors: Sequence[torch.Tensor], op_type: OpType,
+                     name: Optional[str],
+                     process_set: Optional[ProcessSet],
+                     inplace: bool = False, **enqueue_kw) -> List[int]:
+    """Shared grouped enqueue: one atomic negotiation group (coordinator
+    gates all-or-nothing; reference: group_table.cc), per-member names
+    derived from the group name (must MATCH across ranks)."""
+    ctx = HorovodContext.instance()
+    gkey = ctx.group_key_for(name)
+    handles = []
+    for i, t in enumerate(tensors):
+        h = ctx.enqueue(_to_numpy(t), op_type,
+                        name=f"{name}.{i}" if name else None,
+                        process_set_id=_resolve_psid(process_set),
+                        group_key=gkey, group_size=len(tensors),
+                        **enqueue_kw)
+        handles.append(_handles.register(h, t if inplace else None,
+                                         t.dtype))
+    return handles
+
+
 def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
                             average: Optional[bool] = None,
                             name: Optional[str] = None,
@@ -204,20 +225,10 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
                             postscale_factor: float = 1.0,
                             process_set: Optional[ProcessSet] = None,
                             _inplace: bool = False) -> List[int]:
-    rop = _resolve_op(op, average)
-    ctx = HorovodContext.instance()
-    gkey = ctx.group_key_for(name)
-    handles = []
-    for i, t in enumerate(tensors):
-        h = ctx.enqueue(_to_numpy(t), OpType.ALLREDUCE,
-                        name=f"{name}.{i}" if name else None, reduce_op=rop,
-                        prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor,
-                        process_set_id=_resolve_psid(process_set),
-                        group_key=gkey, group_size=len(tensors))
-        handles.append(
-            _handles.register(h, t if _inplace else None, t.dtype))
-    return handles
+    return _grouped_enqueue(
+        tensors, OpType.ALLREDUCE, name, process_set, inplace=_inplace,
+        reduce_op=_resolve_op(op, average), prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
 
 
 def grouped_allreduce_async_(tensors: Sequence[torch.Tensor],
@@ -265,6 +276,23 @@ def grouped_allreduce_(tensors: Sequence[torch.Tensor],
 # ---------------------------------------------------------------------------
 # allgather
 # ---------------------------------------------------------------------------
+
+
+def grouped_allgather_async(tensors: Sequence[torch.Tensor],
+                            name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None
+                            ) -> List[int]:
+    """Allgather a list as one atomic negotiation group (reference:
+    grouped_allgather, group_table.cc)."""
+    return _grouped_enqueue(tensors, OpType.ALLGATHER, name, process_set)
+
+
+def grouped_allgather(tensors: Sequence[torch.Tensor],
+                      name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None
+                      ) -> List[torch.Tensor]:
+    return [synchronize(h) for h in grouped_allgather_async(
+        tensors, name=name, process_set=process_set)]
 
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
@@ -368,6 +396,32 @@ def reducescatter(tensor: torch.Tensor, op: ReduceOp = ReduceOp.AVERAGE,
         postscale_factor=postscale_factor, process_set=process_set))
 
 
+def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
+                                op: ReduceOp = ReduceOp.AVERAGE,
+                                name: Optional[str] = None,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> List[int]:
+    """Reducescatter a list as one atomic negotiation group (reference:
+    grouped_reducescatter, group_table.cc)."""
+    return _grouped_enqueue(
+        tensors, OpType.REDUCESCATTER, name, process_set, reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+
+
+def grouped_reducescatter(tensors: Sequence[torch.Tensor],
+                          op: ReduceOp = ReduceOp.AVERAGE,
+                          name: Optional[str] = None,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          process_set: Optional[ProcessSet] = None
+                          ) -> List[torch.Tensor]:
+    return [synchronize(h) for h in grouped_reducescatter_async(
+        tensors, op=op, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set)]
+
+
 # ---------------------------------------------------------------------------
 # barrier / join / handles
 # ---------------------------------------------------------------------------
@@ -407,6 +461,70 @@ def synchronize(handle: int):
     if target is not None:
         return _write_back(target, arr)
     return _restore(_from_numpy(arr))
+
+
+def sparse_allreduce_async(tensor: torch.Tensor,
+                           name: Optional[str] = None,
+                           op: Optional[ReduceOp] = None,
+                           process_set: Optional[ProcessSet] = None):
+    """Start a sparse COO allreduce; returns an opaque token for
+    :func:`sparse_synchronize`.  Both underlying allgathers (indices,
+    values) enqueue immediately and negotiate concurrently."""
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce requires a sparse COO tensor")
+    rop = _resolve_op(op, None)
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("sparse_allreduce supports Sum and Average only")
+    sp = tensor.coalesce()
+    # Ragged allgather over dim 0: indices cross transposed to
+    # (nnz, sparse_dim), values as (nnz, ...dense dims) — a rank with
+    # zero touched rows contributes zero rows and still participates.
+    # Unnamed calls ride the core's deterministic noname counter
+    # (call-order contract, like every other unnamed collective).
+    h_idx = allgather_async(sp.indices().t().contiguous(),
+                            name=f"{name}.idx" if name else None,
+                            process_set=process_set)
+    h_vals = allgather_async(sp.values().contiguous(),
+                             name=f"{name}.vals" if name else None,
+                             process_set=process_set)
+    return (h_idx, h_vals, tuple(sp.shape), rop, process_set)
+
+
+def sparse_synchronize(token) -> torch.Tensor:
+    """Finish a :func:`sparse_allreduce_async`: re-accumulate the gathered
+    (indices, values) into a coalesced sparse tensor."""
+    h_idx, h_vals, shape, rop, process_set = token
+    try:
+        idx = synchronize(h_idx)
+    except BaseException:
+        # Preserve the pop-before-wait invariant for BOTH halves: a
+        # failing indices gather must not leak the values entry (elastic
+        # retry loops would accumulate stale table entries per step).
+        retire(h_vals)
+        raise
+    vals = synchronize(h_vals)
+    out = torch.sparse_coo_tensor(idx.t(), vals, shape).coalesce()
+    if rop == ReduceOp.AVERAGE:
+        from ..process_sets import effective_size
+
+        # In-place on the coalesced values: dividing by a scalar cannot
+        # create duplicate indices, so no re-coalesce.
+        out.values().div_(effective_size(process_set))
+    return out
+
+
+def sparse_allreduce(tensor: torch.Tensor, name: Optional[str] = None,
+                     op: Optional[ReduceOp] = None,
+                     process_set: Optional[ProcessSet] = None
+                     ) -> torch.Tensor:
+    """Allreduce a sparse COO tensor by gathering every rank's
+    (indices, values) and re-accumulating — the reference's
+    sparse_allreduce_async strategy (gradients of embedding layers with
+    sparse=True), which beats densifying when the union of touched rows
+    is small.  Returns a coalesced sparse tensor; Average (default)
+    divides by the process-set size like the dense op."""
+    return sparse_synchronize(sparse_allreduce_async(
+        tensor, name=name, op=op, process_set=process_set))
 
 
 def retire(handle: int) -> None:

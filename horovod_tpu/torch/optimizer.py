@@ -38,7 +38,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  backward_passes_per_step: int = 1,
                  op=mpi_ops.Average,
                  gradient_predivide_factor: float = 1.0,
-                 process_set: Optional[ProcessSet] = None):
+                 process_set: Optional[ProcessSet] = None,
+                 sparse_as_dense: bool = False,
+                 sparse_params=None):
         super(self.__class__, self).__init__(params)
 
         if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
@@ -72,6 +74,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._process_set = process_set
         self._handles: dict = {}  # param id -> (handle, compression ctx)
         self._passes: dict = {}  # param id -> accumulation count
+        self._sparse_as_dense = bool(sparse_as_dense)
+        # pids whose grads are sparse: learned from the first sparse grad
+        # a hook sees, or DECLARED up front via sparse_params= (parameter
+        # names).  Declaration matters when the first use of a sparse
+        # embedding is data-dependent: a rank whose batch skipped it must
+        # still contribute a zero-nnz SPARSE collective in synchronize()
+        # — an undeclared skip would fill dense and negotiate a different
+        # op than its peers (deadlock).
+        name_to_pid = {n: pid for pid, n in self._param_names.items()}
+        self._sparse_params: set = set()
+        for n in (sparse_params or ()):
+            if n not in name_to_pid:
+                raise ValueError(
+                    f"sparse_params entry {n!r} is not a known parameter "
+                    f"name")
+            self._sparse_params.add(name_to_pid[n])
         self._should_sync = True
         self._hook_registered = []
         self._register_hooks(all_params)
@@ -104,7 +122,36 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # synchronize(): the stale op's in-place target IS p.grad,
             # which autograd has since re-accumulated — a write-back would
             # clobber the fresh gradient with the old reduction.
-            mpi_ops.retire(self._handles.pop(pid)[0])
+            stale = self._handles.pop(pid)
+            if stale[0] == "sparse":
+                for hh in stale[1][:2]:
+                    mpi_ops.retire(hh)
+            else:
+                mpi_ops.retire(stale[0])
+        if p.grad.is_sparse and self._sparse_as_dense:
+            # Reference knob: densify sparse grads and ride the ordinary
+            # dense allreduce (DistributedOptimizer(sparse_as_dense=True)).
+            with torch.no_grad():
+                p.grad = p.grad.to_dense()
+        if p.grad.is_sparse:
+            # Embedding layers with sparse=True route through
+            # sparse_allreduce (gather + re-accumulate) instead of
+            # densifying.  Scaling for bpps happens on the values
+            # locally; compression/predivide are dense-only features
+            # (reference restriction).
+            self._sparse_params.add(pid)
+            if self._predivide != 1.0:
+                raise ValueError(
+                    "gradient_predivide_factor is not supported for "
+                    "sparse gradients")
+            grad = p.grad.coalesce()
+            if self._bpps > 1:
+                grad.values().div_(self._bpps)  # stays coalesced
+            token = mpi_ops.sparse_allreduce_async(
+                grad, name=self._param_names[pid], op=self._op,
+                process_set=self._process_set)
+            self._handles[pid] = ("sparse", token, None, p)
+            return
         op, prescale, postscale = self._op, 1.0 / self._bpps, 1.0
         if self._predivide != 1.0:
             # Reference semantics: split the 1/size of Average into
@@ -140,12 +187,26 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         for p in self._requires_update:
             if id(p) not in self._handles:
                 if p.grad is None:
-                    p.grad = torch.zeros_like(p)
+                    if id(p) in self._sparse_params:
+                        # Zero-nnz contribution, matching the sparse
+                        # collectives the other ranks enqueue under this
+                        # name (a dense zeros fill would negotiate a
+                        # different op and hang the job).
+                        p.grad = torch.sparse_coo_tensor(
+                            torch.zeros((1, 0), dtype=torch.int64),
+                            torch.zeros((0,) + tuple(p.shape[1:]),
+                                        dtype=p.dtype),
+                            p.shape)
+                    else:
+                        p.grad = torch.zeros_like(p)
                 self._passes[id(p)] = 0
                 self._allreduce_grad_async(p)
         entries = list(self._handles.items())
         try:
             for pid, (h, ctx, compressed, p) in entries:
+                if h == "sparse":
+                    p.grad = mpi_ops.sparse_synchronize(ctx)
+                    continue
                 reduced = mpi_ops.synchronize(h)  # in-place: `compressed`
                 restored = self._compression.decompress(reduced, ctx)
                 if restored.data_ptr() != p.grad.data_ptr():
@@ -155,11 +216,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # Sweep the not-yet-synchronized handles out of the module
             # write-back table too — they hold strong gradient-tensor
             # references and mpi_ops.synchronize will never run for them.
-            for _, (h, *_rest) in entries:
-                mpi_ops._handles.pop(h)
+            for _, (h, ctx, *_rest) in entries:
+                if h == "sparse":
+                    for hh in ctx[:2]:
+                        mpi_ops._handles.pop(hh)
+                else:
+                    mpi_ops._handles.pop(h)
             raise
         finally:
             self._handles.clear()
+
+    def set_backward_passes_per_step(self, passes: int) -> None:
+        """Change the local-aggregation window (reference setter); resets
+        the per-parameter accumulation counters."""
+        self._bpps = max(1, int(passes))
+        self._passes = {}
 
     @contextlib.contextmanager
     def skip_synchronize(self):
@@ -187,13 +258,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
 
 def _set_size(process_set: Optional[ProcessSet]) -> int:
-    # ProcessSet.size(), not len(ranks): the global set resolves its
-    # membership lazily and keeps ranks = [].
-    if process_set is not None:
-        return process_set.size()
-    from .. import basics
+    from ..process_sets import effective_size
 
-    return basics.size()
+    return effective_size(process_set)
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -203,13 +270,21 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op=mpi_ops.Average,
                          gradient_predivide_factor: float = 1.0,
-                         process_set: Optional[ProcessSet] = None
-                         ) -> torch.optim.Optimizer:
+                         process_set: Optional[ProcessSet] = None,
+                         sparse_as_dense: bool = False,
+                         sparse_params=None) -> torch.optim.Optimizer:
     """Wrap a torch optimizer so gradients are averaged across ranks during
     backward (reference factory: horovod/torch/optimizer.py
-    DistributedOptimizer)."""
+    DistributedOptimizer).
+
+    ``sparse_as_dense=True`` densifies sparse gradients before the reduce
+    (the reference knob); otherwise sparse grads ride
+    :func:`sparse_allreduce`.  ``sparse_params=`` (parameter names)
+    pre-declares sparse-gradient parameters so a rank whose batch skips
+    the layer on the very first step still negotiates the sparse
+    collective (see _DistributedOptimizer.__init__)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               process_set)
+               process_set, sparse_as_dense, sparse_params)

@@ -81,6 +81,35 @@ def _torch_ops_worker():
     solo = hvd.allreduce(torch.full((2,), float(r + 1)), op=hvd.Sum,
                          name=f"t.ps.{r}", process_set=mine)
     np.testing.assert_allclose(solo.numpy(), float(r + 1))
+    # Grouped allgather / reducescatter (atomic negotiation groups).
+    gs = hvd.grouped_allgather(
+        [torch.full((r + 1, 2), float(r + i)) for i in range(2)],
+        name="t.gag")
+    for i, g in enumerate(gs):
+        assert tuple(g.shape) == (3, 2)
+        np.testing.assert_allclose(g[:1].numpy(), float(i))
+        np.testing.assert_allclose(g[1:].numpy(), float(i + 1))
+    rs = hvd.grouped_reducescatter(
+        [torch.full((4, 2), float(r + i)) for i in range(2)],
+        op=hvd.Sum, name="t.grs")
+    for i, o in enumerate(rs):
+        assert tuple(o.shape) == (2, 2)
+        np.testing.assert_allclose(o.numpy(), 2.0 * i + 1.0)
+
+    # Sparse allreduce: embedding-style row-sparse gradients; rank r
+    # touches rows {r, 2}, so row 2 accumulates from both ranks.
+    sp = torch.sparse_coo_tensor(
+        torch.tensor([[r, 2]]),
+        torch.tensor([[1.0 * (r + 1)] * 3, [10.0] * 3]), (4, 3))
+    red = hvd.sparse_allreduce(sp, op=hvd.Sum, name="t.sparse")
+    dense = red.to_dense()
+    np.testing.assert_allclose(dense[0].numpy(), 1.0)
+    np.testing.assert_allclose(dense[1].numpy(), 2.0)
+    np.testing.assert_allclose(dense[2].numpy(), 20.0)
+    np.testing.assert_allclose(dense[3].numpy(), 0.0)
+    avg = hvd.sparse_allreduce(sp, name="t.sparse.avg").to_dense()
+    np.testing.assert_allclose(avg[2].numpy(), 10.0)
+
     # Global collective after the subset ops: keeps ranks from racing
     # into shutdown while a peer's subset negotiation is in flight (the
     # test_multiprocess.py process-set pattern).
@@ -245,6 +274,87 @@ def _torch_syncbn_worker():
     return r
 
 
+def _torch_sparse_embedding_worker():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+
+    # nn.Embedding(sparse=True) through DistributedOptimizer: the grad
+    # hook must route sparse grads through sparse_allreduce.
+    torch.manual_seed(11)
+    emb = torch.nn.Embedding(8, 4, sparse=True)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.5),
+        named_parameters=emb.named_parameters())
+    hvd.broadcast_parameters(emb.state_dict(), root_rank=0)
+    w0 = emb.weight.detach().clone()
+
+    ids = torch.tensor([r, 2])  # row 2 touched by both ranks
+    opt.zero_grad()
+    emb(ids).sum().backward()
+    assert emb.weight.grad.is_sparse
+    opt.step()
+    # Averaged sparse grads: rows 0/1 moved by lr*0.5 (one rank each),
+    # row 2 by lr*1.0 (both), everything else untouched.
+    delta = (w0 - emb.weight.detach())
+    np.testing.assert_allclose(delta[0].numpy(), 0.25, atol=1e-6)
+    np.testing.assert_allclose(delta[1].numpy(), 0.25, atol=1e-6)
+    np.testing.assert_allclose(delta[2].numpy(), 0.5, atol=1e-6)
+    np.testing.assert_allclose(delta[3:].numpy(), 0.0, atol=1e-6)
+
+    # Zero-nnz contribution: rank 1's batch touches nothing (empty ids);
+    # its zero-row allgather must negotiate cleanly against rank 0's.
+    opt.zero_grad()
+    ids2 = torch.tensor([0]) if r == 0 else torch.tensor([], dtype=torch.long)
+    out = emb(ids2)
+    (out.sum() if out.numel() else out.sum() * 0.0).backward()
+    opt.step()
+
+    # Params stayed in lockstep throughout.
+    g = hvd.allgather(emb.weight.detach().reshape(1, -1), name="t.spemb.w")
+    np.testing.assert_allclose(g[0].numpy(), g[-1].numpy(), rtol=1e-6)
+
+    # Declared sparse param + data-dependent FIRST use: rank 1's batch
+    # skips the embedding entirely on step 1, but sparse_params= makes
+    # its zero-grad fill a zero-nnz SPARSE collective — an undeclared
+    # skip would fill dense and deadlock against rank 0's allgathers.
+    emb2 = torch.nn.Embedding(4, 2, sparse=True)
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb2.parameters(), lr=1.0),
+        named_parameters=emb2.named_parameters(),
+        sparse_params=["weight"])
+    hvd.broadcast_parameters(emb2.state_dict(), root_rank=0)
+    opt2.zero_grad()
+    if r == 0:
+        emb2(torch.tensor([1])).sum().backward()
+    opt2.step()  # must not hang
+    g2 = hvd.allgather(emb2.weight.detach().reshape(1, -1),
+                       name="t.spemb2.w")
+    np.testing.assert_allclose(g2[0].numpy(), g2[-1].numpy(), rtol=1e-6)
+
+    # sparse_as_dense: the reference knob — sparse grads densify and ride
+    # the ordinary dense allreduce.
+    emb3 = torch.nn.Embedding(4, 2, sparse=True)
+    opt3 = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb3.parameters(), lr=1.0),
+        named_parameters=emb3.named_parameters(), sparse_as_dense=True)
+    hvd.broadcast_parameters(emb3.state_dict(), root_rank=0)
+    opt3.zero_grad()
+    emb3(torch.tensor([r])).sum().backward()
+    opt3.step()
+    assert not emb3.weight.grad.is_sparse
+    g3 = hvd.allgather(emb3.weight.detach().reshape(1, -1),
+                       name="t.spemb3.w")
+    np.testing.assert_allclose(g3[0].numpy(), g3[-1].numpy(), rtol=1e-6)
+
+    hvd.shutdown()
+    return r
+
+
 def _torch_sampler_union_worker():
     import numpy as np
     import torch
@@ -353,6 +463,10 @@ def test_torch_syncbn_np2():
 
 def test_torch_elastic_state_np2():
     assert run(_torch_elastic_state_worker, np=2) == [0, 1]
+
+
+def test_torch_sparse_embedding_np2():
+    assert run(_torch_sparse_embedding_worker, np=2) == [0, 1]
 
 
 def test_torch_sampler_union_np2():

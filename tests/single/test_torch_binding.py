@@ -124,6 +124,31 @@ def test_distributed_optimizer_matches_plain_sgd(hvd_torch):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_sparse_allreduce_single(hvd_torch):
+    sp = torch.sparse_coo_tensor(torch.tensor([[0, 3]]),
+                                 torch.tensor([[1.0, 2.0], [3.0, 4.0]]),
+                                 (5, 2))
+    out = hvd.sparse_allreduce(sp, op=hvd.Sum, name="t.sp1")
+    assert out.is_sparse
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               sp.to_dense().numpy())
+    with pytest.raises(ValueError, match="sparse"):
+        hvd.sparse_allreduce(torch.ones(3))
+
+
+def test_set_backward_passes_per_step(hvd_torch):
+    model = torch.nn.Linear(3, 1, bias=False)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters())
+    opt.set_backward_passes_per_step(2)
+    model(torch.ones(1, 3)).sum().backward()
+    assert not opt._handles  # first pass accumulates only now
+    model(torch.ones(1, 3)).sum().backward()
+    assert opt._handles
+    opt.step()
+
+
 def test_backward_passes_per_step_accumulates(hvd_torch):
     model = torch.nn.Linear(3, 1, bias=False)
     with torch.no_grad():

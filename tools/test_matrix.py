@@ -170,6 +170,7 @@ def combos(quick: bool):
         yield ("jax", "purepy", 1, "off", "on", "shm")
         yield ("torch", "native", 2, "on", "on", "shm")
         yield ("torch", "native", 3, "off", "off", "tcp")
+        yield ("torch", "purepy", 1, "on", "on", "shm")
         return
     for core, np_, f, c, p in itertools.product(cores, nps, fusion, cache,
                                                 planes):
@@ -186,6 +187,7 @@ def combos(quick: bool):
     yield ("torch", "native", 3, "on", "on", "tcp")
     yield ("torch", "native", 3, "off", "on", "shm")
     yield ("torch", "native", 1, "on", "on", "shm")
+    yield ("torch", "purepy", 1, "on", "on", "shm")
 
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,

@@ -40,7 +40,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                  gradient_predivide_factor: float = 1.0,
                  process_set: Optional[ProcessSet] = None,
                  sparse_as_dense: bool = False,
-                 sparse_params=None):
+                 sparse_params=None,
+                 num_groups: Optional[int] = None,
+                 groups=None):
         super(self.__class__, self).__init__(params)
 
         if gradient_predivide_factor != 1.0 and op != mpi_ops.Average:
@@ -63,6 +65,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._param_names = {
             id(p): named.get(id(p), f"allreduce.noname.{i}")
             for i, p in enumerate(all_params)}
+        self._p_by_id = {id(p): p for p in all_params}
 
         self._requires_update = [p for group in self.param_groups
                                  for p in group["params"]
@@ -90,9 +93,57 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     f"sparse_params entry {n!r} is not a known parameter "
                     f"name")
             self._sparse_params.add(name_to_pid[n])
+        # Deterministic grouped fusion (reference: num_groups/groups args;
+        # group_table.cc semantics): members of a group allreduce as ONE
+        # atomic negotiation unit, enqueued only when every member's
+        # gradient is locally ready.  groups= takes explicit lists of
+        # params; num_groups= splits requires-grad params into contiguous
+        # chunks in registration order (upstream's split_list scheme).
+        # Sparse params are excluded — they ride sparse_allreduce
+        # individually.
+        if groups is not None and num_groups is not None:
+            raise ValueError("specify either num_groups or groups, not both")
+        self._group_of: dict = {}  # pid -> group index
+        self._group_members: list = []  # group -> [pid] in fixed order
+        self._group_fired: list = []  # group -> set of locally-ready pids
+        if groups is not None:
+            for members in groups:
+                self._add_group([id(p) for p in members])
+        elif num_groups:
+            # Contiguous chunks in registration order (upstream's
+            # split_list): late-firing groups can enqueue while backward
+            # still computes earlier layers — a round-robin stride would
+            # put a last-to-fire param in every group and serialize all
+            # the fusion traffic to end-of-backward.
+            groupable = [id(p) for p in self._requires_update
+                         if id(p) not in self._sparse_params]
+            n = min(max(1, int(num_groups)), max(1, len(groupable)))
+            per, extra = divmod(len(groupable), n)
+            off = 0
+            for gi in range(n):
+                take = per + (1 if gi < extra else 0)
+                if take:
+                    self._add_group(groupable[off:off + take])
+                off += take
         self._should_sync = True
         self._hook_registered = []
         self._register_hooks(all_params)
+
+    def _add_group(self, pids) -> None:
+        g = len(self._group_members)
+        updatable = {id(p) for p in self._requires_update}
+        for pid in pids:
+            if pid in self._group_of:
+                raise ValueError("a parameter appears in multiple groups")
+            if pid not in updatable:
+                raise ValueError(
+                    "groups= contains a tensor that is not a "
+                    "requires-grad optimizer parameter — a frozen member "
+                    "never fires its hook, so its group could never "
+                    "complete")
+            self._group_of[pid] = g
+        self._group_members.append(list(pids))
+        self._group_fired.append(set())
 
     # -- hooks --------------------------------------------------------------
 
@@ -133,6 +184,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # dense allreduce (DistributedOptimizer(sparse_as_dense=True)).
             with torch.no_grad():
                 p.grad = p.grad.to_dense()
+        if p.grad.is_sparse and pid in self._group_of:
+            # Sparse grads ride sparse_allreduce individually; drop the
+            # param from its fusion group (layer-determined sparsity, so
+            # every rank drops the same member).  The shrunk group may
+            # now be complete — already-fired dense members must not be
+            # stranded waiting on the departed one.
+            g = self._group_of.pop(pid)
+            self._group_members[g].remove(pid)
+            self._group_fired[g].discard(pid)
+            self._maybe_enqueue_group(g)
         if p.grad.is_sparse:
             # Embedding layers with sparse=True route through
             # sparse_allreduce (gather + re-accumulate) instead of
@@ -152,6 +213,31 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 process_set=self._process_set)
             self._handles[pid] = ("sparse", token, None, p)
             return
+        g = self._group_of.get(pid)
+        if g is not None:
+            # Extra backward after this group already enqueued: the whole
+            # group's reductions are stale relative to the re-fired
+            # member.  Retire every member's live handle so the group
+            # re-enqueues coherently (unfired members are completed by
+            # synchronize()'s fill-in, mirroring the per-tensor stale
+            # path at the top of this function).
+            for m in self._group_members[g]:
+                if m in self._handles and m != pid:
+                    mpi_ops.retire(self._handles.pop(m)[0])
+            # Fusion group: enqueue only when every member's gradient is
+            # locally ready; the whole group then negotiates atomically.
+            self._group_fired[g].add(pid)
+            self._maybe_enqueue_group(g)
+            return
+        op, prescale, postscale = self._dense_scale()
+        compressed, ctx = self._compression.compress(p.grad)
+        h = mpi_ops.allreduce_async_(
+            compressed, name=self._param_names[pid], op=op,
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=self._process_set)
+        self._handles[pid] = (h, ctx, compressed, p)
+
+    def _dense_scale(self):
         op, prescale, postscale = self._op, 1.0 / self._bpps, 1.0
         if self._predivide != 1.0:
             # Reference semantics: split the 1/size of Average into
@@ -160,12 +246,37 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             op = mpi_ops.Sum
             prescale /= self._predivide
             postscale = self._predivide / _set_size(self._process_set)
-        compressed, ctx = self._compression.compress(p.grad)
-        h = mpi_ops.allreduce_async_(
-            compressed, name=self._param_names[pid], op=op,
+        return op, prescale, postscale
+
+    def _maybe_enqueue_group(self, g: int) -> None:
+        if self._group_members[g] and \
+                len(self._group_fired[g]) == len(self._group_members[g]):
+            self._enqueue_group(g)
+
+    def _group_name(self, g: int) -> str:
+        # Derived from the member PARAMETER names, not the group index:
+        # two DistributedOptimizer instances in one process (GAN-style)
+        # would otherwise emit colliding group keys for different tensor
+        # sets, merging distinct groups in negotiation.  Member names are
+        # cross-rank consistent, so the digest is too.
+        import hashlib
+
+        sig = ",".join(self._param_names[pid]
+                       for pid in self._group_members[g])
+        return "hvd.grouped." + hashlib.sha1(sig.encode()).hexdigest()[:12]
+
+    def _enqueue_group(self, g: int) -> None:
+        members = self._group_members[g]
+        op, prescale, postscale = self._dense_scale()
+        comp = [self._compression.compress(self._p_by_id[pid].grad)
+                for pid in members]
+        handles = mpi_ops.grouped_allreduce_async_(
+            [t for t, _ in comp], name=self._group_name(g), op=op,
             prescale_factor=prescale, postscale_factor=postscale,
             process_set=self._process_set)
-        self._handles[pid] = (h, ctx, compressed, p)
+        for pid, h, (t, ctx) in zip(members, handles, comp):
+            self._handles[pid] = (h, ctx, t, self._p_by_id[pid])
+        self._group_fired[g].clear()
 
     # -- public surface (reference parity) ---------------------------------
 
@@ -185,22 +296,29 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         the step — the optimizer must come back usable, not wedged on
         stale handles from the failed round."""
         for p in self._requires_update:
-            if id(p) not in self._handles:
-                if p.grad is None:
-                    if id(p) in self._sparse_params:
-                        # Zero-nnz contribution, matching the sparse
-                        # collectives the other ranks enqueue under this
-                        # name (a dense zeros fill would negotiate a
-                        # different op and hang the job).
-                        p.grad = torch.sparse_coo_tensor(
-                            torch.zeros((1, 0), dtype=torch.int64),
-                            torch.zeros((0,) + tuple(p.shape[1:]),
-                                        dtype=p.dtype),
-                            p.shape)
-                    else:
-                        p.grad = torch.zeros_like(p)
-                self._passes[id(p)] = 0
-                self._allreduce_grad_async(p)
+            pid = id(p)
+            if pid in self._handles:
+                continue
+            g = self._group_of.get(pid)
+            if g is not None and pid in self._group_fired[g]:
+                # Fired but its group is still waiting on other members;
+                # their fill-ins below complete the group.
+                continue
+            if p.grad is None:
+                if pid in self._sparse_params:
+                    # Zero-nnz contribution, matching the sparse
+                    # collectives the other ranks enqueue under this
+                    # name (a dense zeros fill would negotiate a
+                    # different op and hang the job).
+                    p.grad = torch.sparse_coo_tensor(
+                        torch.zeros((1, 0), dtype=torch.int64),
+                        torch.zeros((0,) + tuple(p.shape[1:]),
+                                    dtype=p.dtype),
+                        p.shape)
+                else:
+                    p.grad = torch.zeros_like(p)
+            self._passes[pid] = 0
+            self._allreduce_grad_async(p)
         entries = list(self._handles.items())
         try:
             for pid, (h, ctx, compressed, p) in entries:
@@ -254,6 +372,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 "zero_grad called with allreduces in flight; call step() "
                 "or synchronize() first (reference raises the same way)")
         self._passes = {}
+        for fired in self._group_fired:
+            fired.clear()  # zeroed grads invalidate partial group fires
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
@@ -272,7 +392,9 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          gradient_predivide_factor: float = 1.0,
                          process_set: Optional[ProcessSet] = None,
                          sparse_as_dense: bool = False,
-                         sparse_params=None) -> torch.optim.Optimizer:
+                         sparse_params=None,
+                         num_groups: Optional[int] = None,
+                         groups=None) -> torch.optim.Optimizer:
     """Wrap a torch optimizer so gradients are averaged across ranks during
     backward (reference factory: horovod/torch/optimizer.py
     DistributedOptimizer).
@@ -282,9 +404,14 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     :func:`sparse_allreduce`.  ``sparse_params=`` (parameter names)
     pre-declares sparse-gradient parameters so a rank whose batch skips
     the layer on the very first step still negotiates the sparse
-    collective (see _DistributedOptimizer.__init__)."""
+    collective (see _DistributedOptimizer.__init__).
+
+    ``num_groups=N`` (or explicit ``groups=[[params...], ...]``)
+    partitions gradients into fusion groups that allreduce as atomic
+    negotiation units — the reference's deterministic grouped fusion."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, gradient_predivide_factor,
-               process_set, sparse_as_dense, sparse_params)
+               process_set, sparse_as_dense, sparse_params, num_groups,
+               groups)

@@ -274,6 +274,70 @@ def _torch_syncbn_worker():
     return r
 
 
+def _torch_grouped_optimizer_worker():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+
+    def make():
+        torch.manual_seed(21)
+        return torch.nn.Sequential(torch.nn.Linear(5, 8), torch.nn.Tanh(),
+                                   torch.nn.Linear(8, 2))
+
+    torch.manual_seed(0)
+    x_all = torch.randn(8 * s, 5)
+    y_all = torch.randn(8 * s, 2)
+    x, y = x_all[r * 8:(r + 1) * 8], y_all[r * 8:(r + 1) * 8]
+
+    def train(num_groups):
+        model = make()
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+            num_groups=num_groups)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        for _ in range(3):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            opt.step()
+        return model
+
+    # Grouped fusion (2 groups over 4 params) must produce exactly the
+    # per-tensor path's result, and keep ranks in lockstep.
+    ungrouped = train(None)
+    grouped = train(2)
+    for pu, pg in zip(ungrouped.parameters(), grouped.parameters()):
+        np.testing.assert_allclose(pg.detach().numpy(),
+                                   pu.detach().numpy(), rtol=1e-6)
+    for i, p in enumerate(grouped.parameters()):
+        g = hvd.allgather(p.detach().reshape(1, -1), name=f"t.grp.{i}")
+        np.testing.assert_allclose(g[0].numpy(), g[-1].numpy(), rtol=1e-6)
+
+    # Partial backward with groups: rank 1 skips the second layer, so two
+    # of its group members never fire; synchronize()'s fill-in completes
+    # the groups with zero grads (no deadlock, averaged halves).
+    model = make()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters(), num_groups=2)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt.zero_grad()
+    h = torch.tanh(model[0](x))
+    out = model[2](h).sum() if r == 0 else h.sum()
+    out.backward()
+    opt.step()  # must not hang
+    for i, p in enumerate(model.parameters()):
+        g = hvd.allgather(p.detach().reshape(1, -1), name=f"t.grp.p.{i}")
+        np.testing.assert_allclose(g[0].numpy(), g[-1].numpy(), rtol=1e-6)
+
+    hvd.shutdown()
+    return r
+
+
 def _torch_sparse_embedding_worker():
     import numpy as np
     import torch
@@ -463,6 +527,10 @@ def test_torch_syncbn_np2():
 
 def test_torch_elastic_state_np2():
     assert run(_torch_elastic_state_worker, np=2) == [0, 1]
+
+
+def test_torch_grouped_optimizer_np2():
+    assert run(_torch_grouped_optimizer_worker, np=2) == [0, 1]
 
 
 def test_torch_sparse_embedding_np2():

@@ -187,6 +187,79 @@ def test_extra_backward_pass_grad_not_clobbered(hvd_torch):
                                -3.0 * np.ones((1, 4)), rtol=1e-6)
 
 
+def test_two_grouped_optimizers_distinct_names(hvd_torch):
+    # GAN-style: two grouped optimizers in one process must not emit
+    # colliding group keys (names derive from member parameter names).
+    gen = torch.nn.Linear(3, 2)
+    disc = torch.nn.Linear(2, 1)
+    opt_g = hvd.DistributedOptimizer(
+        torch.optim.SGD(gen.parameters(), lr=0.1),
+        named_parameters=[("gen." + n, p)
+                          for n, p in gen.named_parameters()],
+        num_groups=1)
+    opt_d = hvd.DistributedOptimizer(
+        torch.optim.SGD(disc.parameters(), lr=0.1),
+        named_parameters=[("disc." + n, p)
+                          for n, p in disc.named_parameters()],
+        num_groups=1)
+    assert opt_g._group_name(0) != opt_d._group_name(0)
+    # Interleaved backward/step across both optimizers stays coherent.
+    disc(gen(torch.ones(1, 3))).sum().backward()
+    opt_g.step(), opt_d.step()
+    opt_g.zero_grad(), opt_d.zero_grad()
+
+
+def test_grouped_frozen_param_rejected(hvd_torch):
+    model = torch.nn.Linear(3, 1)
+    model.bias.requires_grad_(False)
+    with pytest.raises(ValueError, match="requires-grad"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            groups=[[model.weight, model.bias]])
+
+
+def test_grouped_extra_backward_no_strand(hvd_torch):
+    # Second partial backward after the group enqueued: the re-fired
+    # member retires the whole group's handles; step() re-reduces
+    # everything coherently (no stranded member, no stale reduction).
+    model = torch.nn.Sequential(torch.nn.Linear(3, 2), torch.nn.Linear(2, 1))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.0),  # inspect grads only
+        named_parameters=model.named_parameters(), num_groups=1)
+    x = torch.ones(1, 3)
+    model(x).sum().backward()          # full: group enqueues
+    model[0](x).sum().backward()       # partial: only layer-0 refires
+    opt.step()
+
+    ref = torch.nn.Sequential(torch.nn.Linear(3, 2), torch.nn.Linear(2, 1))
+    ref.load_state_dict(model.state_dict())
+    ref(x).sum().backward()
+    ref[0](x).sum().backward()
+    for p, q in zip(model.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p.grad.numpy(), q.grad.numpy(),
+                                   rtol=1e-6)
+
+
+def test_grouped_sparse_member_evicts_and_completes(hvd_torch):
+    # An (undeclared) sparse member lands in a group; its first sparse
+    # grad evicts it, and the shrunk group still completes even when the
+    # dense member fired first.
+    emb = torch.nn.Embedding(4, 2, sparse=True)
+    lin = torch.nn.Linear(2, 1)
+    params = list(lin.parameters()) + list(emb.parameters())
+    named = [(f"p{i}", p) for i, p in enumerate(params)]
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(params, lr=0.1), named_parameters=named,
+        groups=[params])
+    out = lin(emb(torch.tensor([1, 2])))
+    out.sum().backward()
+    opt.step()  # must not strand the dense members
+    assert not opt._handles
+    assert id(emb.weight) not in opt._group_of  # evicted
+    assert emb.weight.grad.is_sparse
+
+
 def test_zero_grad_with_inflight_handles_raises(hvd_torch):
     model = torch.nn.Linear(2, 1)
     opt = hvd.DistributedOptimizer(

@@ -64,7 +64,8 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_int, c.c_int, c.c_int, c.c_int,        # rank size local_rank local_size
         c.c_char_p, c.c_char_p, c.c_int,           # controller addr port
         c.c_double, c.c_longlong, c.c_int, c.c_int,  # cycle fusion cache autotune
-        c.c_char_p, c.c_char_p, c.c_int,           # autotune_log timeline mark
+        c.c_char_p, c.c_int,                       # autotune_log hierarchical
+        c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
     ]
     lib.hvd_shutdown.restype = c.c_int
@@ -111,6 +112,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_process_set_ranks.argtypes = [c.c_int, c.POINTER(c.c_int), c.c_int]
     lib.hvd_negotiation_stats.argtypes = [
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+    lib.hvd_data_plane_stats.argtypes = [
+        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.hvd_start_timeline.argtypes = [c.c_char_p, c.c_int]
     lib.hvd_stop_timeline.argtypes = []
     lib.hvd_last_error.restype = c.c_char_p
@@ -152,6 +155,7 @@ class NativeCore(CoreBackend):
             cfg.fusion_threshold_bytes, cfg.cache_capacity,
             1 if cfg.autotune else 0,
             (cfg.autotune_log or "").encode(),
+            1 if cfg.hierarchical_allreduce else 0,
             (cfg.timeline_path or "").encode(),
             1 if cfg.timeline_mark_cycles else 0,
             cfg.stall_warning_s if cfg.stall_check_enabled else 0.0,
@@ -366,6 +370,16 @@ class NativeCore(CoreBackend):
         self._lib.hvd_negotiation_stats(ctypes.byref(sent),
                                         ctypes.byref(recv))
         return {"ctrl_sent": sent.value, "ctrl_recv": recv.value}
+
+    def data_plane_stats(self) -> dict:
+        """Cumulative host-data-plane bytes sent by this rank, split by
+        locality: to ranks on this host vs. across hosts.  The hierarchical
+        allreduce's measurable effect is a shrinking cross-host share."""
+        local = ctypes.c_longlong()
+        xhost = ctypes.c_longlong()
+        self._lib.hvd_data_plane_stats(ctypes.byref(local),
+                                       ctypes.byref(xhost))
+        return {"data_sent_local": local.value, "data_sent_xhost": xhost.value}
 
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         self._lib.hvd_start_timeline(path.encode(), 1 if mark_cycles else 0)

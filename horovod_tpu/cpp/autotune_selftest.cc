@@ -36,17 +36,24 @@ double Surface(double x0, double x1, unsigned* rng) {
 int main() {
   {
     BayesianOptimizer bo;
+    // With the hierarchical knob pinned (no multi-host topology), the EI
+    // search must not waste probes on the dead arm.
+    bo.set_tune_x3(false);
     unsigned rng = 12345;
     // First probe: a deliberately bad corner (tiny fusion, huge cycle).
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0;
     double first_score = Surface(x0, x1, &rng);
-    bo.AddSample(x0, x1, x2, first_score);
+    bo.AddSample(x0, x1, x2, x3, first_score);
     for (int round = 0; round < 30; ++round) {
-      bo.Suggest(&x0, &x1, &x2);
-      bo.AddSample(x0, x1, x2, Surface(x0, x1, &rng));
+      bo.Suggest(&x0, &x1, &x2, &x3);
+      if (x3 >= 0.5) {
+        std::printf("FAIL: pinned x3 knob was explored\n");
+        return 1;
+      }
+      bo.AddSample(x0, x1, x2, x3, Surface(x0, x1, &rng));
     }
-    double bx0, bx1, bx2, best;
-    bo.Best(&bx0, &bx1, &bx2, &best);
+    double bx0, bx1, bx2, bx3, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &best);
     std::printf("first=%.3e best=%.3e at (%.2f, %.2f, %.0f)\n", first_score,
                 best, bx0, bx1, bx2);
     // The optimum value is ~1e9; the bad corner scores ~0.  Require the
@@ -66,16 +73,17 @@ int main() {
     // optimizer must converge onto category 1 (reference analog:
     // ParameterManager's categorical cache/hierarchical flags).
     BayesianOptimizer bo;
+    bo.set_tune_x3(false);
     unsigned rng = 777;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0;
-    bo.AddSample(x0, x1, x2, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, Surface(x0, x1, &rng));
     for (int round = 0; round < 30; ++round) {
-      bo.Suggest(&x0, &x1, &x2);
+      bo.Suggest(&x0, &x1, &x2, &x3);
       double s = Surface(x0, x1, &rng) * (x2 >= 0.5 ? 1.25 : 1.0);
-      bo.AddSample(x0, x1, x2, s);
+      bo.AddSample(x0, x1, x2, x3, s);
     }
-    double bx0, bx1, bx2, best;
-    bo.Best(&bx0, &bx1, &bx2, &best);
+    double bx0, bx1, bx2, bx3, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &best);
     std::printf("categorical best=%.3e at (%.2f, %.2f, cat=%.0f)\n", best,
                 bx0, bx1, bx2);
     if (bx2 < 0.5) {
@@ -85,6 +93,33 @@ int main() {
     }
     if (best < 0.8 * 1.25e9) {
       std::printf("FAIL: categorical surface peak not approached\n");
+      return 1;
+    }
+  }
+  {
+    // Hierarchical arm: same surface, but the x3=1 arm (hierarchical
+    // allreduce on a multi-host topology) scores 30% higher everywhere.
+    // With the knob tunable, the optimizer must converge onto it.
+    BayesianOptimizer bo;
+    unsigned rng = 4242;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, Surface(x0, x1, &rng));
+    for (int round = 0; round < 40; ++round) {
+      bo.Suggest(&x0, &x1, &x2, &x3);
+      double s = Surface(x0, x1, &rng) * (x3 >= 0.5 ? 1.3 : 1.0);
+      bo.AddSample(x0, x1, x2, x3, s);
+    }
+    double bx0, bx1, bx2, bx3, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &best);
+    std::printf("hier best=%.3e at (%.2f, %.2f, cat=%.0f, hier=%.0f)\n",
+                best, bx0, bx1, bx2, bx3);
+    if (bx3 < 0.5) {
+      std::printf("FAIL: hierarchical knob did not converge to the better "
+                  "arm\n");
+      return 1;
+    }
+    if (best < 0.8 * 1.3e9) {
+      std::printf("FAIL: hierarchical surface peak not approached\n");
       return 1;
     }
   }

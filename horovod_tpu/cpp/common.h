@@ -129,6 +129,13 @@ struct Response {
   std::string error;                 // non-empty -> deliver failure
   bool cache_hit = false;
   int64_t seq = -1;  // global data-op sequence (tags data-plane frames)
+  // Coordinator-decided plane refinement for host-plane allreduces: when
+  // set, every member runs the hierarchical composition (shm-local reduce
+  // to a per-host leader, leader-only cross-host ring, shm-local
+  // broadcast) instead of the flat all-rank ring.  Carried in the
+  // serialized response so the choice can never diverge across ranks —
+  // a split plane would deadlock the data plane.
+  bool hier = false;
   int32_t last_joined = -1;  // JOIN responses: the last rank to join
   // When >= 0, only this rank acts on the response (tombstone error
   // deliveries: the name may have been consistently resubmitted by other
@@ -151,6 +158,11 @@ struct CoreConfig {
   int cache_capacity = 1024;
   bool autotune = false;
   std::string autotune_log;
+  // HOROVOD_HIERARCHICAL_ALLREDUCE: compose shm-local reduce + leader-only
+  // cross-host ring + shm-local broadcast for sets spanning hosts with
+  // co-located ranks.  Only the coordinator's value matters (the decision
+  // rides in each response), so per-rank divergence is harmless.
+  bool hierarchical = false;
   std::string timeline_path;
   bool timeline_mark_cycles = false;
   double stall_warn_s = 60.0;

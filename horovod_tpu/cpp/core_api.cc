@@ -258,9 +258,15 @@ void BackgroundLoop() {
       // Categorical knob: worker-side cache announce (safe per rank —
       // inserts stay deterministic either way).
       auto* sc = dynamic_cast<SocketController*>(g->controller.get());
-      if (sc) sc->SetAnnounceCache(g->params.announce_cache());
+      if (sc) {
+        sc->SetAnnounceCache(g->params.announce_cache());
+        // Coordinator-only knob: the hierarchical decision rides in each
+        // serialized response, so applying it on every rank is harmless.
+        sc->SetHierarchical(g->params.hierarchical());
+      }
       HVD_LOG(DEBUG) << "autotune: fusion=" << fusion << " cycle_ms=" << cycle
-                     << " announce_cache=" << g->params.announce_cache();
+                     << " announce_cache=" << g->params.announce_cache()
+                     << " hierarchical=" << g->params.hierarchical();
     }
 
     double now = MonotonicSeconds();
@@ -317,9 +323,9 @@ extern "C" {
 int hvd_init(int rank, int size, int local_rank, int local_size,
              const char* controller, const char* addr, int port,
              double cycle_ms, long long fusion, int cache_cap, int autotune,
-             const char* autotune_log, const char* timeline_path,
-             int timeline_mark_cycles, double stall_warn_s,
-             double stall_shutdown_s, int log_level) {
+             const char* autotune_log, int hierarchical,
+             const char* timeline_path, int timeline_mark_cycles,
+             double stall_warn_s, double stall_shutdown_s, int log_level) {
   if (g != nullptr) return -1;
   g = new GlobalState();
   auto& cfg = g->cfg;
@@ -335,6 +341,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.cache_capacity = cache_cap;
   cfg.autotune = autotune != 0;
   cfg.autotune_log = autotune_log ? autotune_log : "";
+  cfg.hierarchical = hierarchical != 0;
   cfg.timeline_path = timeline_path ? timeline_path : "";
   cfg.timeline_mark_cycles = timeline_mark_cycles != 0;
   cfg.stall_warn_s = stall_warn_s;
@@ -360,7 +367,13 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     g->timeline.Start(cfg.timeline_path, cfg.timeline_mark_cycles);
   }
   if (cfg.autotune) {
-    g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log);
+    // The hierarchical knob is tunable only when the wired-up topology can
+    // act on it (>= 2 hosts with >= 1 multi-rank host and working shm);
+    // otherwise it is pinned off so the GP never explores a dead arm.
+    auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+    bool hier_tunable = sc != nullptr && sc->HierAvailable();
+    g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log,
+                         cfg.hierarchical, hier_tunable);
   }
   g->background = std::thread(BackgroundLoop);
   return 0;
@@ -594,6 +607,21 @@ void hvd_negotiation_stats(long long* sent, long long* recv) {
   g->controller->NegotiationStats(&s, &r);
   *sent = s;
   *recv = r;
+}
+
+// Data-plane byte accounting split by locality (host plane only): bytes
+// sent to ranks sharing this rank's host key vs. bytes crossing hosts.
+// Lets tests assert the hierarchical composition actually shrinks
+// cross-host traffic instead of trusting the topology log.
+void hvd_data_plane_stats(long long* local, long long* xhost) {
+  *local = *xhost = 0;
+  if (g == nullptr) return;
+  auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+  if (sc == nullptr) return;
+  int64_t l = 0, x = 0;
+  sc->DataPlaneStats(&l, &x);
+  *local = l;
+  *xhost = x;
 }
 
 void hvd_start_timeline(const char* path, int mark_cycles) {

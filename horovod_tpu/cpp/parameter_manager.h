@@ -19,30 +19,34 @@
 namespace hvdtpu {
 
 // Gaussian-process regression + Expected Improvement over two continuous
-// knobs on the unit square plus one BINARY knob (reference:
+// knobs on the unit square plus two BINARY knobs (reference:
 // ParameterManager also tunes categorical flags like cache/hierarchical
-// allreduce — a binary coordinate in the same GP is the cheap TPU-native
-// form).  Exposed for the synthetic-surface self-test
-// (autotune_selftest.cc).
+// allreduce — binary coordinates in the same GP are the cheap TPU-native
+// form; x2 = announce-cache, x3 = hierarchical allreduce).  Exposed for
+// the synthetic-surface self-test (autotune_selftest.cc).
 class BayesianOptimizer {
  public:
-  // Observations are (x in [0,1]^2, x2 in {0,1}, score); scores are
+  // Observations are (x in [0,1]^2, x2/x3 in {0,1}, score); scores are
   // internally max-normalized so the kernel scales stay dimensionless.
-  void AddSample(double x0, double x1, double x2, double score);
-  // Next point to try: argmax EI over a jittered grid x {0,1}.  Falls
+  void AddSample(double x0, double x1, double x2, double x3, double score);
+  // Next point to try: argmax EI over a jittered grid x {0,1}^2.  Falls
   // back to latin-square-ish seed points for the first few calls.
-  void Suggest(double* x0, double* x1, double* x2);
+  void Suggest(double* x0, double* x1, double* x2, double* x3);
   // Best observed sample.
-  void Best(double* x0, double* x1, double* x2, double* score) const;
+  void Best(double* x0, double* x1, double* x2, double* x3,
+            double* score) const;
   int num_samples() const { return static_cast<int>(xs_.size()); }
+  // When the x3 knob cannot take effect (topology not hierarchical), pin
+  // it to 0 so the EI search does not waste half its grid on a dead arm.
+  void set_tune_x3(bool v) { tune_x3_ = v; }
 
  private:
   void FitGP();
-  void Predict(double x0, double x1, double x2, double* mean,
+  void Predict(double x0, double x1, double x2, double x3, double* mean,
                double* var) const;
 
   struct Pt {
-    double x0, x1, x2;
+    double x0, x1, x2, x3;
   };
   std::vector<Pt> xs_;
   std::vector<double> ys_;      // raw scores
@@ -50,12 +54,18 @@ class BayesianOptimizer {
   std::vector<double> chol_;    // Cholesky factor of K (row-major lower)
   double y_max_ = 0;
   unsigned rng_ = 0x9e3779b9u;
+  bool tune_x3_ = true;
 };
 
 class ParameterManager {
  public:
+  // hierarchical: initial value of the hierarchical-allreduce knob.
+  // hier_tunable: whether the data plane can act on it at all (a
+  // hierarchical topology exists); when false the knob is pinned off and
+  // the GP never explores that arm.
   void Initialize(int64_t fusion_threshold, double cycle_time_ms,
-                  const std::string& log_path);
+                  const std::string& log_path, bool hierarchical = false,
+                  bool hier_tunable = false);
   ~ParameterManager();
 
   // Record bytes covered by emitted responses.
@@ -73,6 +83,11 @@ class ParameterManager {
   // response-cache ids?  (Per-rank safe: announcing full requests never
   // desyncs the deterministic cache-insert order.)
   bool announce_cache() const { return cache_use_; }
+  // Categorical knob: hierarchical allreduce (shm-local reduce ->
+  // leader-only cross-host ring -> shm-local broadcast).  Coordinator-only:
+  // the decision rides in each serialized response, so only the
+  // coordinator's copy of this knob matters.
+  bool hierarchical() const { return hier_use_; }
 
  private:
   void Score(double score);
@@ -86,10 +101,13 @@ class ParameterManager {
   int64_t fusion_ = 0;
   double cycle_ms_ = 1.0;
   bool cache_use_ = true;
+  bool hier_use_ = false;
+  bool hier_tunable_ = false;
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
   bool best_cache_ = true;
+  bool best_hier_ = false;
   int warmup_windows_ = 1;
   int windows_since_best_ = 0;
   bool converged_ = false;

@@ -1,6 +1,7 @@
 #include "socket_controller.h"
 
 #include <poll.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstdlib>
@@ -20,7 +21,8 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-constexpr int32_t kProtocolVersion = 4;         // v4: device bit in requests
+// v5: host key in the rendezvous HELLO/book + hier bit in responses
+constexpr int32_t kProtocolVersion = 5;
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -43,6 +45,16 @@ constexpr int32_t kTagShmRead = 0xB000;
 constexpr int32_t kTagShmGrow = 0xC000;
 constexpr int32_t kTagShmOpen = 0xD000;
 constexpr int32_t kTagShmVerdict = 0xE000;
+// Hierarchical allreduce: per-host subgroup phase fences (write done,
+// segments reduced, leader ring done, result read back, region grow) plus
+// the whole-set open/verdict handshake at topology setup.
+constexpr int32_t kTagHierWrite = 0xF000;
+constexpr int32_t kTagHierMid = 0xF800;
+constexpr int32_t kTagHierDone = 0x10000;
+constexpr int32_t kTagHierRead = 0x10800;
+constexpr int32_t kTagHierGrow = 0x11000;
+constexpr int32_t kTagHierOpen = 0x11800;
+constexpr int32_t kTagHierVerdict = 0x12000;
 
 // Broadcasts at least this large take the pipelined chain instead of the
 // binomial tree.  A protocol constant: the algorithm choice must agree on
@@ -93,7 +105,9 @@ Status SocketController::Initialize() {
   peer_socks_.resize(cfg_.size);
   std::vector<std::string> addrs(cfg_.size);
   std::vector<int> ports(cfg_.size, 0);
+  std::vector<std::string> hosts(cfg_.size);
   ports[cfg_.rank] = data_listener_.port();
+  hosts[cfg_.rank] = HostKey(cfg_.rank, cfg_.size);
 
   if (is_coordinator()) {
     if (!listener_.Listen("0.0.0.0", cfg_.rendezvous_port)) {
@@ -143,21 +157,28 @@ Status SocketController::Initialize() {
       }
       int rank = r.GetI32();
       int data_port = r.GetI32();
-      if (rank <= 0 || rank >= cfg_.size || ctrl_socks_[rank].valid()) {
+      std::string host_key = r.GetString();
+      if (!r.ok() || rank <= 0 || rank >= cfg_.size ||
+          ctrl_socks_[rank].valid()) {
         return Status::Error(StatusCode::INVALID_ARGUMENT,
                              "bad HELLO from worker");
       }
       addrs[rank] = s.PeerAddr();
       ports[rank] = data_port;
+      hosts[rank] = host_key;
       s.SetRecvTimeout(0);  // ctrl-channel reads are blocking again
       ctrl_socks_[rank] = std::move(s);
       --needed;
     }
-    // Broadcast the address book over the ctrl channel.
+    // Broadcast the address book over the ctrl channel.  Host keys ride
+    // along so every rank sees the SAME host grouping — workers cannot
+    // derive it from addresses (their view of rank 0's address differs
+    // from the coordinator's own).
     Writer book;
     for (int rank = 0; rank < cfg_.size; ++rank) {
       book.PutString(addrs[rank]);
       book.PutI32(ports[rank]);
+      book.PutString(hosts[rank]);
     }
     for (int rank = 1; rank < cfg_.size; ++rank) {
       if (!ctrl_socks_[rank].SendFrame(book.data())) {
@@ -179,6 +200,7 @@ Status SocketController::Initialize() {
     hello.PutI32(kProtocolVersion);
     hello.PutI32(cfg_.rank);
     hello.PutI32(data_listener_.port());
+    hello.PutString(hosts[cfg_.rank]);
     if (!coord_ctrl_.SendFrame(hello.data())) {
       return Status::Error(StatusCode::PRECONDITION_ERROR, "HELLO failed");
     }
@@ -191,6 +213,7 @@ Status SocketController::Initialize() {
     for (int rank = 0; rank < cfg_.size; ++rank) {
       addrs[rank] = r.GetString();
       ports[rank] = r.GetI32();
+      hosts[rank] = r.GetString();
     }
     // Workers reach rank 0 by the address they rendezvoused through.
     addrs[0] = cfg_.rendezvous_addr;
@@ -200,12 +223,16 @@ Status SocketController::Initialize() {
   // later (EstablishChannel).
   mesh_addrs_ = addrs;
   mesh_ports_ = ports;
+  host_keys_ = hosts;
   std::vector<int> all_ranks(cfg_.size);
   for (int i = 0; i < cfg_.size; ++i) all_ranks[i] = i;
   Status s = ConnectMesh(all_ranks, /*psid=*/0, &peer_socks_);
   if (!s.ok()) return s;
   s = MaybeOpenShm(0, all_ranks);
   if (!s.ok()) return s;
+  s = MaybeSetupHier(0, all_ranks);
+  if (!s.ok()) return s;
+  hierarchical_.store(cfg_.hierarchical, std::memory_order_relaxed);
   initialized_ = true;
   return Status::OK();
 }
@@ -312,11 +339,18 @@ Status SocketController::EstablishChannel(int psid) {
     std::lock_guard<std::mutex> l(channels_mu_);
     channel_socks_[psid] = std::move(socks);
   }
-  return MaybeOpenShm(psid, members);
+  s = MaybeOpenShm(psid, members);
+  if (!s.ok()) return s;
+  return MaybeSetupHier(psid, members);
 }
 
 void SocketController::RemoveChannel(int psid) {
   std::lock_guard<std::mutex> l(channels_mu_);
+  auto hh = hier_.find(psid);
+  if (hh != hier_.end()) {
+    if (hh->second.shm) hh->second.shm->Close(hh->second.local_idx == 0);
+    hier_.erase(hh);
+  }
   auto sh = shm_.find(psid);
   if (sh != shm_.end()) {
     std::vector<int> members;
@@ -371,6 +405,10 @@ void SocketController::Shutdown() {
       kv.second->Close(creator);
     }
     shm_.clear();
+    for (auto& kv : hier_) {
+      if (kv.second.shm) kv.second.shm->Close(kv.second.local_idx == 0);
+    }
+    hier_.clear();
     for (auto& kv : channel_socks_)
       for (auto& s : kv.second) s.Close();
     channel_socks_.clear();
@@ -768,13 +806,20 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   for (auto& r : *out) {
     if (r.error.empty()) {
       for (const auto& m : r.metas) cache_.Insert(m);
-      if (r.seq >= 0) seq_counter_ = r.seq + 1;
+      if (r.seq >= 0) {
+        seq_counter_ = r.seq + 1;
+        if (r.hier) {
+          std::lock_guard<std::mutex> l(hier_mu_);
+          hier_by_seq_[r.seq] = true;
+        }
+      }
     }
   }
   return Status::OK();
 }
 
 void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {
+  const bool hier_on = hierarchical_.load(std::memory_order_relaxed);
   for (auto& r : *responses) {
     if (!r.error.empty()) continue;
     bool all_cached = true;
@@ -784,6 +829,19 @@ void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {
     }
     r.cache_hit = all_cached;
     r.seq = seq_counter_++;
+    // Hierarchical plane decision (coordinator only, carried in the
+    // response): host-plane allreduces on sets whose agreed topology
+    // qualifies.  The device bit follows ResponseToJson's AND — a single
+    // host-bound member demotes the whole response to the host plane.
+    if (hier_on && r.op == OpType::ALLREDUCE && !r.metas.empty()) {
+      bool device = true;
+      for (const auto& m : r.metas) device = device && m.device != 0;
+      if (!device && HierFor(r.process_set_id) != nullptr) r.hier = true;
+    }
+    if (r.hier) {
+      std::lock_guard<std::mutex> l(hier_mu_);
+      hier_by_seq_[r.seq] = true;
+    }
   }
 }
 
@@ -869,6 +927,7 @@ Status SocketController::ExchangeStep(std::vector<Socket>& socks, int send_to,
                                       const std::string& frame,
                                       int recv_from, std::string* in) {
   if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  CountSend(send_to, static_cast<int64_t>(frame.size()));
   if (!DuplexExchange(socks[send_to], frame, socks[recv_from], in,
                       [this] { return aborted_.load(); })) {
     aborted_ = true;
@@ -888,6 +947,7 @@ Status SocketController::ChunkedStep(
   if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
   Writer w;
   PutFrameHeader(&w, current_seq_, tag);
+  CountSend(send_to, send_len + static_cast<int64_t>(w.data().size()));
   ChunkExchangeError err;
   if (!ChunkedDuplexExchange(socks[send_to], send_base, send_len,
                              socks[recv_from], recv_len, chunk_bytes,
@@ -1057,6 +1117,24 @@ Status SocketController::AllreduceBuffer(void* buf, int64_t count,
   Status st = Members(psid, &members, &idx);
   if (!st.ok()) return st;
   if (members.size() > 1) {
+    // Hierarchical path: engaged only when THIS seq's response carried the
+    // coordinator's hier bit (recorded in the cycle), so the choice is
+    // identical on every member.  Direct calls (seq -1, selftests) and
+    // unmarked seqs keep today's behavior.
+    bool hier = false;
+    {
+      std::lock_guard<std::mutex> l(hier_mu_);
+      auto it = hier_by_seq_.find(current_seq_);
+      if (it != hier_by_seq_.end()) {
+        hier = it->second;
+        hier_by_seq_.erase(it);
+      }
+    }
+    if (hier) {
+      if (HierTopo* topo = HierFor(psid)) {
+        return HierAllreduce(*topo, SocksFor(psid), buf, count, dtype, op);
+      }
+    }
     if (ShmRegion* shm = ShmFor(psid)) {
       return ShmAllreduce(*shm, SocksFor(psid), members, idx, buf, count,
                           dtype, op);
@@ -1244,8 +1322,8 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
     char* base = static_cast<char*>(buf);
     const int src =
         vrank > 0 ? members[(root_idx + vrank - 1) % m] : -1;
-    Socket* next_sock =
-        vrank + 1 < m ? &socks[members[(root_idx + vrank + 1) % m]] : nullptr;
+    const int nxt = vrank + 1 < m ? members[(root_idx + vrank + 1) % m] : -1;
+    Socket* next_sock = nxt >= 0 ? &socks[nxt] : nullptr;
     // Geometry header: [seq|tag|nbytes] hops ahead of the raw chunk
     // stream so a size mismatch aborts before any payload bytes land.
     if (src >= 0) {
@@ -1285,6 +1363,7 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       Writer w;
       PutFrameHeader(&w, current_seq_, kTagBroadcastChain);
       w.PutI64(nbytes);
+      CountSend(nxt, static_cast<int64_t>(w.data().size()) + nbytes);
       if (!next_sock->SendFrame(w.data())) {
         aborted_ = true;
         return Status::Error(StatusCode::ABORTED,
@@ -1346,6 +1425,7 @@ Status SocketController::BroadcastBuffer(void* buf, int64_t nbytes,
       Writer w;
       PutFrameHeader(&w, current_seq_, kTagBroadcast);
       w.PutRaw(buf, nbytes);
+      CountSend(dst, static_cast<int64_t>(w.data().size()));
       if (!socks[dst].SendFrame(w.data())) {
         aborted_ = true;
         return Status::Error(StatusCode::ABORTED,
@@ -1507,8 +1587,13 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
 bool SocketController::MembersAllLocal(const std::vector<int>& members) const {
   const char* disable = ::getenv("HOROVOD_SHM_DISABLE");
   if (disable && disable[0] == '1') return false;
+  // The agreed host keys are the locality signal (identical on every rank,
+  // honors the fake-host overrides); the loopback-address test remains as
+  // a belt-and-braces check against a spoofed key colliding across real
+  // hosts.
   for (int r : members) {
     if (r == cfg_.rank) continue;
+    if (host_keys_[r] != host_keys_[cfg_.rank]) return false;
     const std::string& a = mesh_addrs_[r];
     if (a.rfind("127.", 0) != 0 && a != "localhost" && a != "::1") {
       return false;
@@ -1740,6 +1825,230 @@ Status SocketController::ShmAlltoall(ShmRegion& shm,
     out->append(shm.data() + offs[k], rows[k] * row_bytes);
   }
   return SockBarrier(socks, members, idx, kTagShmRead);
+}
+
+// ---------------------------------------------------------------------------
+// Hierarchical allreduce: shm-local reduce -> leader ring -> shm broadcast
+// (reference analog: NCCLHierarchicalAllreduce, SURVEY.md §2.2; the Awan
+// et al. intra-node-reduce / inter-node-exchange design)
+// ---------------------------------------------------------------------------
+
+std::string SocketController::HostKey(int rank, int size) {
+  // Explicit per-rank override first (the reference env name).
+  if (const char* env = ::getenv("HOROVOD_HOSTNAME")) {
+    if (env[0]) return env;
+  }
+  // Test hook: HOROVOD_HIER_FAKE_HOSTS=n partitions the job into n blocks
+  // of consecutive ranks so one machine can emulate a multi-host topology
+  // (mirrors real deployments, where consecutive ranks share a host).
+  if (const char* env = ::getenv("HOROVOD_HIER_FAKE_HOSTS")) {
+    char* end = nullptr;
+    long n = std::strtol(env, &end, 10);
+    if (end && *end == '\0' && n > 1 && size > 0) {
+      int64_t h = static_cast<int64_t>(rank) * n / size;
+      return "fakehost-" + std::to_string(h);
+    }
+  }
+  char buf[256] = {0};
+  if (::gethostname(buf, sizeof(buf) - 1) != 0) return "unknown-host";
+  return buf;
+}
+
+void SocketController::CountSend(int to, int64_t nbytes) {
+  if (to < 0 || to >= static_cast<int>(host_keys_.size())) return;
+  if (host_keys_[to] == host_keys_[cfg_.rank]) {
+    data_sent_local_.fetch_add(nbytes, std::memory_order_relaxed);
+  } else {
+    data_sent_xhost_.fetch_add(nbytes, std::memory_order_relaxed);
+  }
+}
+
+Status SocketController::MaybeSetupHier(int psid,
+                                        const std::vector<int>& members) {
+  const int m = static_cast<int>(members.size());
+  if (m <= 1) return Status::OK();
+  // Group members by agreed host key, first-appearance order over the
+  // sorted member list: identical on every rank, and each group's first
+  // member (its leader) ascends with the group index.
+  std::vector<std::vector<int>> groups;
+  std::map<std::string, int> group_of;
+  for (int r : members) {
+    auto it = group_of.find(host_keys_[r]);
+    if (it == group_of.end()) {
+      group_of.emplace(host_keys_[r], static_cast<int>(groups.size()));
+      groups.push_back({r});
+    } else {
+      groups[it->second].push_back(r);
+    }
+  }
+  size_t max_group = 0;
+  for (const auto& grp : groups) max_group = std::max(max_group, grp.size());
+  // Topology applicability is a pure function of the agreed book, so an
+  // agreed skip here cannot desync: the composition only pays off with
+  // >=2 hosts and at least one host holding co-located ranks.  The
+  // degenerate 1-rank-per-host job never builds a topology and stays on
+  // the flat ring by construction.
+  if (groups.size() < 2 || max_group < 2) return Status::OK();
+
+  HierTopo topo;
+  const int my_group = group_of[host_keys_[cfg_.rank]];
+  topo.local = groups[my_group];
+  topo.local_idx = static_cast<int>(
+      std::find(topo.local.begin(), topo.local.end(), cfg_.rank) -
+      topo.local.begin());
+  for (const auto& grp : groups) topo.leaders.push_back(grp[0]);
+  auto lit = std::find(topo.leaders.begin(), topo.leaders.end(), cfg_.rank);
+  topo.leader_idx = lit == topo.leaders.end()
+                        ? -1
+                        : static_cast<int>(lit - topo.leaders.begin());
+
+  auto mit = std::find(members.begin(), members.end(), cfg_.rank);
+  const int idx = static_cast<int>(mit - members.begin());
+  std::vector<Socket>& socks = SocksFor(psid);
+
+  // The intra-host phases need the subgroup shm region; per-rank state
+  // (HOROVOD_SHM_DISABLE, an shm_open failure) may diverge, so every
+  // member always runs the whole-set handshake and a single no vote
+  // demotes the entire set back to the flat ring.
+  const char* disable = ::getenv("HOROVOD_SHM_DISABLE");
+  const bool attempt = !(disable && disable[0] == '1');
+  const bool creator = topo.local_idx == 0;
+  Status open_st = Status::OK();
+  std::string name;
+  if (topo.local.size() > 1) {
+    topo.shm = std::make_unique<ShmRegion>();
+    name = "/hvd_" + std::to_string(cfg_.rendezvous_port) + "_" +
+           std::to_string(psid) + "_h" + std::to_string(my_group);
+    if (creator && attempt) open_st = topo.shm->Open(name, true);
+  }
+  Status st = SockBarrier(socks, members, idx, kTagHierOpen);
+  if (!st.ok()) return st;
+  if (topo.shm && !creator && attempt) open_st = topo.shm->Open(name, false);
+  if (topo.shm && !attempt) {
+    open_st = Status::Error(StatusCode::PRECONDITION_ERROR, "not attempted");
+  }
+  // Whole-set agreed verdict through the set root (same shape as the shm
+  // plane's): either every member keeps the topology or nobody does.
+  uint8_t ok = open_st.ok() ? 1 : 0;
+  if (idx == 0) {
+    uint8_t all_ok = ok;
+    for (int j = 1; j < m; ++j) {
+      std::string frame;
+      if (!socks[members[j]].RecvFrame(&frame)) all_ok = 0;
+      Reader rd(frame);
+      rd.GetI64();
+      int32_t tag = rd.GetI32();
+      if (!rd.ok() || tag != kTagHierVerdict || rd.remaining() < 1 ||
+          rd.cursor()[0] == 0) {
+        all_ok = 0;
+      }
+    }
+    for (int j = 1; j < m; ++j) {
+      Writer w;
+      PutFrameHeader(&w, current_seq_, kTagHierVerdict);
+      w.PutRaw(&all_ok, 1);
+      if (!socks[members[j]].SendFrame(w.data())) {
+        return Status::Error(StatusCode::ABORTED, "hier verdict send failed");
+      }
+    }
+    ok = all_ok;
+  } else {
+    Writer w;
+    PutFrameHeader(&w, current_seq_, kTagHierVerdict);
+    w.PutRaw(&ok, 1);
+    if (!socks[members[0]].SendFrame(w.data())) {
+      return Status::Error(StatusCode::ABORTED, "hier verdict send failed");
+    }
+    std::string frame;
+    if (!socks[members[0]].RecvFrame(&frame)) {
+      return Status::Error(StatusCode::ABORTED, "hier verdict recv failed");
+    }
+    Reader rd(frame);
+    rd.GetI64();
+    int32_t tag = rd.GetI32();
+    ok = (rd.ok() && tag == kTagHierVerdict && rd.remaining() >= 1)
+             ? static_cast<uint8_t>(rd.cursor()[0])
+             : 0;
+  }
+  if (!ok) {
+    if (topo.shm) topo.shm->Close(creator);
+    HVD_LOG(INFO) << "hierarchical allreduce unavailable for psid " << psid
+                  << "; staying on the flat ring";
+    return Status::OK();
+  }
+  HVD_LOG(INFO) << "hierarchical topology for psid " << psid << ": "
+                << groups.size() << " hosts, " << topo.local.size()
+                << " local member(s), leader rank " << topo.leaders[my_group];
+  std::lock_guard<std::mutex> l(channels_mu_);
+  hier_.emplace(psid, std::move(topo));
+  return Status::OK();
+}
+
+SocketController::HierTopo* SocketController::HierFor(int psid) {
+  std::lock_guard<std::mutex> l(channels_mu_);
+  auto it = hier_.find(psid);
+  return it == hier_.end() ? nullptr : &it->second;
+}
+
+Status SocketController::HierAllreduce(HierTopo& topo,
+                                       std::vector<Socket>& socks, void* buf,
+                                       int64_t count, DataType dtype,
+                                       ReduceOp op) {
+  const int ml = static_cast<int>(topo.local.size());
+  const int item = ItemSize(dtype);
+  const int64_t nbytes = count * item;
+  char* ringbuf = static_cast<char*>(buf);
+  if (ml > 1) {
+    // Phase 1: shm-local reduce into the region's result area.  Same
+    // layout and fences as ShmAllreduce (ml write slots + result), with
+    // the segment reduce split across local members.
+    ShmRegion& shm = *topo.shm;
+    auto grow_barrier = [&] {
+      return SockBarrier(socks, topo.local, topo.local_idx, kTagHierGrow);
+    };
+    Status st = shm.EnsureCapacity((ml + 1) * nbytes, topo.local_idx == 0,
+                                   grow_barrier);
+    if (!st.ok()) return st;
+    char* slots = shm.data();
+    char* result = slots + ml * nbytes;
+    std::memcpy(slots + topo.local_idx * nbytes, buf, nbytes);
+    st = SockBarrier(socks, topo.local, topo.local_idx, kTagHierWrite);
+    if (!st.ok()) return st;
+    const int64_t chunk = count / ml, rem = count % ml;
+    auto start = [&](int c) { return c * chunk + std::min<int64_t>(c, rem); };
+    const int64_t seg_off = start(topo.local_idx) * item;
+    const int64_t seg_len = start(topo.local_idx + 1) - start(topo.local_idx);
+    if (seg_len > 0) {
+      std::memcpy(result + seg_off, slots + seg_off, seg_len * item);
+      for (int j = 1; j < ml; ++j) {
+        ReduceInto(result + seg_off, slots + j * nbytes + seg_off, seg_len,
+                   dtype, op);
+      }
+    }
+    st = SockBarrier(socks, topo.local, topo.local_idx, kTagHierMid);
+    if (!st.ok()) return st;
+    // The leader runs the cross-host ring directly on the shm result area.
+    ringbuf = result;
+  }
+  // Phase 2: leader-only chunk-pipelined ring across hosts.  This is the
+  // whole win: each host moves ~2N over the wire instead of every rank's
+  // 2(np-1)/np*N.  Non-leaders skip straight to the fence.
+  if (topo.leader_idx >= 0) {
+    Status st = RingAllreduce(socks, ringbuf, count, dtype, op, topo.leaders,
+                              topo.leader_idx);
+    if (!st.ok()) return st;
+  }
+  if (ml > 1) {
+    // Phase 3: shm-local broadcast — wait for the leader's ring, then
+    // every local member copies the globally reduced result out.
+    Status st = SockBarrier(socks, topo.local, topo.local_idx, kTagHierDone);
+    if (!st.ok()) return st;
+    std::memcpy(buf, topo.shm->data() + ml * nbytes, nbytes);
+    // Trailing fence: the next op's slot writes must not land while a
+    // peer is still reading the result area.
+    return SockBarrier(socks, topo.local, topo.local_idx, kTagHierRead);
+  }
+  return Status::OK();
 }
 
 }  // namespace hvdtpu

@@ -101,12 +101,37 @@ class SocketController : public Controller {
     announce_cache_.store(v, std::memory_order_relaxed);
   }
 
+  // Hierarchical allreduce knob (HOROVOD_HIERARCHICAL_ALLREDUCE / the
+  // autotuner's second categorical).  Only the COORDINATOR's value feeds
+  // the per-response hier bit, so per-rank divergence (autotune runs on
+  // every rank) cannot split the plane.
+  void SetHierarchical(bool v) {
+    hierarchical_.store(v, std::memory_order_relaxed);
+  }
+  // True when the global process set can run the hierarchical composition
+  // (>=2 hosts, >=1 host with co-located ranks, per-host shm agreed up).
+  // core_api uses this to decide whether the autotuner should explore the
+  // hierarchical coordinate at all.
+  bool HierAvailable() { return HierFor(0) != nullptr; }
+
+  // Data-plane payload bytes sent, split by whether the destination rank
+  // lives on this host (the hierarchical win is the xhost line dropping
+  // to ~2N per host).
+  void DataPlaneStats(int64_t* local, int64_t* xhost) const {
+    *local = data_sent_local_.load(std::memory_order_relaxed);
+    *xhost = data_sent_xhost_.load(std::memory_order_relaxed);
+  }
+
  private:
   // Negotiation ctrl-channel payload byte counters (background thread
   // writes, Python reads — relaxed atomics suffice for monotone counters).
   std::atomic<int64_t> ctrl_sent_{0};
   std::atomic<int64_t> ctrl_recv_{0};
+  // Data-plane payload byte counters keyed by destination host locality.
+  std::atomic<int64_t> data_sent_local_{0};
+  std::atomic<int64_t> data_sent_xhost_{0};
   std::atomic<bool> announce_cache_{true};
+  std::atomic<bool> hierarchical_{false};
   struct Pending {
     TensorRequest meta;
     std::set<int> announced;
@@ -197,6 +222,35 @@ class SocketController : public Controller {
                      const std::vector<int64_t>& splits, int64_t row_bytes,
                      std::string* out, std::vector<int64_t>* recv_splits);
 
+  // -- hierarchical allreduce (shm-local reduce -> leader ring -> shm
+  //    broadcast; see docs/hierarchical.md) ----------------------------------
+  // Per-process-set hierarchical topology, derived from the agreed host
+  // keys at Initialize/EstablishChannel time.  `ok` is a whole-set agreed
+  // verdict (like the shm plane's): either every member holds a working
+  // topology or nobody uses it.
+  struct HierTopo {
+    std::vector<int> local;    // my host's members (sorted global ranks)
+    int local_idx = -1;        // my index in `local`
+    std::vector<int> leaders;  // per-host leader ranks (ascending)
+    int leader_idx = -1;       // my index in `leaders`, -1 if non-leader
+    std::unique_ptr<ShmRegion> shm;  // host subgroup region (null if alone)
+  };
+  // The rank's agreed per-rank host identity (index i = rank i).  Filled
+  // from the rendezvous book so every rank sees the same grouping — the
+  // coordinator's mesh_addrs_ view differs from workers' and cannot be
+  // used for this.
+  static std::string HostKey(int rank, int size);
+  // Build (or agree to skip) the hierarchical topology for a set.  Always
+  // runs a whole-set handshake when the topology LOOKS applicable so a
+  // per-rank failure (shm open, HOROVOD_SHM_DISABLE on one worker) demotes
+  // every member together.
+  Status MaybeSetupHier(int psid, const std::vector<int>& members);
+  HierTopo* HierFor(int psid);
+  Status HierAllreduce(HierTopo& topo, std::vector<Socket>& socks, void* buf,
+                       int64_t count, DataType dtype, ReduceOp op);
+  // Record bytes pushed to rank `to` on the data plane (local vs x-host).
+  void CountSend(int to, int64_t nbytes);
+
   // -- wiring ---------------------------------------------------------------
   bool is_coordinator() const { return cfg_.rank == 0; }
 
@@ -217,6 +271,17 @@ class SocketController : public Controller {
   // mesh address book from Initialize, kept for later channel dials
   std::vector<std::string> mesh_addrs_;
   std::vector<int> mesh_ports_;
+  // agreed per-rank host keys (rendezvous book, protocol v5): the ONLY
+  // valid locality signal — mesh_addrs_[0] differs between coordinator
+  // ("") and workers (the rendezvous address), so address-based host
+  // grouping would diverge across ranks.
+  std::vector<std::string> host_keys_;
+  // psid -> hierarchical topology (only sets where it is applicable+agreed)
+  std::map<int, HierTopo> hier_;
+  // seq -> run-hierarchically, recorded from each cycle's hier bits and
+  // consumed by AllreduceBuffer (lanes are concurrent -> mutex).
+  std::map<int64_t, bool> hier_by_seq_;
+  std::mutex hier_mu_;
   // psid -> per-set socket mesh (indexed by GLOBAL rank, like peer_socks_)
   std::map<int, std::vector<Socket>> channel_socks_;
   // psid -> shared-memory region (same-host member sets only)

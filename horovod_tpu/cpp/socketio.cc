@@ -73,6 +73,7 @@ void SerializeResponse(const Response& r, Writer* w) {
   w->PutI32(r.process_set_id);
   w->PutString(r.error);
   w->PutU8(r.cache_hit ? 1 : 0);
+  w->PutU8(r.hier ? 1 : 0);
   w->PutI64(r.seq);
   w->PutI32(r.last_joined);
   w->PutI32(r.target_rank);
@@ -87,6 +88,7 @@ Response DeserializeResponse(Reader* r) {
   resp.process_set_id = r->GetI32();
   resp.error = r->GetString();
   resp.cache_hit = r->GetU8() != 0;
+  resp.hier = r->GetU8() != 0;
   resp.seq = r->GetI64();
   resp.last_joined = r->GetI32();
   resp.target_rank = r->GetI32();

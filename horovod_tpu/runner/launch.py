@@ -65,6 +65,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--timeline-mark-cycles", action="store_true")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true",
+                   help="compose shm-local reduce + leader-only cross-host "
+                        "ring + shm-local broadcast when hosts hold "
+                        "co-located ranks (HOROVOD_HIERARCHICAL_ALLREDUCE)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=None)
@@ -155,6 +159,8 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.hierarchical_allreduce:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.stall_check_disable:
         env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
     if args.stall_check_warning_time_seconds is not None:

@@ -193,6 +193,11 @@ class CoreBackend:
         backends without a socket control plane)."""
         return {"ctrl_sent": 0, "ctrl_recv": 0}
 
+    def data_plane_stats(self) -> dict:
+        """Cumulative host-data-plane bytes sent, split by locality (zero
+        for backends without a socket data plane)."""
+        return {"data_sent_local": 0, "data_sent_xhost": 0}
+
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError
 

@@ -29,6 +29,33 @@ from ..context import HorovodContext
 from ..process_sets import ProcessSet, _resolve_psid
 from ..wire import OpType, ReduceOp
 
+
+def _torch_version_tuple() -> Tuple[int, int]:
+    # "2.3.1+cpu" / "2.1.0a0+git..." -> (2, 3); unparseable -> assume new
+    # enough rather than refusing a working nightly.
+    parts = torch.__version__.split("+")[0].split(".")
+    try:
+        return int(parts[0]), int("".join(
+            c for c in parts[1] if c.isdigit()) or 0)
+    except (IndexError, ValueError):  # pragma: no cover - exotic builds
+        return (999, 0)
+
+
+_TORCH_VERSION = _torch_version_tuple()
+
+# Hard floor: the optimizer binding is built on
+# register_post_accumulate_grad_hook (torch >= 2.1).  Fail at import with
+# the real reason instead of an AttributeError deep inside a training step.
+if _TORCH_VERSION < (2, 1):
+    raise ImportError(
+        f"horovod_tpu.torch requires torch >= 2.1 "
+        f"(register_post_accumulate_grad_hook); found {torch.__version__}")
+
+# Soft floor: the zero-copy bf16 bridge bit-reinterprets through
+# torch.uint16, which exists from torch 2.3.  Older torch falls back to a
+# lossy float32 round-trip, same as the no-ml_dtypes path.
+_BF16_VIEW_OK = _TORCH_VERSION >= (2, 3) and hasattr(torch, "uint16")
+
 try:
     import ml_dtypes
 
@@ -48,7 +75,7 @@ def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
     if not t.is_contiguous():
         t = t.contiguous()
     if t.dtype == torch.bfloat16:
-        if _BF16 is None:
+        if _BF16 is None or not _BF16_VIEW_OK:
             return t.float().numpy()
         return t.view(torch.uint16).numpy().view(_BF16)
     return t.numpy()
@@ -57,6 +84,9 @@ def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
 def _from_numpy(arr: np.ndarray) -> torch.Tensor:
     arr = np.ascontiguousarray(arr)
     if _BF16 is not None and arr.dtype == _BF16:
+        if not _BF16_VIEW_OK:
+            return torch.from_numpy(
+                arr.astype(np.float32)).to(torch.bfloat16)
         return torch.from_numpy(arr.view(np.uint16).copy()).view(
             torch.bfloat16)
     return torch.from_numpy(arr.copy())
@@ -525,6 +555,16 @@ def sparse_allreduce(tensor: torch.Tensor, name: Optional[str] = None,
     divides by the process-set size like the dense op."""
     return sparse_synchronize(sparse_allreduce_async(
         tensor, name=name, op=op, process_set=process_set))
+
+
+def forget(handle: int) -> None:
+    """Drop the torch-side bookkeeping for ``handle`` WITHOUT waiting on
+    the core: releases the table entry's strong tensor reference and its
+    in-place write-back.  For error-path sweeps where the core op already
+    failed (or will be failed by shutdown) and ``synchronize`` will never
+    run — unlike :func:`retire`, this never blocks.  Unknown handles are a
+    no-op."""
+    _handles.pop(handle)
 
 
 def retire(handle: int) -> None:

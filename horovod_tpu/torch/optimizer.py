@@ -337,9 +337,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             for _, (h, ctx, *_rest) in entries:
                 if h == "sparse":
                     for hh in ctx[:2]:
-                        mpi_ops._handles.pop(hh)
+                        mpi_ops.forget(hh)
                 else:
-                    mpi_ops._handles.pop(h)
+                    mpi_ops.forget(h)
             raise
         finally:
             self._handles.clear()

@@ -90,6 +90,10 @@ class Config:
     cache_enabled: bool = True
     autotune: bool = False
     autotune_log: Optional[str] = None
+    # HOROVOD_HIERARCHICAL_ALLREDUCE: shm-local reduce -> leader-only
+    # cross-host ring -> shm-local broadcast for process sets spanning
+    # hosts with co-located ranks.  Off by default (flat ring).
+    hierarchical_allreduce: bool = False
 
     # Observability.
     timeline_path: Optional[str] = None
@@ -142,6 +146,9 @@ class Config:
             cache_enabled=get_int("HOROVOD_CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY) > 0,
             autotune=get_bool("HOROVOD_AUTOTUNE", False),
             autotune_log=env.get("HOROVOD_AUTOTUNE_LOG"),
+            hierarchical_allreduce=get_bool(
+                "HOROVOD_HIERARCHICAL_ALLREDUCE", False
+            ),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),

@@ -57,6 +57,24 @@ def _best_of(n, env=None, expect_shm_disabled=True, worker=None):
     return best
 
 
+def _assert_faster(slow_env, fast_env, margin, worker=None, n=2, label="",
+                   attempts=3):
+    # Load-detect retry: a background-load burst on the shared core can
+    # invert any single comparison no matter how generous the margin.  When
+    # a round fails, re-measure from scratch (both sides, so a transient
+    # that slowed the FAST side doesn't survive either) before declaring a
+    # perf regression; only the final round asserts.
+    slow_ms = fast_ms = 0.0
+    for _ in range(attempts):
+        slow_ms = _best_of(n, env=slow_env, worker=worker)
+        fast_ms = _best_of(n, env=fast_env, worker=worker)
+        if slow_ms > margin * fast_ms:
+            return
+    assert slow_ms > margin * fast_ms, (
+        f"{label} not faster after {attempts} rounds: "
+        f"slow={slow_ms:.1f}ms fast={fast_ms:.1f}ms (margin {margin}x)")
+
+
 def test_shm_plane_beats_tcp_ring():
     shm = run(_plane_worker, np=4)
     shm_ms = max(res["ms"] for res in shm)
@@ -72,13 +90,13 @@ def test_shm_plane_beats_tcp_ring():
 def test_pipelined_ring_beats_whole_segment_ring():
     # VERDICT r3 #5: the chunk-pipelined ring (default) must beat the
     # legacy whole-segment ring on the same TCP path.  Measured ~1.5-1.8x;
-    # min-of-2 runs + 1.15x margin absorb scheduler noise.
-    legacy_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1",
-                                 "HOROVOD_RING_CHUNK_BYTES": "0"})
-    piped_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1"})
-    assert legacy_ms > 1.15 * piped_ms, (
-        f"pipelined ring not faster: legacy={legacy_ms:.1f}ms "
-        f"pipelined={piped_ms:.1f}ms")
+    # min-of-3 runs + a 1.10x margin + load-detect retry absorb scheduler
+    # noise (the old min-of-2/1.15x gate still flaked under CI load).
+    _assert_faster(
+        slow_env={"HOROVOD_SHM_DISABLE": "1",
+                  "HOROVOD_RING_CHUNK_BYTES": "0"},
+        fast_env={"HOROVOD_SHM_DISABLE": "1"},
+        margin=1.10, n=3, label="pipelined ring")
 
 
 def _bcast_worker():
@@ -113,14 +131,11 @@ def test_chain_broadcast_beats_binomial_tree():
     # Large broadcasts (the broadcast_parameters case) take the pipelined
     # chain: every member sends N once vs the tree root's N*log2(m)
     # egress.  Measured ~2.0x at 32 MiB np=4; 1.3x margin for noise.
-    tree_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1",
-                               "HOROVOD_RING_CHUNK_BYTES": "0"},
-                       worker=_bcast_worker)
-    chain_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1"},
-                        worker=_bcast_worker)
-    assert tree_ms > 1.3 * chain_ms, (
-        f"chain broadcast not faster: tree={tree_ms:.0f}ms "
-        f"chain={chain_ms:.0f}ms")
+    _assert_faster(
+        slow_env={"HOROVOD_SHM_DISABLE": "1",
+                  "HOROVOD_RING_CHUNK_BYTES": "0"},
+        fast_env={"HOROVOD_SHM_DISABLE": "1"},
+        margin=1.3, worker=_bcast_worker, label="chain broadcast")
 
 
 def _allgather_worker():
@@ -159,14 +174,11 @@ def test_pipelined_allgather_beats_whole_block_ring():
     # Pipelined allgather (size ring + chunked hops straight into the
     # output concat) vs legacy whole-block string frames.  Measured
     # ~1.55-1.75x at 8 MiB/rank np=4; 1.2x margin for noise.
-    legacy_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1",
-                                 "HOROVOD_RING_CHUNK_BYTES": "0"},
-                         worker=_allgather_worker)
-    piped_ms = _best_of(2, env={"HOROVOD_SHM_DISABLE": "1"},
-                        worker=_allgather_worker)
-    assert legacy_ms > 1.2 * piped_ms, (
-        f"pipelined allgather not faster: legacy={legacy_ms:.0f}ms "
-        f"pipelined={piped_ms:.0f}ms")
+    _assert_faster(
+        slow_env={"HOROVOD_SHM_DISABLE": "1",
+                  "HOROVOD_RING_CHUNK_BYTES": "0"},
+        fast_env={"HOROVOD_SHM_DISABLE": "1"},
+        margin=1.2, worker=_allgather_worker, label="pipelined allgather")
 
 
 def _shm_correctness_worker():

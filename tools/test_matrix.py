@@ -13,7 +13,9 @@ combination of:
 - cache:   default capacity / disabled (HOROVOD_CACHE_CAPACITY=0)
 - plane:   shared-memory / pipelined TCP ring (HOROVOD_SHM_DISABLE=1) /
            legacy whole-segment TCP ring (+HOROVOD_RING_CHUNK_BYTES=0),
-           np>1 only
+           np>1 only / hierarchical (HOROVOD_HIERARCHICAL_ALLREDUCE=1 over
+           two fake hosts via HOROVOD_HIER_FAKE_HOSTS=2), np>=3 only —
+           smaller np degenerates to one rank per fake host
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -160,12 +162,13 @@ def combos(quick: bool):
     nps = [1, 2, 3]
     fusion = ["on", "off"]
     cache = ["on", "off"]
-    planes = ["shm", "tcp", "tcp0"]
+    planes = ["shm", "tcp", "tcp0", "hier"]
     if quick:
         # One covering set instead of the full product.
         yield ("jax", "native", 3, "on", "on", "shm")
         yield ("jax", "native", 2, "off", "off", "tcp")
         yield ("jax", "native", 3, "on", "off", "tcp0")
+        yield ("jax", "native", 3, "on", "on", "hier")
         yield ("jax", "native", 1, "on", "off", "shm")
         yield ("jax", "purepy", 1, "off", "on", "shm")
         yield ("torch", "native", 2, "on", "on", "shm")
@@ -178,6 +181,8 @@ def combos(quick: bool):
             continue  # pure-python core is single-process by contract
         if np_ == 1 and p != "shm":
             continue  # no data plane at np=1; plane axis is meaningless
+        if p == "hier" and np_ < 3:
+            continue  # 2 ranks / 2 fake hosts has no multi-rank host
         yield ("jax", core, np_, f, c, p)
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
@@ -186,6 +191,7 @@ def combos(quick: bool):
     yield ("torch", "native", 2, "on", "off", "tcp0")
     yield ("torch", "native", 3, "on", "on", "tcp")
     yield ("torch", "native", 3, "off", "on", "shm")
+    yield ("torch", "native", 3, "on", "on", "hier")
     yield ("torch", "native", 1, "on", "on", "shm")
     yield ("torch", "purepy", 1, "on", "on", "shm")
 
@@ -197,6 +203,8 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # The plane axis must own this knob: an ambient setting would
     # silently collapse the pipelined-vs-legacy distinction.
     env.pop("HOROVOD_RING_CHUNK_BYTES", None)
+    env.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
+    env.pop("HOROVOD_HIER_FAKE_HOSTS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -209,6 +217,11 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_SHM_DISABLE"] = "1"
     if plane == "tcp0":
         env["HOROVOD_RING_CHUNK_BYTES"] = "0"  # legacy whole-segment frames
+    if plane == "hier":
+        # Two fake hosts carved out of the rank space: block partition, so
+        # np=3 gives hosts {0,1} + {2} — the smallest hierarchical topology.
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+        env["HOROVOD_HIER_FAKE_HOSTS"] = "2"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:

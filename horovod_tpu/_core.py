@@ -64,7 +64,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_int, c.c_int, c.c_int, c.c_int,        # rank size local_rank local_size
         c.c_char_p, c.c_char_p, c.c_int,           # controller addr port
         c.c_double, c.c_longlong, c.c_int, c.c_int,  # cycle fusion cache autotune
-        c.c_char_p, c.c_int,                       # autotune_log hierarchical
+        c.c_char_p, c.c_int, c.c_int,              # autotune_log hierarchical wire_comp
         c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
     ]
@@ -114,6 +114,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.hvd_data_plane_stats.argtypes = [
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+    lib.hvd_data_plane_stats2.argtypes = [
+        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+        c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.hvd_start_timeline.argtypes = [c.c_char_p, c.c_int]
     lib.hvd_stop_timeline.argtypes = []
     lib.hvd_last_error.restype = c.c_char_p
@@ -156,6 +159,7 @@ class NativeCore(CoreBackend):
             1 if cfg.autotune else 0,
             (cfg.autotune_log or "").encode(),
             1 if cfg.hierarchical_allreduce else 0,
+            {"none": 0, "bf16": 1, "int8": 2}.get(cfg.wire_compression, 0),
             (cfg.timeline_path or "").encode(),
             1 if cfg.timeline_mark_cycles else 0,
             cfg.stall_warning_s if cfg.stall_check_enabled else 0.0,
@@ -374,12 +378,20 @@ class NativeCore(CoreBackend):
     def data_plane_stats(self) -> dict:
         """Cumulative host-data-plane bytes sent by this rank, split by
         locality: to ranks on this host vs. across hosts.  The hierarchical
-        allreduce's measurable effect is a shrinking cross-host share."""
+        allreduce's measurable effect is a shrinking cross-host share; wire
+        compression's is wire bytes dropping below the raw (pre-codec)
+        bytes, which the data_raw_* counters track."""
         local = ctypes.c_longlong()
         xhost = ctypes.c_longlong()
-        self._lib.hvd_data_plane_stats(ctypes.byref(local),
-                                       ctypes.byref(xhost))
-        return {"data_sent_local": local.value, "data_sent_xhost": xhost.value}
+        raw_local = ctypes.c_longlong()
+        raw_xhost = ctypes.c_longlong()
+        self._lib.hvd_data_plane_stats2(
+            ctypes.byref(local), ctypes.byref(xhost),
+            ctypes.byref(raw_local), ctypes.byref(raw_xhost))
+        return {"data_sent_local": local.value,
+                "data_sent_xhost": xhost.value,
+                "data_raw_local": raw_local.value,
+                "data_raw_xhost": raw_xhost.value}
 
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         self._lib.hvd_start_timeline(path.encode(), 1 if mark_cycles else 0)

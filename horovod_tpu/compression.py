@@ -14,6 +14,12 @@ import numpy as np
 
 def _astype(tensor, dtype):
     if isinstance(tensor, np.ndarray):
+        if dtype == "bfloat16":
+            # numpy has no native bfloat16; ml_dtypes (a jax dependency)
+            # registers one.
+            import ml_dtypes
+
+            return tensor.astype(ml_dtypes.bfloat16)
         return tensor.astype(dtype)
     import jax.numpy as jnp
 
@@ -49,9 +55,15 @@ class _CastCompressor(Compressor):
     @classmethod
     def compress(cls, tensor):
         dtype = getattr(tensor, "dtype", None)
-        if dtype is not None and str(dtype) in ("float32", "float64"):
-            return _astype(tensor, cls.wire_dtype), dtype
-        return tensor, None
+        if dtype is None or str(dtype) not in ("float32", "float64"):
+            return tensor, None
+        wire = cls.wire_dtype
+        if str(dtype) == "float64" and wire == "float16":
+            # float16's 5-bit exponent silently overflows float64's range
+            # (anything past 65504 becomes inf); bfloat16 keeps the fp32
+            # exponent so only precision, not magnitude, is traded.
+            wire = "bfloat16"
+        return _astype(tensor, wire), dtype
 
     @classmethod
     def decompress(cls, tensor, ctx):

@@ -13,8 +13,10 @@
 
 #include <sys/socket.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <cstring>
 #include <random>
@@ -22,6 +24,7 @@
 #include <vector>
 
 #include "socketio.h"
+#include "wire_codec.h"
 
 namespace hvdtpu {
 int GetLogLevel() { return 4; }  // errors only
@@ -175,12 +178,155 @@ void Cancellation() {
   }
 }
 
+// ---- wire_codec.h: the codec layer the compressed ring rides ------------
+
+// bf16 truncation is exact for values already representable in bf16
+// (mantissa fits in 7 bits): round-tripping them must be bit-identical.
+void CodecBf16RoundTrip() {
+  const float vals[] = {0.0f,     -0.0f, 1.0f,      -1.0f,   0.5f,
+                        2.0f,     -2.5f, 1024.0f,   -0.125f, 3.140625f,
+                        65536.0f, 0x1p100f, -0x1p-100f, 0.0078125f};
+  const int64_t n = sizeof(vals) / sizeof(vals[0]);
+  std::vector<char> enc(
+      static_cast<size_t>(WireEncodedBytes(WireCodec::kBf16, n)));
+  std::vector<float> dec(static_cast<size_t>(n));
+  WireEncode(WireCodec::kBf16, vals, n, enc.data());
+  WireDecodeRange(WireCodec::kBf16, enc.data(), n, 0, n, dec.data());
+  for (int64_t i = 0; i < n; ++i) {
+    if (std::memcmp(&dec[i], &vals[i], 4) != 0) {
+      Fail("bf16 round-trip not exact for representable value", -3);
+      return;
+    }
+  }
+  // Non-representable values still land within one bf16 ulp (truncation:
+  // error < 2^-7 relative).
+  const float odd[] = {3.14159265f, 1.0001f, -123.456f, 7.7777e-5f};
+  const int64_t m = sizeof(odd) / sizeof(odd[0]);
+  WireEncode(WireCodec::kBf16, odd, m, enc.data());
+  WireDecodeRange(WireCodec::kBf16, enc.data(), m, 0, m, dec.data());
+  for (int64_t i = 0; i < m; ++i) {
+    if (std::fabs(dec[i] - odd[i]) > std::fabs(odd[i]) * (1.0f / 128.0f)) {
+      Fail("bf16 truncation error exceeds one ulp bound", -3);
+      return;
+    }
+  }
+}
+
+// int8 block scaling: |decode(encode(x)) - x| <= scale/2 per element,
+// where scale = blockmax/127; partial last blocks and random-access
+// decode (block-unaligned ranges) must agree with a full decode.
+void CodecInt8ErrorBound() {
+  std::mt19937 rng(0xBEEF);
+  std::uniform_real_distribution<float> mag(-50.f, 50.f);
+  // 3 full blocks + a partial one, plus an all-zero block in the middle.
+  const int64_t n = 3 * kWireBlock + 77;
+  std::vector<float> src(static_cast<size_t>(n));
+  for (auto& v : src) v = mag(rng);
+  for (int64_t i = kWireBlock; i < 2 * kWireBlock; ++i) src[i] = 0.0f;
+  std::vector<char> enc(
+      static_cast<size_t>(WireEncodedBytes(WireCodec::kInt8, n)));
+  WireEncode(WireCodec::kInt8, src.data(), n, enc.data());
+  std::vector<float> dec(static_cast<size_t>(n));
+  WireDecodeRange(WireCodec::kInt8, enc.data(), n, 0, n, dec.data());
+  for (int64_t b0 = 0; b0 < n; b0 += kWireBlock) {
+    const int64_t bn = std::min(kWireBlock, n - b0);
+    float maxabs = 0.f;
+    for (int64_t i = 0; i < bn; ++i) {
+      maxabs = std::max(maxabs, std::fabs(src[b0 + i]));
+    }
+    const float scale = maxabs / 127.0f;
+    for (int64_t i = 0; i < bn; ++i) {
+      if (std::fabs(dec[b0 + i] - src[b0 + i]) > scale * 0.5f + 1e-12f) {
+        Fail("int8 block-scale error exceeds scale/2", -4);
+        return;
+      }
+    }
+  }
+  // Incremental decode (the ring's consume path): byte-level prefixes +
+  // block-unaligned ranges must reproduce the full decode exactly.
+  int64_t decoded = 0;
+  std::vector<float> inc(static_cast<size_t>(n));
+  for (int64_t bytes = 0; bytes <= WireEncodedBytes(WireCodec::kInt8, n);
+       bytes += 97) {
+    const int64_t avail = WireDecodableElems(WireCodec::kInt8, bytes, n);
+    if (avail < decoded) {
+      Fail("WireDecodableElems not monotone", -4);
+      return;
+    }
+    if (avail > decoded) {
+      WireDecodeRange(WireCodec::kInt8, enc.data(), n, decoded, avail,
+                      inc.data() + decoded);
+      decoded = avail;
+    }
+  }
+  const int64_t tail = WireDecodableElems(
+      WireCodec::kInt8, WireEncodedBytes(WireCodec::kInt8, n), n);
+  if (tail > decoded) {
+    WireDecodeRange(WireCodec::kInt8, enc.data(), n, decoded, tail,
+                    inc.data() + decoded);
+    decoded = tail;
+  }
+  if (decoded != n ||
+      std::memcmp(inc.data(), dec.data(), static_cast<size_t>(4 * n)) != 0) {
+    Fail("incremental int8 decode diverges from full decode", -4);
+  }
+}
+
+// fp32 ring accumulation: simulating the reduce-scatter phase (each hop
+// contributes decode(encode(x_i)) into an fp32 accumulator), the total
+// error stays within hops x the single-quantization bound — the property
+// that makes the compressed ring's error linear in ring size instead of
+// compounding (re-quantizing partial sums would square it away).
+void CodecRingAccumulationBound() {
+  std::mt19937 rng(0x5EED);
+  std::uniform_real_distribution<float> mag(-10.f, 10.f);
+  const int hops = 7;  // ring of 8: 7 reduce-scatter contributions
+  const int64_t n = 2 * kWireBlock + 33;
+  std::vector<double> exact(static_cast<size_t>(n), 0.0);
+  std::vector<float> acc(static_cast<size_t>(n), 0.0f);
+  std::vector<double> bound(static_cast<size_t>(n), 0.0);
+  std::vector<char> enc(
+      static_cast<size_t>(WireEncodedBytes(WireCodec::kInt8, n)));
+  std::vector<float> dec(static_cast<size_t>(n));
+  for (int h = 0; h < hops; ++h) {
+    std::vector<float> x(static_cast<size_t>(n));
+    for (auto& v : x) v = mag(rng);
+    WireEncode(WireCodec::kInt8, x.data(), n, enc.data());
+    WireDecodeRange(WireCodec::kInt8, enc.data(), n, 0, n, dec.data());
+    for (int64_t i = 0; i < n; ++i) {
+      exact[i] += x[i];
+      acc[i] += dec[i];  // fp32 accumulate of the decoded contribution
+    }
+    for (int64_t b0 = 0; b0 < n; b0 += kWireBlock) {
+      const int64_t bn = std::min(kWireBlock, n - b0);
+      float maxabs = 0.f;
+      for (int64_t i = 0; i < bn; ++i) {
+        maxabs = std::max(maxabs, std::fabs(x[b0 + i]));
+      }
+      for (int64_t i = 0; i < bn; ++i) {
+        bound[b0 + i] += maxabs / 127.0 * 0.5;  // scale/2 per hop
+      }
+    }
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    // Tiny slack for the fp32 summation itself (7 adds of ~10-magnitude
+    // values: machine-epsilon territory next to the quantization bound).
+    if (std::fabs(acc[i] - exact[i]) > bound[i] + 1e-4) {
+      Fail("ring accumulation error exceeds hops x scale/2", -5);
+      return;
+    }
+  }
+}
+
 }  // namespace
 
 int main() {
   FuzzRounds();
   HeaderMismatch();
   Cancellation();
+  CodecBf16RoundTrip();
+  CodecInt8ErrorBound();
+  CodecRingAccumulationBound();
   if (failures.load() != 0) {
     std::fprintf(stderr, "%d failure(s)\n", failures.load());
     return 1;

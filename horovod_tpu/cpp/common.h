@@ -136,6 +136,13 @@ struct Response {
   // serialized response so the choice can never diverge across ranks —
   // a split plane would deadlock the data plane.
   bool hier = false;
+  // Coordinator-decided wire codec for the cross-host ring hops of this
+  // response (0=none, 1=bf16, 2=int8 — hvd::WireCodec).  Rides the
+  // serialized response for the same reason as `hier`: a codec split
+  // across ranks would be a framing mismatch on the data plane.  Demoted
+  // to 0 for non-fp32 dtypes, device-plane ops, sub-floor payloads, and
+  // topologies where any ring hop stays on-host (docs/compression.md).
+  int32_t wire_comp = 0;
   int32_t last_joined = -1;  // JOIN responses: the last rank to join
   // When >= 0, only this rank acts on the response (tombstone error
   // deliveries: the name may have been consistently resubmitted by other
@@ -163,6 +170,9 @@ struct CoreConfig {
   // co-located ranks.  Only the coordinator's value matters (the decision
   // rides in each response), so per-rank divergence is harmless.
   bool hierarchical = false;
+  // HOROVOD_WIRE_COMPRESSION: codec for cross-host ring hops (0=none,
+  // 1=bf16, 2=int8).  Coordinator-authoritative like `hierarchical`.
+  int wire_compression = 0;
   std::string timeline_path;
   bool timeline_mark_cycles = false;
   double stall_warn_s = 60.0;

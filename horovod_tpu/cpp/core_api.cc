@@ -260,13 +260,16 @@ void BackgroundLoop() {
       auto* sc = dynamic_cast<SocketController*>(g->controller.get());
       if (sc) {
         sc->SetAnnounceCache(g->params.announce_cache());
-        // Coordinator-only knob: the hierarchical decision rides in each
-        // serialized response, so applying it on every rank is harmless.
+        // Coordinator-only knobs: the hierarchical/wire-codec decisions
+        // ride in each serialized response, so applying them on every
+        // rank is harmless.
         sc->SetHierarchical(g->params.hierarchical());
+        sc->SetWireCompression(g->params.wire_compression());
       }
       HVD_LOG(DEBUG) << "autotune: fusion=" << fusion << " cycle_ms=" << cycle
                      << " announce_cache=" << g->params.announce_cache()
-                     << " hierarchical=" << g->params.hierarchical();
+                     << " hierarchical=" << g->params.hierarchical()
+                     << " wire_compression=" << g->params.wire_compression();
     }
 
     double now = MonotonicSeconds();
@@ -323,7 +326,7 @@ extern "C" {
 int hvd_init(int rank, int size, int local_rank, int local_size,
              const char* controller, const char* addr, int port,
              double cycle_ms, long long fusion, int cache_cap, int autotune,
-             const char* autotune_log, int hierarchical,
+             const char* autotune_log, int hierarchical, int wire_compression,
              const char* timeline_path, int timeline_mark_cycles,
              double stall_warn_s, double stall_shutdown_s, int log_level) {
   if (g != nullptr) return -1;
@@ -342,6 +345,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.autotune = autotune != 0;
   cfg.autotune_log = autotune_log ? autotune_log : "";
   cfg.hierarchical = hierarchical != 0;
+  cfg.wire_compression =
+      wire_compression >= 0 && wire_compression <= 2 ? wire_compression : 0;
   cfg.timeline_path = timeline_path ? timeline_path : "";
   cfg.timeline_mark_cycles = timeline_mark_cycles != 0;
   cfg.stall_warn_s = stall_warn_s;
@@ -372,8 +377,13 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // otherwise it is pinned off so the GP never explores a dead arm.
     auto* sc = dynamic_cast<SocketController*>(g->controller.get());
     bool hier_tunable = sc != nullptr && sc->HierAvailable();
+    // Same pinning rule for the wire codec: tunable only when some ring
+    // hop actually crosses hosts (the leader ring, or an all-cross-host
+    // flat ring).
+    bool wire_tunable = sc != nullptr && sc->WireCompAvailable();
     g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log,
-                         cfg.hierarchical, hier_tunable);
+                         cfg.hierarchical, hier_tunable,
+                         cfg.wire_compression, wire_tunable);
   }
   g->background = std::thread(BackgroundLoop);
   return 0;
@@ -618,10 +628,28 @@ void hvd_data_plane_stats(long long* local, long long* xhost) {
   if (g == nullptr) return;
   auto* sc = dynamic_cast<SocketController*>(g->controller.get());
   if (sc == nullptr) return;
-  int64_t l = 0, x = 0;
-  sc->DataPlaneStats(&l, &x);
+  int64_t l = 0, x = 0, rl = 0, rx = 0;
+  sc->DataPlaneStats(&l, &x, &rl, &rx);
   *local = l;
   *xhost = x;
+}
+
+// Extended form: `raw_*` are the fp32-equivalent payload bytes of the
+// same sends (wire == raw unless a compressed ring encoded them), so
+// raw/wire is the measured compression ratio.  The 2-arg export above
+// keeps its ABI for older callers.
+void hvd_data_plane_stats2(long long* local, long long* xhost,
+                           long long* raw_local, long long* raw_xhost) {
+  *local = *xhost = *raw_local = *raw_xhost = 0;
+  if (g == nullptr) return;
+  auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+  if (sc == nullptr) return;
+  int64_t l = 0, x = 0, rl = 0, rx = 0;
+  sc->DataPlaneStats(&l, &x, &rl, &rx);
+  *local = l;
+  *xhost = x;
+  *raw_local = rl;
+  *raw_xhost = rx;
 }
 
 void hvd_start_timeline(const char* path, int mark_cycles) {

@@ -19,34 +19,40 @@
 namespace hvdtpu {
 
 // Gaussian-process regression + Expected Improvement over two continuous
-// knobs on the unit square plus two BINARY knobs (reference:
+// knobs on the unit square plus three CATEGORICAL knobs (reference:
 // ParameterManager also tunes categorical flags like cache/hierarchical
-// allreduce — binary coordinates in the same GP are the cheap TPU-native
-// form; x2 = announce-cache, x3 = hierarchical allreduce).  Exposed for
-// the synthetic-surface self-test (autotune_selftest.cc).
+// allreduce — categorical coordinates in the same GP are the cheap
+// TPU-native form; x2 = announce-cache {0,1}, x3 = hierarchical allreduce
+// {0,1}, x4 = wire compression {0, 0.5, 1} for {none, bf16, int8}).
+// Exposed for the synthetic-surface self-test (autotune_selftest.cc).
 class BayesianOptimizer {
  public:
-  // Observations are (x in [0,1]^2, x2/x3 in {0,1}, score); scores are
-  // internally max-normalized so the kernel scales stay dimensionless.
-  void AddSample(double x0, double x1, double x2, double x3, double score);
-  // Next point to try: argmax EI over a jittered grid x {0,1}^2.  Falls
-  // back to latin-square-ish seed points for the first few calls.
-  void Suggest(double* x0, double* x1, double* x2, double* x3);
+  // Observations are (x in [0,1]^2, x2/x3 in {0,1}, x4 in {0,0.5,1},
+  // score); scores are internally max-normalized so the kernel scales
+  // stay dimensionless.
+  void AddSample(double x0, double x1, double x2, double x3, double x4,
+                 double score);
+  // Next point to try: argmax EI over a jittered grid x the categorical
+  // levels.  Falls back to latin-square-ish seed points for the first few
+  // calls.
+  void Suggest(double* x0, double* x1, double* x2, double* x3, double* x4);
   // Best observed sample.
-  void Best(double* x0, double* x1, double* x2, double* x3,
+  void Best(double* x0, double* x1, double* x2, double* x3, double* x4,
             double* score) const;
   int num_samples() const { return static_cast<int>(xs_.size()); }
   // When the x3 knob cannot take effect (topology not hierarchical), pin
   // it to 0 so the EI search does not waste half its grid on a dead arm.
   void set_tune_x3(bool v) { tune_x3_ = v; }
+  // Same pinning rule for x4 (wire compression: no all-cross-host ring).
+  void set_tune_x4(bool v) { tune_x4_ = v; }
 
  private:
   void FitGP();
-  void Predict(double x0, double x1, double x2, double x3, double* mean,
-               double* var) const;
+  void Predict(double x0, double x1, double x2, double x3, double x4,
+               double* mean, double* var) const;
 
   struct Pt {
-    double x0, x1, x2, x3;
+    double x0, x1, x2, x3, x4;
   };
   std::vector<Pt> xs_;
   std::vector<double> ys_;      // raw scores
@@ -55,6 +61,7 @@ class BayesianOptimizer {
   double y_max_ = 0;
   unsigned rng_ = 0x9e3779b9u;
   bool tune_x3_ = true;
+  bool tune_x4_ = true;
 };
 
 class ParameterManager {
@@ -62,10 +69,13 @@ class ParameterManager {
   // hierarchical: initial value of the hierarchical-allreduce knob.
   // hier_tunable: whether the data plane can act on it at all (a
   // hierarchical topology exists); when false the knob is pinned off and
-  // the GP never explores that arm.
+  // the GP never explores that arm.  wire_comp / wire_tunable: same pair
+  // for the wire-compression codec (0=none, 1=bf16, 2=int8), pinned when
+  // no all-cross-host ring exists.
   void Initialize(int64_t fusion_threshold, double cycle_time_ms,
                   const std::string& log_path, bool hierarchical = false,
-                  bool hier_tunable = false);
+                  bool hier_tunable = false, int wire_comp = 0,
+                  bool wire_tunable = false);
   ~ParameterManager();
 
   // Record bytes covered by emitted responses.
@@ -88,6 +98,10 @@ class ParameterManager {
   // the decision rides in each serialized response, so only the
   // coordinator's copy of this knob matters.
   bool hierarchical() const { return hier_use_; }
+  // Categorical knob: wire-compression codec for cross-host ring hops
+  // (0=none, 1=bf16, 2=int8 — hvdtpu::WireCodec).  Coordinator-only for
+  // the same reason as hierarchical().
+  int wire_compression() const { return wire_use_; }
 
  private:
   void Score(double score);
@@ -103,11 +117,14 @@ class ParameterManager {
   bool cache_use_ = true;
   bool hier_use_ = false;
   bool hier_tunable_ = false;
+  int wire_use_ = 0;
+  bool wire_tunable_ = false;
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
   bool best_cache_ = true;
   bool best_hier_ = false;
+  int best_wire_ = 0;
   int warmup_windows_ = 1;
   int windows_since_best_ = 0;
   bool converged_ = false;

@@ -21,8 +21,9 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-// v5: host key in the rendezvous HELLO/book + hier bit in responses
-constexpr int32_t kProtocolVersion = 5;
+// v6: wire_comp codec byte in responses (v5 added the host key in the
+// rendezvous HELLO/book + the hier bit in responses)
+constexpr int32_t kProtocolVersion = 6;
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -55,6 +56,12 @@ constexpr int32_t kTagHierRead = 0x10800;
 constexpr int32_t kTagHierGrow = 0x11000;
 constexpr int32_t kTagHierOpen = 0x11800;
 constexpr int32_t kTagHierVerdict = 0x12000;
+// Compressed-ring phases (wire_codec.h).  Distinct from the raw-ring tags
+// so a codec split across ranks — which the coordinator's wire_comp bit
+// makes impossible by construction — would still fail fast as a header
+// mismatch rather than decode garbage.
+constexpr int32_t kTagCompReduceScatter = 0x12800;
+constexpr int32_t kTagCompAllgather = 0x13000;
 
 // Broadcasts at least this large take the pipelined chain instead of the
 // binomial tree.  A protocol constant: the algorithm choice must agree on
@@ -79,6 +86,13 @@ SocketController::SocketController(const CoreConfig& cfg)
     if (end && *end == '\0' && v >= 0) {
       ring_chunk_bytes_ = std::min<long long>(v, 1LL << 30);
     }
+  }
+  // HOROVOD_WIRE_COMPRESSION_MIN_BYTES: payload floor below which the
+  // coordinator demotes the wire codec to none (default 64 KiB).
+  if (const char* env = ::getenv("HOROVOD_WIRE_COMPRESSION_MIN_BYTES")) {
+    char* end = nullptr;
+    long long v = std::strtoll(env, &end, 10);
+    if (end && *end == '\0' && v >= 0) wire_comp_floor_ = v;
   }
 }
 
@@ -233,6 +247,7 @@ Status SocketController::Initialize() {
   s = MaybeSetupHier(0, all_ranks);
   if (!s.ok()) return s;
   hierarchical_.store(cfg_.hierarchical, std::memory_order_relaxed);
+  wire_compression_.store(cfg_.wire_compression, std::memory_order_relaxed);
   initialized_ = true;
   return Status::OK();
 }
@@ -808,9 +823,10 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
       for (const auto& m : r.metas) cache_.Insert(m);
       if (r.seq >= 0) {
         seq_counter_ = r.seq + 1;
-        if (r.hier) {
+        if (r.hier || r.wire_comp != 0) {
           std::lock_guard<std::mutex> l(hier_mu_);
-          hier_by_seq_[r.seq] = true;
+          plane_by_seq_[r.seq] = {r.hier,
+                                  static_cast<WireCodec>(r.wire_comp)};
         }
       }
     }
@@ -820,6 +836,7 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
 
 void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {
   const bool hier_on = hierarchical_.load(std::memory_order_relaxed);
+  const int wire_on = wire_compression_.load(std::memory_order_relaxed);
   for (auto& r : *responses) {
     if (!r.error.empty()) continue;
     bool all_cached = true;
@@ -829,18 +846,44 @@ void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {
     }
     r.cache_hit = all_cached;
     r.seq = seq_counter_++;
-    // Hierarchical plane decision (coordinator only, carried in the
-    // response): host-plane allreduces on sets whose agreed topology
-    // qualifies.  The device bit follows ResponseToJson's AND — a single
-    // host-bound member demotes the whole response to the host plane.
-    if (hier_on && r.op == OpType::ALLREDUCE && !r.metas.empty()) {
+    // Plane decisions (coordinator only, carried in the response).  The
+    // device bit follows ResponseToJson's AND — a single host-bound
+    // member demotes the whole response to the host plane.
+    if (r.op == OpType::ALLREDUCE && !r.metas.empty()) {
       bool device = true;
-      for (const auto& m : r.metas) device = device && m.device != 0;
-      if (!device && HierFor(r.process_set_id) != nullptr) r.hier = true;
+      int64_t total_bytes = 0;
+      for (const auto& m : r.metas) {
+        device = device && m.device != 0;
+        total_bytes += m.nbytes;
+      }
+      // Hierarchical: host-plane allreduces on sets whose agreed topology
+      // qualifies.
+      if (hier_on && !device && HierFor(r.process_set_id) != nullptr) {
+        r.hier = true;
+      }
+      // Wire codec: demoted (left 0) for non-fp32 dtypes, device-plane
+      // ops, payloads under the floor, and topologies with any same-host
+      // ring hop — hierarchical compresses its leader ring (the shm-local
+      // planes stay raw), a flat ring only when every hop crosses hosts.
+      if (wire_on != 0 && !device && r.dtype == DataType::FLOAT32 &&
+          total_bytes >= wire_comp_floor_) {
+        bool applies;
+        if (r.hier) {
+          applies = true;  // only the cross-host leader ring compresses
+        } else {
+          // The agreed host keys predict the members' plane choice (shm
+          // only opens when all keys match), so this coordinator-side
+          // check is a pure function of the rendezvous book.
+          std::vector<int> members;
+          applies = process_sets_.Ranks(r.process_set_id, &members) &&
+                    members.size() >= 2 && RingAllCrossHost(members);
+        }
+        if (applies) r.wire_comp = wire_on;
+      }
     }
-    if (r.hier) {
+    if (r.hier || r.wire_comp != 0) {
       std::lock_guard<std::mutex> l(hier_mu_);
-      hier_by_seq_[r.seq] = true;
+      plane_by_seq_[r.seq] = {r.hier, static_cast<WireCodec>(r.wire_comp)};
     }
   }
 }
@@ -943,11 +986,14 @@ Status SocketController::ChunkedStep(
     std::vector<Socket>& socks, int send_to, const char* send_base,
     int64_t send_len, int recv_from, int64_t recv_len, char* recv_dest,
     int32_t tag, int64_t chunk_bytes,
-    const std::function<void(int64_t, const char*, int64_t)>& consume) {
+    const std::function<void(int64_t, const char*, int64_t)>& consume,
+    int64_t raw_len) {
   if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
   Writer w;
   PutFrameHeader(&w, current_seq_, tag);
-  CountSend(send_to, send_len + static_cast<int64_t>(w.data().size()));
+  const int64_t hdr = static_cast<int64_t>(w.data().size());
+  CountSend(send_to, send_len + hdr,
+            (raw_len < 0 ? send_len : raw_len) + hdr);
   ChunkExchangeError err;
   if (!ChunkedDuplexExchange(socks[send_to], send_base, send_len,
                              socks[recv_from], recv_len, chunk_bytes,
@@ -1108,6 +1154,120 @@ Status SocketController::RingAllreduce(std::vector<Socket>& socks, void* buf,
   return Status::OK();
 }
 
+bool SocketController::RingAllCrossHost(const std::vector<int>& members) const {
+  const int m = static_cast<int>(members.size());
+  if (m < 2 || host_keys_.empty()) return false;
+  for (int i = 0; i < m; ++i) {
+    if (host_keys_[members[i]] == host_keys_[members[(i + 1) % m]]) {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool SocketController::WireCompAvailable() {
+  if (HierFor(0) != nullptr) return true;  // leader ring is all-cross-host
+  if (ShmFor(0) != nullptr) return false;  // shm plane: no wire at all
+  std::vector<int> all(cfg_.size);
+  for (int i = 0; i < cfg_.size; ++i) all[i] = i;
+  return RingAllCrossHost(all);
+}
+
+Status SocketController::CompressedRingAllreduce(
+    std::vector<Socket>& socks, void* buf, int64_t count, ReduceOp op,
+    const std::vector<int>& members, int idx, WireCodec codec) {
+  const int m = static_cast<int>(members.size());
+  if (m == 1) return Status::OK();
+  if (codec == WireCodec::kNone) {
+    return RingAllreduce(socks, buf, count, DataType::FLOAT32, op, members,
+                         idx);
+  }
+  float* base = static_cast<float*>(buf);
+  const int64_t chunk = count / m, rem = count % m;
+  auto start = [&](int c) { return c * chunk + std::min<int64_t>(c, rem); };
+  auto len = [&](int c) { return start(c + 1) - start(c); };
+  const int next = members[(idx + 1) % m];
+  const int prev = members[(idx - 1 + m) % m];
+  // The compressed ring is always chunk-pipelined (the legacy
+  // whole-segment path predates it and stays raw); chunk boundaries are
+  // byte-level, the decode carry below handles partial int8 blocks.
+  const int64_t chunkb =
+      ring_chunk_bytes_ > 0 ? ring_chunk_bytes_ : (1 << 19);
+  const int64_t maxseg = chunk + (rem > 0 ? 1 : 0);
+  std::vector<char> enc_send(
+      static_cast<size_t>(WireEncodedBytes(codec, maxseg)));
+  std::vector<char> enc_recv(enc_send.size());
+  std::vector<float> stage(static_cast<size_t>(maxseg));
+
+  // Phase 1: reduce-scatter.  Every hop re-encodes the CURRENT fp32
+  // partial sums (one fresh quantization per hop) and the receiver
+  // decodes to fp32 before accumulating — so after m-1 hops each element
+  // carries at most (m-1) single-quantization errors, never an error of
+  // a quantized partial sum re-quantized.
+  for (int s = 0; s < m - 1; ++s) {
+    const int send_c = ((idx - s) % m + m) % m;
+    const int recv_c = ((idx - s - 1) % m + m) % m;
+    const int64_t selems = len(send_c), relems = len(recv_c);
+    WireEncode(codec, base + start(send_c), selems, enc_send.data());
+    float* seg = base + start(recv_c);
+    int64_t decoded = 0;
+    auto consume = [&](int64_t off, const char* /*data*/, int64_t nb) {
+      // Decode every fully-received element so far (the peer's chunking
+      // is byte-, not block-aligned; carry partial blocks forward).
+      const int64_t avail = WireDecodableElems(codec, off + nb, relems);
+      if (avail > decoded) {
+        WireDecodeRange(codec, enc_recv.data(), relems, decoded, avail,
+                        stage.data());
+        ReduceInto(seg + decoded, stage.data(), avail - decoded,
+                   DataType::FLOAT32, op);
+        decoded = avail;
+      }
+    };
+    Status st = ChunkedStep(socks, next, enc_send.data(),
+                            WireEncodedBytes(codec, selems), prev,
+                            WireEncodedBytes(codec, relems), enc_recv.data(),
+                            kTagCompReduceScatter + s, chunkb, consume,
+                            /*raw_len=*/4 * selems);
+    if (!st.ok()) return st;
+  }
+
+  // Phase 2: allgather.  The owner of each finished segment encodes it
+  // ONCE; every later hop forwards those encoded bytes verbatim and the
+  // owner itself decodes its own encoding — so all m members decode the
+  // identical stream and the results are bit-identical across ranks
+  // (one quantization total in this phase, regardless of ring length).
+  const int own_c = (idx + 1) % m;
+  WireEncode(codec, base + start(own_c), len(own_c), enc_send.data());
+  WireDecodeRange(codec, enc_send.data(), len(own_c), 0, len(own_c), stage.data());
+  std::memcpy(base + start(own_c), stage.data(),
+              static_cast<size_t>(4 * len(own_c)));
+  for (int s = 0; s < m - 1; ++s) {
+    const int send_c = ((idx + 1 - s) % m + m) % m;
+    const int recv_c = ((idx - s) % m + m) % m;
+    const int64_t relems = len(recv_c);
+    float* seg = base + start(recv_c);
+    int64_t decoded = 0;
+    auto consume = [&](int64_t off, const char* /*data*/, int64_t nb) {
+      const int64_t avail = WireDecodableElems(codec, off + nb, relems);
+      if (avail > decoded) {
+        WireDecodeRange(codec, enc_recv.data(), relems, decoded, avail,
+                        seg + decoded);
+        decoded = avail;
+      }
+    };
+    Status st = ChunkedStep(socks, next, enc_send.data(),
+                            WireEncodedBytes(codec, len(send_c)), prev,
+                            WireEncodedBytes(codec, relems), enc_recv.data(),
+                            kTagCompAllgather + s, chunkb, consume,
+                            /*raw_len=*/4 * len(send_c));
+    if (!st.ok()) return st;
+    // What we just received is exactly what we forward next hop
+    // (send_c at step s+1 == recv_c at step s): swap, don't re-encode.
+    std::swap(enc_send, enc_recv);
+  }
+  return Status::OK();
+}
+
 Status SocketController::AllreduceBuffer(void* buf, int64_t count,
                                          DataType dtype, ReduceOp op,
                                          int psid) {
@@ -1117,27 +1277,32 @@ Status SocketController::AllreduceBuffer(void* buf, int64_t count,
   Status st = Members(psid, &members, &idx);
   if (!st.ok()) return st;
   if (members.size() > 1) {
-    // Hierarchical path: engaged only when THIS seq's response carried the
-    // coordinator's hier bit (recorded in the cycle), so the choice is
-    // identical on every member.  Direct calls (seq -1, selftests) and
-    // unmarked seqs keep today's behavior.
-    bool hier = false;
+    // Plane refinement: engaged only when THIS seq's response carried the
+    // coordinator's hier bit / wire codec (recorded in the cycle), so the
+    // choice is identical on every member.  Direct calls (seq -1,
+    // selftests) and unmarked seqs keep today's behavior.
+    PlaneChoice plane;
     {
       std::lock_guard<std::mutex> l(hier_mu_);
-      auto it = hier_by_seq_.find(current_seq_);
-      if (it != hier_by_seq_.end()) {
-        hier = it->second;
-        hier_by_seq_.erase(it);
+      auto it = plane_by_seq_.find(current_seq_);
+      if (it != plane_by_seq_.end()) {
+        plane = it->second;
+        plane_by_seq_.erase(it);
       }
     }
-    if (hier) {
+    if (plane.hier) {
       if (HierTopo* topo = HierFor(psid)) {
-        return HierAllreduce(*topo, SocksFor(psid), buf, count, dtype, op);
+        return HierAllreduce(*topo, SocksFor(psid), buf, count, dtype, op,
+                             plane.wire);
       }
     }
     if (ShmRegion* shm = ShmFor(psid)) {
       return ShmAllreduce(*shm, SocksFor(psid), members, idx, buf, count,
                           dtype, op);
+    }
+    if (plane.wire != WireCodec::kNone && dtype == DataType::FLOAT32) {
+      return CompressedRingAllreduce(SocksFor(psid), buf, count, op, members,
+                                     idx, plane.wire);
     }
   }
   return RingAllreduce(SocksFor(psid), buf, count, dtype, op, members, idx);
@@ -1854,12 +2019,15 @@ std::string SocketController::HostKey(int rank, int size) {
   return buf;
 }
 
-void SocketController::CountSend(int to, int64_t nbytes) {
+void SocketController::CountSend(int to, int64_t wire_bytes,
+                                 int64_t raw_bytes) {
   if (to < 0 || to >= static_cast<int>(host_keys_.size())) return;
   if (host_keys_[to] == host_keys_[cfg_.rank]) {
-    data_sent_local_.fetch_add(nbytes, std::memory_order_relaxed);
+    data_sent_local_.fetch_add(wire_bytes, std::memory_order_relaxed);
+    data_raw_local_.fetch_add(raw_bytes, std::memory_order_relaxed);
   } else {
-    data_sent_xhost_.fetch_add(nbytes, std::memory_order_relaxed);
+    data_sent_xhost_.fetch_add(wire_bytes, std::memory_order_relaxed);
+    data_raw_xhost_.fetch_add(raw_bytes, std::memory_order_relaxed);
   }
 }
 
@@ -1993,7 +2161,7 @@ SocketController::HierTopo* SocketController::HierFor(int psid) {
 Status SocketController::HierAllreduce(HierTopo& topo,
                                        std::vector<Socket>& socks, void* buf,
                                        int64_t count, DataType dtype,
-                                       ReduceOp op) {
+                                       ReduceOp op, WireCodec codec) {
   const int ml = static_cast<int>(topo.local.size());
   const int item = ItemSize(dtype);
   const int64_t nbytes = count * item;
@@ -2034,8 +2202,14 @@ Status SocketController::HierAllreduce(HierTopo& topo,
   // whole win: each host moves ~2N over the wire instead of every rank's
   // 2(np-1)/np*N.  Non-leaders skip straight to the fence.
   if (topo.leader_idx >= 0) {
-    Status st = RingAllreduce(socks, ringbuf, count, dtype, op, topo.leaders,
-                              topo.leader_idx);
+    // Every leader-ring hop crosses hosts, so this is where the wire
+    // codec engages (the shm-local phases above/below stay raw fp32).
+    Status st =
+        (codec != WireCodec::kNone && dtype == DataType::FLOAT32)
+            ? CompressedRingAllreduce(socks, ringbuf, count, op,
+                                      topo.leaders, topo.leader_idx, codec)
+            : RingAllreduce(socks, ringbuf, count, dtype, op, topo.leaders,
+                            topo.leader_idx);
     if (!st.ok()) return st;
   }
   if (ml > 1) {

@@ -40,6 +40,7 @@
 #include "response_cache.h"
 #include "shm_plane.h"
 #include "socketio.h"
+#include "wire_codec.h"
 
 namespace hvdtpu {
 
@@ -114,12 +115,29 @@ class SocketController : public Controller {
   // hierarchical coordinate at all.
   bool HierAvailable() { return HierFor(0) != nullptr; }
 
+  // Wire-compression knob (HOROVOD_WIRE_COMPRESSION / the autotuner's
+  // third categorical; 0=none, 1=bf16, 2=int8).  Like SetHierarchical,
+  // only the COORDINATOR's value feeds the per-response wire_comp field.
+  void SetWireCompression(int v) {
+    wire_compression_.store(v, std::memory_order_relaxed);
+  }
+  // True when the global process set has a ring whose every hop crosses
+  // hosts (the hier leader ring, or a flat ring with one rank per host
+  // and no shm plane) — i.e. compression could ever engage.  core_api
+  // uses this to pin the autotune coordinate, same rule as HierAvailable.
+  bool WireCompAvailable();
+
   // Data-plane payload bytes sent, split by whether the destination rank
   // lives on this host (the hierarchical win is the xhost line dropping
-  // to ~2N per host).
-  void DataPlaneStats(int64_t* local, int64_t* xhost) const {
+  // to ~2N per host).  `raw_*` count the fp32-equivalent payload of the
+  // same sends: wire < raw exactly when compression engaged, and
+  // raw/wire is the measured compression ratio (docs/compression.md).
+  void DataPlaneStats(int64_t* local, int64_t* xhost, int64_t* raw_local,
+                      int64_t* raw_xhost) const {
     *local = data_sent_local_.load(std::memory_order_relaxed);
     *xhost = data_sent_xhost_.load(std::memory_order_relaxed);
+    *raw_local = data_raw_local_.load(std::memory_order_relaxed);
+    *raw_xhost = data_raw_xhost_.load(std::memory_order_relaxed);
   }
 
  private:
@@ -127,11 +145,18 @@ class SocketController : public Controller {
   // writes, Python reads — relaxed atomics suffice for monotone counters).
   std::atomic<int64_t> ctrl_sent_{0};
   std::atomic<int64_t> ctrl_recv_{0};
-  // Data-plane payload byte counters keyed by destination host locality.
+  // Data-plane payload byte counters keyed by destination host locality:
+  // `data_sent_*` are bytes on the wire, `data_raw_*` the fp32-equivalent
+  // payload (equal unless a compressed ring encoded the send).
   std::atomic<int64_t> data_sent_local_{0};
   std::atomic<int64_t> data_sent_xhost_{0};
+  std::atomic<int64_t> data_raw_local_{0};
+  std::atomic<int64_t> data_raw_xhost_{0};
   std::atomic<bool> announce_cache_{true};
   std::atomic<bool> hierarchical_{false};
+  // Requested wire codec (WireCodec as int); the coordinator demotes
+  // per-response where it cannot apply (see UpdateCachesAndSeq).
+  std::atomic<int> wire_compression_{0};
   struct Pending {
     TensorRequest meta;
     std::set<int> announced;
@@ -161,12 +186,16 @@ class SocketController : public Controller {
   // `consume` runs per completed chunk (overlapping reduce with transfer),
   // and `recv_dest` receives the incoming segment in place.  Headers carry
   // the same [seq|tag] as ExchangeStep frames; mismatches abort the job.
+  // `raw_len` is the fp32-equivalent payload size for byte accounting
+  // (compressed rings send fewer wire bytes than they represent);
+  // -1 means raw == wire (the uncompressed default).
   Status ChunkedStep(
       std::vector<Socket>& socks, int send_to, const char* send_base,
       int64_t send_len, int recv_from, int64_t recv_len, char* recv_dest,
       int32_t tag, int64_t chunk_bytes,
       const std::function<void(int64_t off, const char* data, int64_t len)>&
-          consume);
+          consume,
+      int64_t raw_len = -1);
   // Frame helpers: every data frame is [i64 seq][i32 tag][raw payload];
   // seq/tag mismatches mean the mesh desynced and abort the job.
   static void PutFrameHeader(Writer* w, int64_t seq, int32_t tag);
@@ -175,6 +204,21 @@ class SocketController : public Controller {
   Status RingAllreduce(std::vector<Socket>& socks, void* buf, int64_t count,
                        DataType dtype, ReduceOp op,
                        const std::vector<int>& members, int idx);
+  // Ring allreduce with the payload wire-encoded on every hop (fp32
+  // tensors only; docs/compression.md).  Reduce-scatter hops decode each
+  // incoming chunk and ACCUMULATE IN FP32 (one quantization of error per
+  // hop, never compounding re-quantization of partial sums); the
+  // allgather phase encodes each finished segment once at its owner and
+  // forwards those bytes verbatim, so every member decodes the identical
+  // stream and results stay bit-identical across ranks.
+  Status CompressedRingAllreduce(std::vector<Socket>& socks, void* buf,
+                                 int64_t count, ReduceOp op,
+                                 const std::vector<int>& members, int idx,
+                                 WireCodec codec);
+  // True when every adjacent hop of the flat ring over `members` crosses
+  // hosts (one rank per host), i.e. a flat compressed ring never wastes
+  // codec work on a same-host link.
+  bool RingAllCrossHost(const std::vector<int>& members) const;
   // Shared pipelined ring reduce phase (m-1 hops, in-flight reduction
   // with partial-element carry): segment boundaries come from `offs`
   // (m+1 element offsets into buf), the schedule runs in `vidx` index
@@ -247,9 +291,13 @@ class SocketController : public Controller {
   Status MaybeSetupHier(int psid, const std::vector<int>& members);
   HierTopo* HierFor(int psid);
   Status HierAllreduce(HierTopo& topo, std::vector<Socket>& socks, void* buf,
-                       int64_t count, DataType dtype, ReduceOp op);
+                       int64_t count, DataType dtype, ReduceOp op,
+                       WireCodec codec);
   // Record bytes pushed to rank `to` on the data plane (local vs x-host).
-  void CountSend(int to, int64_t nbytes);
+  // `raw_bytes` is the fp32-equivalent payload; the 2-arg form means
+  // raw == wire (no compression on this send).
+  void CountSend(int to, int64_t nbytes) { CountSend(to, nbytes, nbytes); }
+  void CountSend(int to, int64_t wire_bytes, int64_t raw_bytes);
 
   // -- wiring ---------------------------------------------------------------
   bool is_coordinator() const { return cfg_.rank == 0; }
@@ -259,6 +307,11 @@ class SocketController : public Controller {
   // (128k/256k/512k x socket-buffer sizes); the ctor only overrides this
   // from the env.
   int64_t ring_chunk_bytes_ = 1 << 19;
+
+  // HOROVOD_WIRE_COMPRESSION_MIN_BYTES: responses whose fp32 payload is
+  // below this stay raw — codec overhead beats the byte savings on tiny
+  // tensors, and the autotuner's fused buckets clear it trivially.
+  int64_t wire_comp_floor_ = 1 << 16;
 
   Listener listener_;       // coordinator: rendezvous/ctrl accept
   Listener data_listener_;  // every rank: mesh peer accept (ephemeral port)
@@ -278,9 +331,14 @@ class SocketController : public Controller {
   std::vector<std::string> host_keys_;
   // psid -> hierarchical topology (only sets where it is applicable+agreed)
   std::map<int, HierTopo> hier_;
-  // seq -> run-hierarchically, recorded from each cycle's hier bits and
-  // consumed by AllreduceBuffer (lanes are concurrent -> mutex).
-  std::map<int64_t, bool> hier_by_seq_;
+  // Per-seq coordinator plane decisions (the response's hier bit + wire
+  // codec), recorded from each cycle's responses and consumed by
+  // AllreduceBuffer (lanes are concurrent -> mutex).
+  struct PlaneChoice {
+    bool hier = false;
+    WireCodec wire = WireCodec::kNone;
+  };
+  std::map<int64_t, PlaneChoice> plane_by_seq_;
   std::mutex hier_mu_;
   // psid -> per-set socket mesh (indexed by GLOBAL rank, like peer_socks_)
   std::map<int, std::vector<Socket>> channel_socks_;

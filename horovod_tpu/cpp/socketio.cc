@@ -74,6 +74,7 @@ void SerializeResponse(const Response& r, Writer* w) {
   w->PutString(r.error);
   w->PutU8(r.cache_hit ? 1 : 0);
   w->PutU8(r.hier ? 1 : 0);
+  w->PutU8(static_cast<uint8_t>(r.wire_comp));
   w->PutI64(r.seq);
   w->PutI32(r.last_joined);
   w->PutI32(r.target_rank);
@@ -89,6 +90,7 @@ Response DeserializeResponse(Reader* r) {
   resp.error = r->GetString();
   resp.cache_hit = r->GetU8() != 0;
   resp.hier = r->GetU8() != 0;
+  resp.wire_comp = r->GetU8();
   resp.seq = r->GetI64();
   resp.last_joined = r->GetI32();
   resp.target_rank = r->GetI32();

@@ -69,6 +69,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="compose shm-local reduce + leader-only cross-host "
                         "ring + shm-local broadcast when hosts hold "
                         "co-located ranks (HOROVOD_HIERARCHICAL_ALLREDUCE)")
+    p.add_argument("--wire-compression", default=None,
+                   choices=["none", "bf16", "int8"],
+                   help="codec for fp32 allreduce payloads on cross-host "
+                        "ring hops; accumulation stays fp32 "
+                        "(HOROVOD_WIRE_COMPRESSION)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=None)
@@ -113,6 +118,7 @@ def _apply_config_file(args: argparse.Namespace,
         "host_discovery_script": cfg.get("host-discovery-script"),
         "slots_per_host": cfg.get("slots-per-host"),
         "log_level": cfg.get("log-level"),
+        "wire_compression": cfg.get("wire-compression"),
     }
     tl = cfg.get("timeline") or {}
     flat["timeline_filename"] = tl.get("filename")
@@ -161,6 +167,8 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
     if args.hierarchical_allreduce:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.wire_compression:
+        env["HOROVOD_WIRE_COMPRESSION"] = args.wire_compression
     if args.stall_check_disable:
         env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
     if args.stall_check_warning_time_seconds is not None:

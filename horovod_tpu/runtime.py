@@ -194,9 +194,11 @@ class CoreBackend:
         return {"ctrl_sent": 0, "ctrl_recv": 0}
 
     def data_plane_stats(self) -> dict:
-        """Cumulative host-data-plane bytes sent, split by locality (zero
-        for backends without a socket data plane)."""
-        return {"data_sent_local": 0, "data_sent_xhost": 0}
+        """Cumulative host-data-plane bytes sent, split by locality, plus
+        the raw (pre-wire-codec) byte counts (zero for backends without a
+        socket data plane)."""
+        return {"data_sent_local": 0, "data_sent_xhost": 0,
+                "data_raw_local": 0, "data_raw_xhost": 0}
 
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError

@@ -51,6 +51,31 @@ def get_int(name: str, default: int) -> int:
         return default
 
 
+WIRE_COMPRESSION_CODECS = ("none", "bf16", "int8")
+
+
+def get_wire_compression() -> str:
+    """Parse HOROVOD_WIRE_COMPRESSION into a validated codec name.
+
+    Unset / empty / "0" / "off" / "false" all mean "none" so boolean-style
+    launch scripts degrade safely; anything else unrecognised falls back to
+    "none" with a warning rather than failing init (and the coordinator's
+    agreed value wins over any per-rank divergence anyway).
+    """
+    raw = os.environ.get("HOROVOD_WIRE_COMPRESSION", "")
+    val = raw.strip().lower()
+    if val in ("", "0", "off", "false", "no"):
+        return "none"
+    if val in WIRE_COMPRESSION_CODECS:
+        return val
+    from .logging import get_logger
+
+    get_logger().warning(
+        "HOROVOD_WIRE_COMPRESSION=%r not one of %s; using 'none'",
+        raw, "/".join(WIRE_COMPRESSION_CODECS))
+    return "none"
+
+
 def get_float(name: str, default: float) -> float:
     val = os.environ.get(name)
     if val is None or not val.strip():
@@ -94,6 +119,10 @@ class Config:
     # cross-host ring -> shm-local broadcast for process sets spanning
     # hosts with co-located ranks.  Off by default (flat ring).
     hierarchical_allreduce: bool = False
+    # HOROVOD_WIRE_COMPRESSION: codec for fp32 allreduce payloads on
+    # cross-host ring hops ("none" | "bf16" | "int8").  Accumulation stays
+    # fp32; the coordinator decides per-response so ranks never diverge.
+    wire_compression: str = "none"
 
     # Observability.
     timeline_path: Optional[str] = None
@@ -149,6 +178,7 @@ class Config:
             hierarchical_allreduce=get_bool(
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False
             ),
+            wire_compression=get_wire_compression(),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),

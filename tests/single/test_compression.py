@@ -1,0 +1,72 @@
+"""Gradient-compression casts (horovod_tpu/compression.py).
+
+The load-bearing case: float64 tensors must never be routed through
+float16, whose 5-bit exponent silently turns anything past 65504 into
+inf.  FP16Compressor reroutes float64 through bfloat16 (fp32 exponent
+range), and BF16Compressor works on plain numpy arrays via ml_dtypes.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.compression import Compression
+
+
+def test_fp16_float32_round_trip():
+    x = np.linspace(-4.0, 4.0, 64, dtype=np.float32)
+    wire, ctx = Compression.fp16.compress(x)
+    assert str(wire.dtype) == "float16"
+    back = Compression.fp16.decompress(wire, ctx)
+    assert str(back.dtype) == "float32"
+    np.testing.assert_allclose(back, x, atol=1e-2)
+
+
+def test_fp16_float64_routed_through_bf16():
+    # 1e30 overflows float16 (max 65504) but is comfortably in bf16 range.
+    x = np.array([1e30, -2.5e12, 1.0, -65504.0, 7e-20], dtype=np.float64)
+    wire, ctx = Compression.fp16.compress(x)
+    assert str(wire.dtype) == "bfloat16", (
+        "float64 must not be cast to float16 (silent overflow to inf)")
+    back = np.asarray(Compression.fp16.decompress(wire, ctx))
+    assert str(back.dtype) == "float64"
+    assert np.all(np.isfinite(back))
+    np.testing.assert_allclose(back, x, rtol=1 / 128.0)
+
+
+def test_bf16_numpy_float32():
+    x = np.array([3.14159, -1e35, 2.0, 0.0], dtype=np.float32)
+    wire, ctx = Compression.bf16.compress(x)
+    assert str(wire.dtype) == "bfloat16"
+    back = np.asarray(Compression.bf16.decompress(wire, ctx))
+    assert str(back.dtype) == "float32"
+    assert np.all(np.isfinite(back))
+    np.testing.assert_allclose(back, x, rtol=1 / 128.0)
+    # Exactly-representable values survive bit-for-bit.
+    exact = np.array([1.0, -0.5, 1024.0, 0.0078125], dtype=np.float32)
+    wire, ctx = Compression.bf16.compress(exact)
+    np.testing.assert_array_equal(
+        np.asarray(Compression.bf16.decompress(wire, ctx)), exact)
+
+
+def test_bf16_float64_round_trip():
+    x = np.array([1e300 / 1e270, -42.42, 3e-20], dtype=np.float64)
+    wire, ctx = Compression.bf16.compress(x)
+    assert str(wire.dtype) == "bfloat16"
+    back = np.asarray(Compression.bf16.decompress(wire, ctx))
+    assert str(back.dtype) == "float64"
+    np.testing.assert_allclose(back, x, rtol=1 / 128.0)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float16])
+def test_non_compressible_dtypes_pass_through(dtype):
+    x = np.arange(8).astype(dtype)
+    wire, ctx = Compression.fp16.compress(x)
+    assert wire is x and ctx is None
+    assert Compression.fp16.decompress(wire, ctx) is x
+
+
+def test_none_compressor_identity():
+    x = np.ones(4, dtype=np.float64)
+    wire, ctx = Compression.none.compress(x)
+    assert wire is x and ctx is None
+    assert Compression.none.decompress(wire, ctx) is x

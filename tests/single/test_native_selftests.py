@@ -38,5 +38,17 @@ def test_core_selftest_under_tsan():
 def test_chunk_exchange_selftest():
     """Randomized-geometry fuzz of ChunkedDuplexExchange (the primitive
     under the pipelined ring/chain data plane) plus its header-mismatch
-    and cancellation error paths."""
+    and cancellation error paths, and the wire-codec layer: bf16
+    round-trip exactness, int8 block-scale error bound, incremental
+    (chunk-boundary) decode equivalence, and the fp32 ring-accumulation
+    bound (error <= hops x scale/2)."""
     _build_and_run("chunk_exchange_selftest")
+
+
+def test_make_selftest_target():
+    """`make selftest` builds and runs every non-TSAN selftest binary in
+    one shot — the entry point developers (and CI without pytest) use."""
+    out = subprocess.run(["make", "selftest"], cwd=CPP_DIR,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout

@@ -11,6 +11,12 @@ Measures, per configuration:
 
 Usage: python tools/bench_negotiation.py [--np 4] [--steps 60]
 Prints one JSON line per configuration plus a summary ratio line.
+
+With --wire-compression {bf16,int8} an additional data-plane section runs:
+a large fp32 allreduce over two fake hosts with the hierarchical plane (so
+the codec engages on the cross-host leader ring), reporting cross-host
+wire bytes/step against the fp32 baseline and the max abs error the codec
+introduced.
 """
 
 import argparse
@@ -89,11 +95,75 @@ def run_config(name: str, env: dict, np_: int, steps: int, tensors: int):
     return agg
 
 
+def _wire_worker(steps: int, elems: int):
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    x = ((np.arange(elems) % 251) + r).astype(np.float32)
+    exact = sum(((np.arange(elems) % 251) + rr).astype(np.float64)
+                for rr in range(s))
+    core = HorovodContext.instance().core
+    hvd.allreduce(x, op=hvd.Sum, name="wb.warm")
+    hvd.barrier()
+    s0 = core.data_plane_stats()
+    max_err = 0.0
+    import time
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=f"wb.{i}"),
+                         dtype=np.float64)
+        max_err = max(max_err, float(np.max(np.abs(out - exact))))
+    dt = time.perf_counter() - t0
+    s1 = core.data_plane_stats()
+    hvd.barrier()
+    hvd.shutdown()
+    return {"rank": r, "steps_per_s": steps / dt, "max_abs_err": max_err,
+            "xhost_bytes_per_step":
+                (s1["data_sent_xhost"] - s0["data_sent_xhost"]) / steps,
+            "raw_xhost_bytes_per_step":
+                (s1["data_raw_xhost"] - s0["data_raw_xhost"]) / steps}
+
+
+def run_wire_config(codec: str, np_: int, steps: int, elems: int):
+    from horovod_tpu.runner import run
+
+    env = {"JAX_PLATFORMS": "cpu", "HOROVOD_HIER_FAKE_HOSTS": "2",
+           "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+           "HOROVOD_WIRE_COMPRESSION": codec}
+    results = run(_wire_worker, args=(steps, elems), np=np_, env=env,
+                  stream_prefix=False)
+    agg = {
+        "config": f"wire_{codec}",
+        "np": np_,
+        "payload_bytes": elems * 4,
+        "steps_per_s": round(min(r["steps_per_s"] for r in results), 2),
+        "xhost_bytes_per_step": round(
+            sum(r["xhost_bytes_per_step"] for r in results), 1),
+        "raw_xhost_bytes_per_step": round(
+            sum(r["raw_xhost_bytes_per_step"] for r in results), 1),
+        "max_abs_err": max(r["max_abs_err"] for r in results),
+    }
+    print(json.dumps(agg), flush=True)
+    return agg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--np", type=int, default=4)
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--tensors", type=int, default=50)
+    ap.add_argument("--wire-compression", default=None,
+                    choices=["bf16", "int8"],
+                    help="also benchmark the wire codec on a cross-host "
+                         "(fake two-host, hierarchical) topology against "
+                         "the fp32 baseline: bytes/step + max abs error")
+    ap.add_argument("--wire-mb", type=float, default=4.0,
+                    help="fp32 payload size for the wire benchmark (MiB)")
+    ap.add_argument("--wire-steps", type=int, default=10)
     args = ap.parse_args()
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
@@ -120,6 +190,25 @@ def main():
             cache_on["worker_announce_bytes_per_step"]
             / max(cache_off["worker_announce_bytes_per_step"], 1.0), 3)
     print(json.dumps(summary), flush=True)
+
+    if args.wire_compression:
+        elems = int(args.wire_mb * (1 << 20)) // 4
+        base = run_wire_config("none", args.np, args.wire_steps, elems)
+        comp = run_wire_config(args.wire_compression, args.np,
+                               args.wire_steps, elems)
+        print(json.dumps({
+            "metric": "wire_compression",
+            "codec": args.wire_compression,
+            "xhost_bytes_ratio_vs_fp32": round(
+                comp["xhost_bytes_per_step"]
+                / max(base["xhost_bytes_per_step"], 1.0), 3),
+            "wire_vs_raw_ratio": round(
+                comp["xhost_bytes_per_step"]
+                / max(comp["raw_xhost_bytes_per_step"], 1.0), 3),
+            "max_abs_err": comp["max_abs_err"],
+            "steps_ratio_vs_fp32": round(
+                comp["steps_per_s"] / max(base["steps_per_s"], 1e-9), 3),
+        }), flush=True)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,10 @@ combination of:
            np>1 only / hierarchical (HOROVOD_HIERARCHICAL_ALLREDUCE=1 over
            two fake hosts via HOROVOD_HIER_FAKE_HOSTS=2), np>=3 only —
            smaller np degenerates to one rank per fake host
+- wire:    none / bf16 / int8 (HOROVOD_WIRE_COMPRESSION) — codecs engage
+           on the hier plane's cross-host leader ring; plus demotion
+           combos where the knob is set on an all-local topology and the
+           coordinator must turn it into a no-op
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -100,6 +104,18 @@ WORKLOAD = textwrap.dedent("""
                                 op=hvd.Sum, process_set=ps, name="m.ps")
             np.testing.assert_allclose(out, (s - 1) * s / 2.0)
 
+    # big fp32 payload above the wire-compression floor: rides the codec
+    # on cross-host topologies (tolerance keyed off the knob; the small
+    # tensors above stay under the floor, so their exact asserts hold).
+    wire = os.environ.get("HOROVOD_WIRE_COMPRESSION", "none")
+    wtol = {"bf16": dict(rtol=0.04, atol=1e-3),
+            "int8": dict(rtol=0.05, atol=6.0)}.get(wire, dict(rtol=1e-6))
+    big = ((np.arange(1 << 16) % 251) + r).astype(np.float32)
+    wexp = sum(((np.arange(1 << 16) % 251) + rr).astype(np.float32)
+               for rr in range(s))
+    np.testing.assert_allclose(hvd.allreduce(big, op=hvd.Sum, name="m.wire"),
+                               wexp, **wtol)
+
     hvd.barrier()
     hvd.shutdown()
     print(f"WORKLOAD-OK rank={r}", flush=True)
@@ -151,6 +167,17 @@ TORCH_WORKLOAD = textwrap.dedent("""
     out, _ = hvd.alltoall(data, splits=[2] * s, name="m.a2a")
     assert tuple(out.shape) == (2 * s, 1)
 
+    # big fp32 payload above the wire-compression floor (see jax workload).
+    wire = os.environ.get("HOROVOD_WIRE_COMPRESSION", "none")
+    wtol = {"bf16": dict(rtol=0.04, atol=1e-3),
+            "int8": dict(rtol=0.05, atol=6.0)}.get(wire, dict(rtol=1e-6))
+    big = torch.remainder(torch.arange(1 << 16, dtype=torch.float32),
+                          251.0) + r
+    wexp = sum((np.arange(1 << 16) % 251 + rr).astype(np.float32)
+               for rr in range(s))
+    np.testing.assert_allclose(
+        hvd.allreduce(big, op=hvd.Sum, name="m.wire").numpy(), wexp, **wtol)
+
     hvd.barrier()
     hvd.shutdown()
     print(f"WORKLOAD-OK rank={r}", flush=True)
@@ -163,41 +190,53 @@ def combos(quick: bool):
     fusion = ["on", "off"]
     cache = ["on", "off"]
     planes = ["shm", "tcp", "tcp0", "hier"]
+    wires = ["none", "bf16", "int8"]
     if quick:
-        # One covering set instead of the full product.
-        yield ("jax", "native", 3, "on", "on", "shm")
-        yield ("jax", "native", 2, "off", "off", "tcp")
-        yield ("jax", "native", 3, "on", "off", "tcp0")
-        yield ("jax", "native", 3, "on", "on", "hier")
-        yield ("jax", "native", 1, "on", "off", "shm")
-        yield ("jax", "purepy", 1, "off", "on", "shm")
-        yield ("torch", "native", 2, "on", "on", "shm")
-        yield ("torch", "native", 3, "off", "off", "tcp")
-        yield ("torch", "purepy", 1, "on", "on", "shm")
+        # One covering set instead of the full product (every axis value
+        # appears; hier+none pairing is covered by tests/parallel).
+        yield ("jax", "native", 3, "on", "on", "shm", "none")
+        # Same-host links: the coordinator must demote the codec (knob
+        # harmless, results exact).
+        yield ("jax", "native", 2, "off", "off", "tcp", "bf16")
+        yield ("jax", "native", 3, "on", "off", "tcp0", "none")
+        yield ("jax", "native", 3, "on", "on", "hier", "bf16")
+        yield ("jax", "native", 3, "on", "off", "hier", "int8")
+        yield ("jax", "native", 1, "on", "off", "shm", "none")
+        yield ("jax", "purepy", 1, "off", "on", "shm", "none")
+        yield ("torch", "native", 2, "on", "on", "shm", "none")
+        yield ("torch", "native", 3, "off", "off", "tcp", "none")
+        yield ("torch", "purepy", 1, "on", "on", "shm", "none")
         return
-    for core, np_, f, c, p in itertools.product(cores, nps, fusion, cache,
-                                                planes):
+    for core, np_, f, c, p, w in itertools.product(cores, nps, fusion,
+                                                   cache, planes, wires):
         if core == "purepy" and np_ > 1:
             continue  # pure-python core is single-process by contract
         if np_ == 1 and p != "shm":
             continue  # no data plane at np=1; plane axis is meaningless
         if p == "hier" and np_ < 3:
             continue  # 2 ranks / 2 fake hosts has no multi-rank host
-        yield ("jax", core, np_, f, c, p)
+        if w != "none" and (p != "hier" or core != "native"):
+            continue  # codec engages only on cross-host hops (leader ring)
+        yield ("jax", core, np_, f, c, p, w)
+    # Demotion coverage: codec requested on an all-local flat ring.
+    yield ("jax", "native", 2, "on", "on", "tcp", "bf16")
+    yield ("jax", "native", 3, "on", "on", "shm", "int8")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
-    yield ("torch", "native", 2, "on", "on", "shm")
-    yield ("torch", "native", 2, "off", "off", "tcp")
-    yield ("torch", "native", 2, "on", "off", "tcp0")
-    yield ("torch", "native", 3, "on", "on", "tcp")
-    yield ("torch", "native", 3, "off", "on", "shm")
-    yield ("torch", "native", 3, "on", "on", "hier")
-    yield ("torch", "native", 1, "on", "on", "shm")
-    yield ("torch", "purepy", 1, "on", "on", "shm")
+    yield ("torch", "native", 2, "on", "on", "shm", "none")
+    yield ("torch", "native", 2, "off", "off", "tcp", "none")
+    yield ("torch", "native", 2, "on", "off", "tcp0", "none")
+    yield ("torch", "native", 3, "on", "on", "tcp", "none")
+    yield ("torch", "native", 3, "off", "on", "shm", "none")
+    yield ("torch", "native", 3, "on", "on", "hier", "none")
+    yield ("torch", "native", 3, "on", "on", "hier", "bf16")
+    yield ("torch", "native", 3, "on", "on", "hier", "int8")
+    yield ("torch", "native", 1, "on", "on", "shm", "none")
+    yield ("torch", "purepy", 1, "on", "on", "shm", "none")
 
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
-              plane: str, script: str, timeout: float) -> tuple:
+              plane: str, wire: str, script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -205,6 +244,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     env.pop("HOROVOD_RING_CHUNK_BYTES", None)
     env.pop("HOROVOD_HIERARCHICAL_ALLREDUCE", None)
     env.pop("HOROVOD_HIER_FAKE_HOSTS", None)
+    # Same for the wire axis: ambient codec settings would skew both the
+    # exact asserts (wire=none combos) and the demotion combos.
+    env.pop("HOROVOD_WIRE_COMPRESSION", None)
+    env.pop("HOROVOD_WIRE_COMPRESSION_MIN_BYTES", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -222,6 +265,8 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         # np=3 gives hosts {0,1} + {2} — the smallest hierarchical topology.
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
         env["HOROVOD_HIER_FAKE_HOSTS"] = "2"
+    if wire != "none":
+        env["HOROVOD_WIRE_COMPRESSION"] = wire
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -254,11 +299,12 @@ def main() -> int:
             with open(scripts[binding], "w") as f:
                 f.write(text)
         for combo in combos(args.quick):
-            binding, core, np_, fusion, cache, plane = combo
+            binding, core, np_, fusion, cache, plane, wire = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
-                     f"fusion={fusion:<3} cache={cache:<3} plane={plane}")
+                     f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
+                     f"wire={wire}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
-                                       script=scripts[binding],
+                                       wire, script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
                   flush=True)

@@ -65,6 +65,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_char_p, c.c_char_p, c.c_int,           # controller addr port
         c.c_double, c.c_longlong, c.c_int, c.c_int,  # cycle fusion cache autotune
         c.c_char_p, c.c_int, c.c_int,              # autotune_log hierarchical wire_comp
+        c.c_int, c.c_char_p, c.c_double,           # metrics metrics_file interval
         c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
     ]
@@ -119,6 +120,14 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
     lib.hvd_start_timeline.argtypes = [c.c_char_p, c.c_int]
     lib.hvd_stop_timeline.argtypes = []
+    try:
+        # Old-ABI tolerance (same pattern as hvd_data_plane_stats2): a
+        # stale .so that survived a failed rebuild predates the metrics
+        # plane; metrics() then degrades to {} instead of raising.
+        lib.hvd_metrics_dump.restype = c.c_int
+        lib.hvd_metrics_dump.argtypes = [c.c_char_p, c.c_int]
+    except AttributeError:
+        pass
     lib.hvd_last_error.restype = c.c_char_p
 
 
@@ -160,6 +169,9 @@ class NativeCore(CoreBackend):
             (cfg.autotune_log or "").encode(),
             1 if cfg.hierarchical_allreduce else 0,
             {"none": 0, "bf16": 1, "int8": 2}.get(cfg.wire_compression, 0),
+            1 if cfg.metrics_enabled else 0,
+            (cfg.metrics_file or "").encode(),
+            cfg.metrics_interval_s,
             (cfg.timeline_path or "").encode(),
             1 if cfg.timeline_mark_cycles else 0,
             cfg.stall_warning_s if cfg.stall_check_enabled else 0.0,
@@ -392,6 +404,30 @@ class NativeCore(CoreBackend):
                 "data_sent_xhost": xhost.value,
                 "data_raw_local": raw_local.value,
                 "data_raw_xhost": raw_xhost.value}
+
+    _warned_no_metrics = False
+
+    def metrics(self) -> dict:
+        """Local metrics registry as a dict (counters + power-of-two-bucket
+        histograms); on the coordinator the dump also carries the cluster
+        view and the last straggler report.  An old .so without the entry
+        point degrades to {} with a one-time warning."""
+        if not hasattr(self._lib, "hvd_metrics_dump"):
+            if not NativeCore._warned_no_metrics:
+                NativeCore._warned_no_metrics = True
+                log.warning("native core predates the metrics plane "
+                            "(hvd_metrics_dump missing); metrics() returns {}")
+            return {}
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_metrics_dump(buf, cap)
+        while n == -2:  # buffer too small: grow and retry
+            cap *= 4
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.hvd_metrics_dump(buf, cap)
+        if n <= 0:
+            return {}
+        return json.loads(buf.raw[:n].decode())
 
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         self._lib.hvd_start_timeline(path.encode(), 1 if mark_cycles else 0)

@@ -190,6 +190,26 @@ def num_devices() -> int:
     return jax.local_device_count()
 
 
+# -- metrics ----------------------------------------------------------------
+
+def metrics() -> dict:
+    """Local metrics-registry snapshot: counters (cycle occupancy, fusion
+    efficiency, stall warnings) and power-of-two-bucket histograms
+    (negotiation wait, ring hop latency, shm fence wait).  On rank 0 the
+    dict also carries ``cluster`` (per-rank snapshots aggregated by the
+    coordinator) and ``straggler_report``.  Empty when the metrics plane is
+    disabled or the backend has no native registry."""
+    return HorovodContext.instance().core.metrics()
+
+
+def metrics_prometheus() -> str:
+    """The same snapshot rendered in Prometheus text exposition format
+    (``hvd_*`` families; see docs/observability.md for the naming scheme)."""
+    from .utils.metrics import render_prometheus
+
+    return render_prometheus(metrics())
+
+
 # -- timeline ---------------------------------------------------------------
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

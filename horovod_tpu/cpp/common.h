@@ -173,6 +173,14 @@ struct CoreConfig {
   // HOROVOD_WIRE_COMPRESSION: codec for cross-host ring hops (0=none,
   // 1=bf16, 2=int8).  Coordinator-authoritative like `hierarchical`.
   int wire_compression = 0;
+  // HOROVOD_METRICS / HOROVOD_METRICS_FILE: enable the native metrics
+  // registry; when metrics_file is non-empty the background loop writes a
+  // JSON snapshot there every metrics_interval_s (a `{rank}` placeholder
+  // is substituted, else `.<rank>` is appended — np>1 runs on one host
+  // would otherwise clobber a shared path).
+  bool metrics = false;
+  std::string metrics_file;
+  double metrics_interval_s = 10.0;
   std::string timeline_path;
   bool timeline_mark_cycles = false;
   double stall_warn_s = 60.0;

@@ -26,6 +26,7 @@
 #include "common.h"
 #include "controller.h"
 #include "logging.h"
+#include "metrics.h"
 #include "parameter_manager.h"
 #include "socket_controller.h"
 #include "timeline.h"
@@ -35,22 +36,6 @@ namespace hvdtpu {
 namespace {
 
 int g_log_level = WARNING;
-
-std::string JsonEscape(const std::string& s) {
-  std::string out;
-  out.reserve(s.size() + 8);
-  for (char c : s) {
-    if (c == '"' || c == '\\') {
-      out.push_back('\\');
-      out.push_back(c);
-    } else if (static_cast<unsigned char>(c) < 0x20) {
-      out += ' ';
-    } else {
-      out.push_back(c);
-    }
-  }
-  return out;
-}
 
 struct GlobalState {
   CoreConfig cfg;
@@ -80,6 +65,8 @@ struct GlobalState {
   std::atomic<int64_t> fusion_threshold{64LL << 20};
   double cycle_ms = 1.0;
   double last_stall_check = 0.0;
+  std::string metrics_path;  // per-rank resolved HOROVOD_METRICS_FILE
+  double last_metrics_write = 0.0;
 
   std::mutex err_mu;
   std::string last_error;
@@ -145,12 +132,39 @@ void FailAllOutstanding(const std::string& reason) {
   if (!err.handles.empty()) DeliverResponse(err);
 }
 
+std::string ControllerMetricsJson() {
+  auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+  return sc ? sc->ClusterMetricsJson() : std::string();
+}
+
+// Atomic (write-then-rename) so a reader never sees a torn snapshot.
+void WriteMetricsFile() {
+  std::string json =
+      GlobalMetrics().DumpJson(g->cfg.rank, ControllerMetricsJson());
+  std::string tmp = g->metrics_path + ".tmp";
+  FILE* f = std::fopen(tmp.c_str(), "w");
+  if (!f) return;
+  std::fwrite(json.data(), 1, json.size(), f);
+  std::fputc('\n', f);
+  std::fclose(f);
+  std::rename(tmp.c_str(), g->metrics_path.c_str());
+}
+
 void BackgroundLoop() {
   auto& cfg = g->cfg;
   double stall_period = cfg.stall_warn_s > 0 ? cfg.stall_warn_s : 60.0;
   while (!g->shutdown.load()) {
+    double sleep_start = MonotonicSeconds();
     std::this_thread::sleep_for(
         std::chrono::microseconds(static_cast<int64_t>(g->cycle_ms * 1000)));
+    double work_start = MonotonicSeconds();
+    if (MetricsOn()) {
+      auto& mreg = GlobalMetrics();
+      mreg.cycle_count.fetch_add(1, std::memory_order_relaxed);
+      mreg.cycle_idle_us.fetch_add(
+          static_cast<int64_t>((work_start - sleep_start) * 1e6),
+          std::memory_order_relaxed);
+    }
     g->timeline.MarkCycle();
 
     std::vector<TensorRequest> newreqs;
@@ -215,6 +229,12 @@ void BackgroundLoop() {
           continue;
         }
         r.handles.push_back(it->second.handle);
+        if (MetricsOn()) {
+          // Same span the timeline's NEGOTIATE B/E pair measures, so the
+          // registry total and the trace agree.
+          GlobalMetrics().negotiation_wait_us.ObserveSeconds(
+              MonotonicSeconds() - it->second.enqueued_at);
+        }
         g->outstanding.erase(it);
         g->timeline.End(name, "NEGOTIATE");
       }
@@ -243,6 +263,15 @@ void BackgroundLoop() {
         // a local join, uninvolved ranks drop them in C++ as before.
         if (r.op == OpType::JOIN && !r.handles.empty()) {
           g->join_inflight.store(false);
+        }
+        if (MetricsOn() && !r.metas.empty()) {
+          auto& mreg = GlobalMetrics();
+          int64_t rbytes = 0;
+          for (const auto& m : r.metas) rbytes += m.nbytes;
+          mreg.responses_total.fetch_add(1, std::memory_order_relaxed);
+          mreg.tensors_fused_total.fetch_add(
+              static_cast<int64_t>(r.metas.size()), std::memory_order_relaxed);
+          mreg.bytes_fused_total.fetch_add(rbytes, std::memory_order_relaxed);
         }
         DeliverResponse(r);
       }
@@ -277,6 +306,10 @@ void BackgroundLoop() {
       g->last_stall_check = now;
       std::string report = g->controller->StallReport(cfg.stall_warn_s);
       if (!report.empty()) {
+        if (MetricsOn()) {
+          GlobalMetrics().stall_warnings_total.fetch_add(
+              1, std::memory_order_relaxed);
+        }
         HVD_LOG(WARNING)
             << "Stall detected: tensors submitted on some ranks but not "
                "others: "
@@ -308,6 +341,16 @@ void BackgroundLoop() {
         FailAllOutstanding("Horovod stall shutdown: " + msg);
       }
     }
+    if (MetricsOn()) {
+      GlobalMetrics().cycle_busy_us.fetch_add(
+          static_cast<int64_t>((MonotonicSeconds() - work_start) * 1e6),
+          std::memory_order_relaxed);
+    }
+    if (!g->metrics_path.empty() &&
+        MonotonicSeconds() - g->last_metrics_write >= cfg.metrics_interval_s) {
+      g->last_metrics_write = MonotonicSeconds();
+      WriteMetricsFile();
+    }
   }
   g->background_done.store(true);
 }
@@ -327,8 +370,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              const char* controller, const char* addr, int port,
              double cycle_ms, long long fusion, int cache_cap, int autotune,
              const char* autotune_log, int hierarchical, int wire_compression,
-             const char* timeline_path, int timeline_mark_cycles,
-             double stall_warn_s, double stall_shutdown_s, int log_level) {
+             int metrics_enabled, const char* metrics_file,
+             double metrics_interval_s, const char* timeline_path,
+             int timeline_mark_cycles, double stall_warn_s,
+             double stall_shutdown_s, int log_level) {
   if (g != nullptr) return -1;
   g = new GlobalState();
   auto& cfg = g->cfg;
@@ -347,6 +392,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.hierarchical = hierarchical != 0;
   cfg.wire_compression =
       wire_compression >= 0 && wire_compression <= 2 ? wire_compression : 0;
+  cfg.metrics_file = metrics_file ? metrics_file : "";
+  cfg.metrics = metrics_enabled != 0 || !cfg.metrics_file.empty();
+  cfg.metrics_interval_s = metrics_interval_s > 0 ? metrics_interval_s : 10.0;
   cfg.timeline_path = timeline_path ? timeline_path : "";
   cfg.timeline_mark_cycles = timeline_mark_cycles != 0;
   cfg.stall_warn_s = stall_warn_s;
@@ -354,6 +402,22 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   SetLogLevel(log_level);
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
   g->fusion_threshold.store(fusion);
+
+  // The registry is process-global (instrumentation points sit below the
+  // GlobalState), so re-init within one process starts from zero.
+  GlobalMetrics().Reset();
+  GlobalMetrics().enabled.store(cfg.metrics, std::memory_order_relaxed);
+  if (!cfg.metrics_file.empty()) {
+    std::string p = cfg.metrics_file;
+    auto pos = p.find("{rank}");
+    if (pos != std::string::npos) {
+      p.replace(pos, 6, std::to_string(cfg.rank));
+    } else {
+      p += "." + std::to_string(cfg.rank);
+    }
+    g->metrics_path = p;
+  }
+  g->timeline.SetRank(cfg.rank);
 
   if (cfg.size > 1 || cfg.controller == "socket") {
     g->controller = std::make_unique<SocketController>(cfg);
@@ -364,12 +428,17 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   if (!s.ok()) {
     SetLastError(s.reason);
     HVD_LOG(ERROR) << "init failed: " << s.reason;
+    GlobalMetrics().enabled.store(false, std::memory_order_relaxed);
     delete g;
     g = nullptr;
     return -2;
   }
   if (!cfg.timeline_path.empty()) {
     g->timeline.Start(cfg.timeline_path, cfg.timeline_mark_cycles);
+    // Every rank leaves controller Initialize() through the rendezvous
+    // handshake's closing fences within the same instant, so this event
+    // is merge_timeline.py's cross-rank alignment anchor.
+    g->timeline.Instant("RENDEZVOUS");
   }
   if (cfg.autotune) {
     // The hierarchical knob is tunable only when the wired-up topology can
@@ -410,6 +479,10 @@ int hvd_shutdown() {
   }
   if (g->background.joinable()) g->background.join();
   FailAllOutstanding("Horovod has been shut down");
+  // Final snapshot so short runs (shorter than the interval) still leave
+  // a complete metrics file behind.
+  if (!g->metrics_path.empty()) WriteMetricsFile();
+  GlobalMetrics().enabled.store(false, std::memory_order_relaxed);
   g->timeline.Stop();
   {
     std::lock_guard<std::mutex> l(g->out_mu);
@@ -650,6 +723,22 @@ void hvd_data_plane_stats2(long long* local, long long* xhost,
   *xhost = x;
   *raw_local = rl;
   *raw_xhost = rx;
+}
+
+// Full local metrics registry as one JSON object; on the coordinator the
+// dump also carries the aggregated cluster view (per-rank piggybacked
+// snapshots) and the latest straggler attribution report.
+// Returns: >0 = JSON length written, -1 = not initialized, -2 = buffer
+// too small (caller grows and retries, same convention as
+// hvd_pop_response).
+int hvd_metrics_dump(char* buf, int cap) {
+  if (g == nullptr) return -1;
+  std::string json =
+      GlobalMetrics().DumpJson(g->cfg.rank, ControllerMetricsJson());
+  if (static_cast<int>(json.size()) + 1 > cap) return -2;
+  std::memcpy(buf, json.data(), json.size());
+  buf[json.size()] = '\0';
+  return static_cast<int>(json.size());
 }
 
 void hvd_start_timeline(const char* path, int mark_cycles) {

@@ -1,0 +1,109 @@
+#include "metrics.h"
+
+#include <cstdio>
+#include <sstream>
+
+namespace hvdtpu {
+
+int64_t Histogram::QuantileUs(double q) const {
+  int64_t n = count.load(std::memory_order_relaxed);
+  if (n <= 0) return 0;
+  int64_t target = static_cast<int64_t>(q * n);
+  if (target < 1) target = 1;
+  if (target > n) target = n;
+  int64_t cum = 0;
+  for (int b = 0; b < kNumBuckets; ++b) {
+    cum += buckets[b].load(std::memory_order_relaxed);
+    if (cum >= target) return int64_t{1} << b;
+  }
+  return int64_t{1} << (kNumBuckets - 1);
+}
+
+std::string Histogram::Json() const {
+  std::ostringstream os;
+  os << "{\"count\":" << count.load(std::memory_order_relaxed)
+     << ",\"sum_us\":" << sum_us.load(std::memory_order_relaxed)
+     << ",\"p50_us\":" << QuantileUs(0.5)
+     << ",\"p99_us\":" << QuantileUs(0.99) << ",\"buckets\":[";
+  for (int b = 0; b < kNumBuckets; ++b) {
+    if (b) os << ',';
+    os << buckets[b].load(std::memory_order_relaxed);
+  }
+  os << "]}";
+  return os.str();
+}
+
+void MetricsRegistry::Reset() {
+  cycle_count.store(0, std::memory_order_relaxed);
+  cycle_busy_us.store(0, std::memory_order_relaxed);
+  cycle_idle_us.store(0, std::memory_order_relaxed);
+  responses_total.store(0, std::memory_order_relaxed);
+  tensors_fused_total.store(0, std::memory_order_relaxed);
+  bytes_fused_total.store(0, std::memory_order_relaxed);
+  stall_warnings_total.store(0, std::memory_order_relaxed);
+  straggler_reports_total.store(0, std::memory_order_relaxed);
+  negotiation_wait_us.Reset();
+  ring_hop_us.Reset();
+  shm_fence_us.Reset();
+}
+
+std::string MetricsRegistry::DumpJson(int rank,
+                                      const std::string& extra_json) const {
+  std::ostringstream os;
+  os << "{\"enabled\":"
+     << (enabled.load(std::memory_order_relaxed) ? "true" : "false")
+     << ",\"rank\":" << rank << ",\"counters\":{"
+     << "\"cycle_count\":" << cycle_count.load(std::memory_order_relaxed)
+     << ",\"cycle_busy_us\":" << cycle_busy_us.load(std::memory_order_relaxed)
+     << ",\"cycle_idle_us\":" << cycle_idle_us.load(std::memory_order_relaxed)
+     << ",\"responses_total\":"
+     << responses_total.load(std::memory_order_relaxed)
+     << ",\"tensors_fused_total\":"
+     << tensors_fused_total.load(std::memory_order_relaxed)
+     << ",\"bytes_fused_total\":"
+     << bytes_fused_total.load(std::memory_order_relaxed)
+     << ",\"stall_warnings_total\":"
+     << stall_warnings_total.load(std::memory_order_relaxed)
+     << ",\"straggler_reports_total\":"
+     << straggler_reports_total.load(std::memory_order_relaxed)
+     << "},\"histograms\":{"
+     << "\"negotiation_wait_us\":" << negotiation_wait_us.Json()
+     << ",\"ring_hop_us\":" << ring_hop_us.Json()
+     << ",\"shm_fence_us\":" << shm_fence_us.Json() << "}";
+  if (!extra_json.empty()) os << ',' << extra_json;
+  os << "}";
+  return os.str();
+}
+
+MetricsRegistry& GlobalMetrics() {
+  static MetricsRegistry registry;
+  return registry;
+}
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    unsigned char u = static_cast<unsigned char>(c);
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (u < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", u);
+          out += buf;
+        } else {
+          out.push_back(c);
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,101 @@
+// Lock-cheap metrics registry for the native core (reference analog:
+// horovod/common/timeline instrumentation points + the per-op stats the
+// upstream autotuner consumes; SURVEY.md §5).  All counters and histogram
+// buckets are relaxed atomics — instrumentation points are a single
+// fetch_add on the hot path, and every site is guarded by MetricsOn() so
+// a disabled registry costs one relaxed bool load.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+
+namespace hvdtpu {
+
+// Fixed power-of-two microsecond buckets.  Bucket 0 holds [0, 1us);
+// bucket b (1 <= b < kBuckets-1) holds [2^(b-1), 2^b) us; the last
+// bucket is the +Inf overflow.  2^26 us ≈ 67 s upper finite bound.
+struct Histogram {
+  static constexpr int kNumBuckets = 28;
+  std::atomic<int64_t> buckets[kNumBuckets];
+  std::atomic<int64_t> count{0};
+  std::atomic<int64_t> sum_us{0};
+
+  Histogram() {
+    for (auto& b : buckets) b.store(0, std::memory_order_relaxed);
+  }
+
+  void ObserveUs(int64_t us) {
+    if (us < 0) us = 0;
+    int b = 0;
+    while (b < kNumBuckets - 1 && us >= (int64_t{1} << b)) ++b;
+    buckets[b].fetch_add(1, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+    sum_us.fetch_add(us, std::memory_order_relaxed);
+  }
+
+  void ObserveSeconds(double s) {
+    ObserveUs(static_cast<int64_t>(s * 1e6));
+  }
+
+  // Upper bound of the bucket holding the q-quantile (conservative:
+  // the true quantile is <= the returned value, within one power of 2).
+  int64_t QuantileUs(double q) const;
+
+  void Reset() {
+    for (auto& b : buckets) b.store(0, std::memory_order_relaxed);
+    count.store(0, std::memory_order_relaxed);
+    sum_us.store(0, std::memory_order_relaxed);
+  }
+
+  // {"count":N,"sum_us":S,"p50_us":..,"p99_us":..,"buckets":[..]}
+  std::string Json() const;
+};
+
+struct MetricsRegistry {
+  std::atomic<bool> enabled{false};
+
+  // Background-loop cycle occupancy: one tick = one sleep (idle) plus
+  // the negotiation work that follows it (busy).
+  std::atomic<int64_t> cycle_count{0};
+  std::atomic<int64_t> cycle_busy_us{0};
+  std::atomic<int64_t> cycle_idle_us{0};
+
+  // Fusion efficiency: tensors and payload bytes per delivered fused
+  // response.
+  std::atomic<int64_t> responses_total{0};
+  std::atomic<int64_t> tensors_fused_total{0};
+  std::atomic<int64_t> bytes_fused_total{0};
+
+  // Stall inspector fires (coordinator logs a missing-rank report) and
+  // straggler attribution reports emitted.
+  std::atomic<int64_t> stall_warnings_total{0};
+  std::atomic<int64_t> straggler_reports_total{0};
+
+  // Latency distributions.
+  Histogram negotiation_wait_us;  // enqueue -> fused response mapped back
+  Histogram ring_hop_us;          // one pipelined chunk exchange step
+  Histogram shm_fence_us;         // shm/hier dissemination-barrier fences
+
+  void Reset();
+
+  // Full registry as one JSON object.  extra_json, when non-empty, is a
+  // pre-rendered fragment (e.g. the coordinator's cluster view) spliced
+  // into the object as additional top-level members; it must start with
+  // a comma-free `"key":...` sequence.
+  std::string DumpJson(int rank, const std::string& extra_json) const;
+};
+
+MetricsRegistry& GlobalMetrics();
+
+inline bool MetricsOn() {
+  return GlobalMetrics().enabled.load(std::memory_order_relaxed);
+}
+
+// JSON string-body escaping shared by the timeline writer, the metrics
+// dump, and the error-string paths: quotes, backslashes, and all control
+// characters (< 0x20) become legal JSON escapes, so arbitrary tensor
+// names cannot corrupt a trace or dump.
+std::string JsonEscape(const std::string& s);
+
+}  // namespace hvdtpu

@@ -1,0 +1,214 @@
+// Self-test for the metrics registry (histogram bucketing, quantile
+// bounds, dump validity) and the timeline's JSON emission (hostile tensor
+// names: quotes, backslashes, control characters, and kilobyte-long names
+// that used to truncate the old fixed snprintf buffers mid-object).
+// Run via `make selftest` and tests/single/test_native_selftests.py.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+
+#include "metrics.h"
+#include "timeline.h"
+
+// Logging hooks normally provided by core_api.cc.
+namespace hvdtpu {
+int GetLogLevel() { return 5; }
+void SetLogLevel(int) {}
+}  // namespace hvdtpu
+
+using hvdtpu::GlobalMetrics;
+using hvdtpu::Histogram;
+using hvdtpu::JsonEscape;
+using hvdtpu::Timeline;
+
+namespace {
+
+// Minimal structural JSON validator: balanced containers, legal string
+// escapes, no raw control characters inside strings.  Enough to prove a
+// trace/dump would survive a real parser without linking one.
+bool ValidJson(const std::string& s, std::string* why) {
+  std::string stack;
+  bool in_string = false;
+  for (size_t i = 0; i < s.size(); ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    if (in_string) {
+      if (c < 0x20) {
+        *why = "raw control char inside string at offset " +
+               std::to_string(i);
+        return false;
+      }
+      if (c == '\\') {
+        if (i + 1 >= s.size()) {
+          *why = "dangling backslash";
+          return false;
+        }
+        char n = s[i + 1];
+        if (std::strchr("\"\\/bfnrtu", n) == nullptr) {
+          *why = std::string("illegal escape \\") + n;
+          return false;
+        }
+        i += (n == 'u') ? 5 : 1;
+      } else if (c == '"') {
+        in_string = false;
+      }
+      continue;
+    }
+    switch (c) {
+      case '"': in_string = true; break;
+      case '{': case '[': stack.push_back(static_cast<char>(c)); break;
+      case '}':
+        if (stack.empty() || stack.back() != '{') {
+          *why = "unbalanced } at offset " + std::to_string(i);
+          return false;
+        }
+        stack.pop_back();
+        break;
+      case ']':
+        if (stack.empty() || stack.back() != '[') {
+          *why = "unbalanced ] at offset " + std::to_string(i);
+          return false;
+        }
+        stack.pop_back();
+        break;
+      default: break;
+    }
+  }
+  if (in_string) {
+    *why = "unterminated string";
+    return false;
+  }
+  if (!stack.empty()) {
+    *why = "unclosed containers: " + stack;
+    return false;
+  }
+  return true;
+}
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path);
+  std::ostringstream os;
+  os << f.rdbuf();
+  return os.str();
+}
+
+#define CHECK(cond, msg)                          \
+  do {                                            \
+    if (!(cond)) {                                \
+      std::printf("FAIL: %s\n", msg);             \
+      return 1;                                   \
+    }                                             \
+  } while (0)
+
+}  // namespace
+
+int main() {
+  // -- JsonEscape ----------------------------------------------------------
+  {
+    std::string nasty = "w[\"0\"]\\path\nend\ttab";
+    nasty.push_back('\x01');
+    std::string esc = JsonEscape(nasty);
+    CHECK(esc == "w[\\\"0\\\"]\\\\path\\nend\\ttab\\u0001",
+          "JsonEscape output mismatch");
+    std::string why;
+    CHECK(ValidJson("{\"k\":\"" + esc + "\"}", &why),
+          "escaped string does not form valid JSON");
+  }
+
+  // -- Histogram bucketing + quantiles -------------------------------------
+  {
+    Histogram h;
+    h.ObserveUs(0);
+    CHECK(h.buckets[0].load() == 1, "0us must land in bucket 0");
+    h.ObserveUs(1);   // [1,2) -> bucket 1
+    h.ObserveUs(3);   // [2,4) -> bucket 2
+    CHECK(h.buckets[1].load() == 1 && h.buckets[2].load() == 1,
+          "power-of-two bucket placement wrong");
+    h.Reset();
+    for (int i = 0; i < 1000; ++i) h.ObserveUs(1000);  // bucket ub 1024
+    CHECK(h.count.load() == 1000 && h.sum_us.load() == 1000000,
+          "count/sum accounting wrong");
+    CHECK(h.QuantileUs(0.5) == 1024 && h.QuantileUs(0.99) == 1024,
+          "quantile must return the occupied bucket's upper bound");
+    h.ObserveUs(200000);  // one 200ms outlier: p50 unchanged, p99 unchanged
+    CHECK(h.QuantileUs(0.5) == 1024, "median moved on a single outlier");
+    CHECK(h.QuantileUs(1.0) == 262144, "max quantile must see the outlier");
+    // Overflow bucket: beyond the largest finite upper bound.
+    Histogram o;
+    o.ObserveUs(int64_t{1} << 40);
+    CHECK(o.buckets[Histogram::kNumBuckets - 1].load() == 1,
+          "huge value must land in the overflow bucket");
+    std::string why;
+    CHECK(ValidJson(h.Json(), &why), "histogram JSON invalid");
+  }
+
+  // -- Registry dump -------------------------------------------------------
+  {
+    auto& m = GlobalMetrics();
+    m.Reset();
+    m.enabled.store(true);
+    m.cycle_count.fetch_add(7);
+    m.cycle_busy_us.fetch_add(123);
+    m.responses_total.fetch_add(2);
+    m.tensors_fused_total.fetch_add(50);
+    m.bytes_fused_total.fetch_add(1 << 20);
+    m.negotiation_wait_us.ObserveUs(500);
+    std::string dump = m.DumpJson(3, "");
+    std::string why;
+    CHECK(ValidJson(dump, &why), "registry dump invalid JSON");
+    CHECK(dump.find("\"rank\":3") != std::string::npos, "rank missing");
+    CHECK(dump.find("\"cycle_count\":7") != std::string::npos,
+          "counter missing from dump");
+    CHECK(dump.find("\"negotiation_wait_us\":{\"count\":1") !=
+              std::string::npos,
+          "histogram missing from dump");
+    // Extra fragment splices as additional top-level members.
+    std::string with_extra = m.DumpJson(0, "\"cluster\":{},\"x\":1");
+    CHECK(ValidJson(with_extra, &why), "dump with extra fragment invalid");
+    CHECK(with_extra.find("\"cluster\":{}") != std::string::npos,
+          "extra fragment not spliced");
+    m.enabled.store(false);
+    m.Reset();
+  }
+
+  // -- Timeline emission with hostile tensor names -------------------------
+  {
+    std::string path = "/tmp/hvd_metrics_selftest_timeline.json";
+    Timeline t;
+    t.SetRank(2);
+    t.Start(path, /*mark_cycles=*/true);
+    std::string nasty = "w[\"0\"]\\b\n";
+    t.Begin(nasty, "NEGOTIATE");
+    t.End(nasty, "NEGOTIATE");
+    std::string huge(2000, 'x');  // old 512-byte buffer truncated this
+    huge += "\"tail";
+    t.Begin(huge, "NEGOTIATE");
+    t.End(huge, "NEGOTIATE");
+    t.MarkCycle();
+    t.Instant("RENDEZVOUS");
+    t.Stop();
+    std::string trace = ReadFile(path);
+    std::remove(path.c_str());
+    CHECK(!trace.empty(), "timeline wrote nothing");
+    std::string why;
+    if (!ValidJson(trace, &why)) {
+      std::printf("FAIL: timeline trace invalid JSON: %s\n", why.c_str());
+      return 1;
+    }
+    CHECK(trace.find("w[\\\"0\\\"]\\\\b\\n") != std::string::npos,
+          "hostile tensor name not escaped in trace");
+    CHECK(trace.find(huge.substr(0, 1900)) != std::string::npos,
+          "long tensor name truncated");
+    CHECK(trace.find("\"CLOCK_SYNC\"") != std::string::npos &&
+              trace.find("\"rank\":2") != std::string::npos,
+          "CLOCK_SYNC anchor with rank missing");
+    CHECK(trace.find("\"RENDEZVOUS\"") != std::string::npos,
+          "RENDEZVOUS instant missing");
+  }
+
+  std::printf("PASS\n");
+  return 0;
+}

@@ -21,9 +21,10 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-// v6: wire_comp codec byte in responses (v5 added the host key in the
-// rendezvous HELLO/book + the hier bit in responses)
-constexpr int32_t kProtocolVersion = 6;
+// v7: metrics snapshot trailer on worker CYCLE frames (v6 added the
+// wire_comp codec byte in responses, v5 the host key in the rendezvous
+// HELLO/book + the hier bit in responses)
+constexpr int32_t kProtocolVersion = 7;
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -93,6 +94,30 @@ SocketController::SocketController(const CoreConfig& cfg)
     char* end = nullptr;
     long long v = std::strtoll(env, &end, 10);
     if (end && *end == '\0' && v >= 0) wire_comp_floor_ = v;
+  }
+  // Metrics-plane knobs (coordinator-side straggler attribution).
+  if (const char* env = ::getenv("HOROVOD_METRICS_REPORT_SECONDS")) {
+    char* end = nullptr;
+    double v = std::strtod(env, &end);
+    if (end && *end == '\0' && v > 0) metrics_report_s_ = v;
+  }
+  if (const char* env = ::getenv("HOROVOD_STRAGGLER_SKEW")) {
+    char* end = nullptr;
+    double v = std::strtod(env, &end);
+    if (end && *end == '\0' && v > 1.0) straggler_skew_ = v;
+  }
+  if (const char* env = ::getenv("HOROVOD_STRAGGLER_MIN_MS")) {
+    char* end = nullptr;
+    double v = std::strtod(env, &end);
+    if (end && *end == '\0' && v >= 0) straggler_min_us_ = v * 1000.0;
+  }
+  if (is_coordinator()) {
+    cluster_.resize(cfg.size);
+    announce_prev_.assign(cfg.size, {0, 0});
+    announce_lag_.reserve(cfg.size);
+    for (int i = 0; i < cfg.size; ++i) {
+      announce_lag_.push_back(std::make_unique<Histogram>());
+    }
   }
 }
 
@@ -508,6 +533,7 @@ void SocketController::Announce(int rank, TensorRequest req,
     p.first_seen = MonotonicSeconds();
     p.announced.insert(rank);
     pending_.emplace(req.name, std::move(p));
+    RecordAnnounceLag(rank, 0.0);  // first announcer defines t=0
     return;
   }
   // Cross-rank consistency validation (reference: ComputeResponseList's
@@ -563,7 +589,11 @@ void SocketController::Announce(int rank, TensorRequest req,
   // rank's capability bit — deliberately NOT a mismatch error (a host
   // numpy on one rank simply demotes the collective to the host plane).
   p.meta.device = p.meta.device & req.device;
-  p.announced.insert(rank);
+  if (p.announced.insert(rank).second) {
+    // How long after the tensor's first announcement this rank's own
+    // arrived: the culprit-side signal the straggler report ranks by.
+    RecordAnnounceLag(rank, MonotonicSeconds() - p.first_seen);
+  }
 }
 
 void SocketController::AddTombstone(const std::string& name,
@@ -631,6 +661,22 @@ Status SocketController::CoordinatorCycle(
     int32_t n_full = rd.GetI32();
     for (int32_t i = 0; i < n_full; ++i) {
       Announce(rank, DeserializeRequest(&rd), &errors);
+    }
+    // v7 trailer: the worker's piggybacked metrics snapshot (cumulative;
+    // absent marker when its registry is disabled).
+    int32_t has_metrics = rd.GetI32();
+    if (has_metrics == 1) {
+      RankMetricsSnapshot s;
+      s.neg_count = rd.GetI64();
+      s.neg_sum_us = rd.GetI64();
+      s.neg_p50_us = rd.GetI64();
+      s.neg_p99_us = rd.GetI64();
+      s.cycle_busy_us = rd.GetI64();
+      s.cycle_idle_us = rd.GetI64();
+      s.cycle_count = rd.GetI64();
+      s.updated_at = MonotonicSeconds();
+      std::lock_guard<std::mutex> l(metrics_mu_);
+      cluster_[rank] = s;
     }
   }
 
@@ -764,7 +810,101 @@ Status SocketController::CoordinatorCycle(
                                std::to_string(rank));
     }
   }
+  if (MetricsOn()) {
+    double now = MonotonicSeconds();
+    FillSelfSnapshot(now);
+    MaybeStragglerReport(now);
+  }
   return Status::OK();
+}
+
+void SocketController::RecordAnnounceLag(int rank, double lag_s) {
+  if (!MetricsOn()) return;
+  if (rank < 0 || rank >= static_cast<int>(announce_lag_.size())) return;
+  announce_lag_[rank]->ObserveSeconds(lag_s);
+}
+
+void SocketController::FillSelfSnapshot(double now) {
+  const auto& m = GlobalMetrics();
+  RankMetricsSnapshot s;
+  s.neg_count = m.negotiation_wait_us.count.load(std::memory_order_relaxed);
+  s.neg_sum_us = m.negotiation_wait_us.sum_us.load(std::memory_order_relaxed);
+  s.neg_p50_us = m.negotiation_wait_us.QuantileUs(0.5);
+  s.neg_p99_us = m.negotiation_wait_us.QuantileUs(0.99);
+  s.cycle_busy_us = m.cycle_busy_us.load(std::memory_order_relaxed);
+  s.cycle_idle_us = m.cycle_idle_us.load(std::memory_order_relaxed);
+  s.cycle_count = m.cycle_count.load(std::memory_order_relaxed);
+  s.updated_at = now;
+  std::lock_guard<std::mutex> l(metrics_mu_);
+  if (!cluster_.empty()) cluster_[0] = s;
+}
+
+void SocketController::MaybeStragglerReport(double now) {
+  if (cfg_.size < 2 || announce_lag_.empty()) return;
+  if (now - last_metrics_report_ < metrics_report_s_) return;
+  last_metrics_report_ = now;
+  // Mean announce lag per rank over the window since the last report.
+  std::vector<double> mean_us(cfg_.size, 0.0);
+  std::vector<int64_t> window_count(cfg_.size, 0);
+  int64_t any = 0;
+  for (int r = 0; r < cfg_.size; ++r) {
+    int64_t c = announce_lag_[r]->count.load(std::memory_order_relaxed);
+    int64_t s = announce_lag_[r]->sum_us.load(std::memory_order_relaxed);
+    int64_t dc = c - announce_prev_[r].first;
+    int64_t ds = s - announce_prev_[r].second;
+    announce_prev_[r] = {c, s};
+    if (dc > 0) mean_us[r] = static_cast<double>(ds) / dc;
+    window_count[r] = dc;
+    any += dc;
+  }
+  if (any == 0) return;
+  std::vector<double> sorted = mean_us;
+  std::sort(sorted.begin(), sorted.end());
+  double median = sorted[sorted.size() / 2];
+  double threshold = std::max(straggler_skew_ * median, straggler_min_us_);
+  std::ostringstream os;
+  bool found = false;
+  for (int r = 0; r < cfg_.size; ++r) {
+    if (window_count[r] == 0 || mean_us[r] <= threshold) continue;
+    if (found) os << "; ";
+    found = true;
+    const std::string host =
+        r < static_cast<int>(host_keys_.size()) ? host_keys_[r] : "?";
+    os << "rank " << r << " (host " << host << "): negotiation lag mean="
+       << static_cast<int64_t>(mean_us[r] / 1000) << "ms p50="
+       << announce_lag_[r]->QuantileUs(0.5) / 1000 << "ms p99="
+       << announce_lag_[r]->QuantileUs(0.99) / 1000
+       << "ms vs fleet median " << static_cast<int64_t>(median / 1000)
+       << "ms";
+  }
+  if (!found) return;
+  std::string report = "straggler report: " + os.str();
+  GlobalMetrics().straggler_reports_total.fetch_add(1,
+                                                    std::memory_order_relaxed);
+  HVD_LOG(WARNING) << report;
+  std::lock_guard<std::mutex> l(metrics_mu_);
+  straggler_report_ = std::move(report);
+}
+
+std::string SocketController::ClusterMetricsJson() {
+  if (!is_coordinator()) return "";
+  std::ostringstream os;
+  std::lock_guard<std::mutex> l(metrics_mu_);
+  os << "\"cluster\":{";
+  for (size_t r = 0; r < cluster_.size(); ++r) {
+    const auto& s = cluster_[r];
+    if (r) os << ',';
+    os << "\"" << r << "\":{\"neg_count\":" << s.neg_count
+       << ",\"neg_sum_us\":" << s.neg_sum_us
+       << ",\"neg_p50_us\":" << s.neg_p50_us
+       << ",\"neg_p99_us\":" << s.neg_p99_us
+       << ",\"cycle_busy_us\":" << s.cycle_busy_us
+       << ",\"cycle_idle_us\":" << s.cycle_idle_us
+       << ",\"cycle_count\":" << s.cycle_count
+       << ",\"updated_at\":" << s.updated_at << "}";
+  }
+  os << "},\"straggler_report\":\"" << JsonEscape(straggler_report_) << "\"";
+  return os.str();
 }
 
 Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
@@ -793,6 +933,22 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   }
   w.PutI32(static_cast<int32_t>(full.size()));
   for (const auto* r : full) SerializeRequest(*r, &w);
+  // v7 trailer: piggyback this rank's metrics snapshot (cumulative) on
+  // the cycle frame it sends anyway — the coordinator's cluster view
+  // costs no extra round trips.
+  if (MetricsOn()) {
+    const auto& m = GlobalMetrics();
+    w.PutI32(1);
+    w.PutI64(m.negotiation_wait_us.count.load(std::memory_order_relaxed));
+    w.PutI64(m.negotiation_wait_us.sum_us.load(std::memory_order_relaxed));
+    w.PutI64(m.negotiation_wait_us.QuantileUs(0.5));
+    w.PutI64(m.negotiation_wait_us.QuantileUs(0.99));
+    w.PutI64(m.cycle_busy_us.load(std::memory_order_relaxed));
+    w.PutI64(m.cycle_idle_us.load(std::memory_order_relaxed));
+    w.PutI64(m.cycle_count.load(std::memory_order_relaxed));
+  } else {
+    w.PutI32(0);
+  }
   ctrl_sent_.fetch_add(w.data().size(), std::memory_order_relaxed);
   if (!coord_ctrl_.SendFrame(w.data())) {
     aborted_ = true;
@@ -994,6 +1150,7 @@ Status SocketController::ChunkedStep(
   const int64_t hdr = static_cast<int64_t>(w.data().size());
   CountSend(send_to, send_len + hdr,
             (raw_len < 0 ? send_len : raw_len) + hdr);
+  const double hop_t0 = MetricsOn() ? MonotonicSeconds() : 0.0;
   ChunkExchangeError err;
   if (!ChunkedDuplexExchange(socks[send_to], send_base, send_len,
                              socks[recv_from], recv_len, chunk_bytes,
@@ -1023,6 +1180,9 @@ Status SocketController::ChunkedStep(
                          "pipelined ring exchange failed (send->" +
                              std::to_string(send_to) + ", recv<-" +
                              std::to_string(recv_from) + ")");
+  }
+  if (hop_t0 > 0.0) {
+    GlobalMetrics().ring_hop_us.ObserveSeconds(MonotonicSeconds() - hop_t0);
   }
   return Status::OK();
 }
@@ -1729,6 +1889,11 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
                                      const std::vector<int>& members,
                                      int idx, int32_t tag_base) {
   const int m = static_cast<int>(members.size());
+  // Fence-wait metric: only the shm/hier phase fences (tag families at or
+  // above kTagShmSize) — the public Barrier() is a user-visible collective,
+  // not plane bookkeeping.
+  const double fence_t0 =
+      tag_base >= kTagShmSize && MetricsOn() ? MonotonicSeconds() : 0.0;
   // Dissemination barrier: ceil(log2(m)) duplex rounds.
   for (int k = 1; k < m; k <<= 1) {
     const int to = members[(idx + k) % m];
@@ -1741,6 +1906,9 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
     Reader rd(frame);
     st = CheckFrameHeader(&rd, tag_base + k, "barrier");
     if (!st.ok()) return st;
+  }
+  if (fence_t0 > 0.0) {
+    GlobalMetrics().shm_fence_us.ObserveSeconds(MonotonicSeconds() - fence_t0);
   }
   return Status::OK();
 }

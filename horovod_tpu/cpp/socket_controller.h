@@ -37,6 +37,7 @@
 
 #include "common.h"
 #include "controller.h"
+#include "metrics.h"
 #include "response_cache.h"
 #include "shm_plane.h"
 #include "socketio.h"
@@ -140,7 +141,53 @@ class SocketController : public Controller {
     *raw_xhost = data_raw_xhost_.load(std::memory_order_relaxed);
   }
 
+  // Coordinator-only JSON fragment for hvd_metrics_dump: the per-rank
+  // cluster view built from the snapshots each worker piggybacks on its
+  // CYCLE frame (protocol v7), plus the latest straggler attribution
+  // report.  Workers return "".
+  std::string ClusterMetricsJson();
+
  private:
+  // Compact per-rank metrics snapshot, piggybacked worker->coordinator on
+  // every CYCLE frame (protocol v7) and refreshed for rank 0 locally.
+  // All values are cumulative since init; the straggler check differences
+  // them per report window.
+  struct RankMetricsSnapshot {
+    int64_t neg_count = 0;
+    int64_t neg_sum_us = 0;
+    int64_t neg_p50_us = 0;
+    int64_t neg_p99_us = 0;
+    int64_t cycle_busy_us = 0;
+    int64_t cycle_idle_us = 0;
+    int64_t cycle_count = 0;
+    double updated_at = 0;
+  };
+  // Coordinator-side straggler attribution: per-rank announce lag = how
+  // long after a tensor's FIRST announcement this rank's own announcement
+  // arrived (the rank consistently announcing last IS the straggler —
+  // every other rank's negotiation wait measures the victim side, not the
+  // culprit).  Checked every metrics_report_s_; ranks whose mean window
+  // lag exceeds max(straggler_skew_ x fleet median, straggler_min_us_)
+  // are named with host, p50/p99 and the fleet median.
+  void RecordAnnounceLag(int rank, double lag_s);
+  void MaybeStragglerReport(double now);
+  void FillSelfSnapshot(double now);
+
+  std::mutex metrics_mu_;  // guards cluster_ + straggler_report_ (the
+                           // background thread writes, hvd_metrics_dump
+                           // reads from the Python thread)
+  std::vector<RankMetricsSnapshot> cluster_;           // coordinator, by rank
+  std::vector<std::unique_ptr<Histogram>> announce_lag_;  // coordinator
+  // Cumulative (count, sum_us) per rank at the last report, for deltas.
+  std::vector<std::pair<int64_t, int64_t>> announce_prev_;
+  std::string straggler_report_;
+  double last_metrics_report_ = 0;
+  // HOROVOD_METRICS_REPORT_SECONDS / HOROVOD_STRAGGLER_SKEW /
+  // HOROVOD_STRAGGLER_MIN_MS (ctor reads the env, like ring_chunk_bytes_).
+  double metrics_report_s_ = 30.0;
+  double straggler_skew_ = 3.0;
+  double straggler_min_us_ = 5000.0;
+
   // Negotiation ctrl-channel payload byte counters (background thread
   // writes, Python reads — relaxed atomics suffice for monotone counters).
   std::atomic<int64_t> ctrl_sent_{0};

@@ -1,28 +1,12 @@
 #include "timeline.h"
 
+#include <chrono>
 #include <functional>
 
 #include "common.h"
+#include "metrics.h"
 
 namespace hvdtpu {
-
-namespace {
-std::string JsonEscape(const std::string& s) {
-  std::string out;
-  out.reserve(s.size() + 8);
-  for (char c : s) {
-    if (c == '"' || c == '\\') {
-      out.push_back('\\');
-      out.push_back(c);
-    } else if (static_cast<unsigned char>(c) < 0x20) {
-      out += ' ';
-    } else {
-      out.push_back(c);
-    }
-  }
-  return out;
-}
-}  // namespace
 
 Timeline::~Timeline() { Stop(); }
 
@@ -37,6 +21,16 @@ void Timeline::Start(const std::string& path, bool mark_cycles) {
   mark_cycles_ = mark_cycles;
   shutdown_ = false;
   enabled_ = true;
+  // Anchor event: wall clock at trace ts≈0, so merge_timeline.py can put
+  // per-rank traces on one axis.  Pushed straight onto the queue — Emit()
+  // would re-take mu_.
+  int64_t unix_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                        std::chrono::system_clock::now().time_since_epoch())
+                        .count();
+  queue_.push_back("{\"name\":\"CLOCK_SYNC\",\"ph\":\"i\",\"ts\":0,"
+                   "\"pid\":0,\"tid\":0,\"s\":\"p\",\"args\":{\"rank\":" +
+                   std::to_string(rank_) + ",\"unix_us\":" +
+                   std::to_string(unix_us) + "}}");
   writer_ = std::thread([this] { WriterLoop(); });
 }
 
@@ -93,34 +87,25 @@ void Timeline::WriterLoop() {
 void Timeline::Begin(const std::string& tensor, const std::string& phase) {
   if (!enabled_) return;
   int64_t tid = static_cast<int64_t>(std::hash<std::string>{}(tensor) & 0x7fffffff);
-  char buf[512];
-  std::snprintf(buf, sizeof(buf),
-                "{\"name\":\"%s\",\"ph\":\"B\",\"ts\":%lld,\"pid\":0,"
-                "\"tid\":%lld,\"args\":{\"tensor\":\"%s\"}}",
-                JsonEscape(phase).c_str(), static_cast<long long>(NowUs()),
-                static_cast<long long>(tid), JsonEscape(tensor).c_str());
-  Emit(buf);
+  Emit("{\"name\":\"" + JsonEscape(phase) +
+       "\",\"ph\":\"B\",\"ts\":" + std::to_string(NowUs()) +
+       ",\"pid\":0,\"tid\":" + std::to_string(tid) +
+       ",\"args\":{\"tensor\":\"" + JsonEscape(tensor) + "\"}}");
 }
 
 void Timeline::End(const std::string& tensor, const std::string& phase) {
   if (!enabled_) return;
   int64_t tid = static_cast<int64_t>(std::hash<std::string>{}(tensor) & 0x7fffffff);
-  char buf[256];
-  std::snprintf(buf, sizeof(buf),
-                "{\"name\":\"%s\",\"ph\":\"E\",\"ts\":%lld,\"pid\":0,\"tid\":%lld}",
-                JsonEscape(phase).c_str(), static_cast<long long>(NowUs()),
-                static_cast<long long>(tid));
-  Emit(buf);
+  Emit("{\"name\":\"" + JsonEscape(phase) +
+       "\",\"ph\":\"E\",\"ts\":" + std::to_string(NowUs()) +
+       ",\"pid\":0,\"tid\":" + std::to_string(tid) + "}");
 }
 
 void Timeline::Instant(const std::string& name) {
   if (!enabled_) return;
-  char buf[256];
-  std::snprintf(buf, sizeof(buf),
-                "{\"name\":\"%s\",\"ph\":\"i\",\"ts\":%lld,\"pid\":0,\"tid\":0,"
-                "\"s\":\"p\"}",
-                JsonEscape(name).c_str(), static_cast<long long>(NowUs()));
-  Emit(buf);
+  Emit("{\"name\":\"" + JsonEscape(name) +
+       "\",\"ph\":\"i\",\"ts\":" + std::to_string(NowUs()) +
+       ",\"pid\":0,\"tid\":0,\"s\":\"p\"}");
 }
 
 void Timeline::MarkCycle() {

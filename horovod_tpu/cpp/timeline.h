@@ -18,6 +18,11 @@ class Timeline {
   void Stop();
   bool enabled() const { return enabled_; }
 
+  // Rank recorded in the CLOCK_SYNC anchor event Start() emits, which
+  // tools/merge_timeline.py uses to align per-rank traces (each rank's
+  // ts is relative to its own Start; the anchor carries wall-clock us).
+  void SetRank(int rank) { rank_ = rank; }
+
   // Phase events keyed by tensor name (B/E pairs on a per-tensor lane).
   void Begin(const std::string& tensor, const std::string& phase);
   void End(const std::string& tensor, const std::string& phase);
@@ -31,6 +36,7 @@ class Timeline {
 
   bool enabled_ = false;
   bool mark_cycles_ = false;
+  int rank_ = -1;
   double t0_ = 0.0;
   FILE* file_ = nullptr;
   bool first_event_ = true;

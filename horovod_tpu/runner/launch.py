@@ -63,6 +63,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--cache-capacity", type=int, default=None)
     p.add_argument("--timeline-filename", default=None)
     p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--metrics-file", default=None,
+                   help="periodic per-rank JSON metrics snapshots; a "
+                        "literal {rank} in the path is substituted, "
+                        "otherwise .<rank> is appended "
+                        "(HOROVOD_METRICS_FILE; implies HOROVOD_METRICS)")
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
     p.add_argument("--hierarchical-allreduce", action="store_true",
@@ -123,6 +128,8 @@ def _apply_config_file(args: argparse.Namespace,
     tl = cfg.get("timeline") or {}
     flat["timeline_filename"] = tl.get("filename")
     flat["timeline_mark_cycles"] = tl.get("mark-cycles")
+    mt = cfg.get("metrics") or {}
+    flat["metrics_file"] = mt.get("file")
     at = cfg.get("autotune") or {}
     flat["autotune"] = at.get("enabled")
     flat["autotune_log_file"] = at.get("log-file")
@@ -161,6 +168,8 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_TIMELINE"] = args.timeline_filename
     if args.timeline_mark_cycles:
         env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.metrics_file:
+        env["HOROVOD_METRICS_FILE"] = args.metrics_file
     if args.autotune:
         env["HOROVOD_AUTOTUNE"] = "1"
     if args.autotune_log_file:

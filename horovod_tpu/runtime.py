@@ -200,6 +200,11 @@ class CoreBackend:
         return {"data_sent_local": 0, "data_sent_xhost": 0,
                 "data_raw_local": 0, "data_raw_xhost": 0}
 
+    def metrics(self) -> dict:
+        """Local metrics registry (counters + histograms) as a dict; empty
+        for backends without the native registry."""
+        return {}
+
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError
 

@@ -127,6 +127,14 @@ class Config:
     # Observability.
     timeline_path: Optional[str] = None
     timeline_mark_cycles: bool = False
+    # HOROVOD_METRICS: native counter/histogram registry (negotiation wait,
+    # cycle occupancy, fusion efficiency, ring hops, shm fences).  Setting
+    # HOROVOD_METRICS_FILE implies enabled; a literal "{rank}" in the path
+    # is substituted, otherwise ".<rank>" is appended so ranks never clobber
+    # each other on a shared filesystem.
+    metrics_enabled: bool = False
+    metrics_file: Optional[str] = None
+    metrics_interval_s: float = 10.0
     log_level: str = "warning"
 
     # Stall inspector.
@@ -181,6 +189,11 @@ class Config:
             wire_compression=get_wire_compression(),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
+            metrics_enabled=get_bool(
+                "HOROVOD_METRICS", bool(env.get("HOROVOD_METRICS_FILE"))
+            ),
+            metrics_file=env.get("HOROVOD_METRICS_FILE"),
+            metrics_interval_s=get_float("HOROVOD_METRICS_INTERVAL", 10.0),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             stall_check_enabled=not get_bool("HOROVOD_STALL_CHECK_DISABLE", False),
             stall_warning_s=get_float(

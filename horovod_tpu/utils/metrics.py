@@ -1,0 +1,54 @@
+"""Prometheus text-exposition rendering of the native metrics dump.
+
+Pure formatting, no scrape server: the caller decides how to expose the
+text (write to a file a node_exporter textfile-collector picks up, or serve
+it from an existing HTTP endpoint).  Naming scheme (docs/observability.md):
+
+- counters ->  ``hvd_<name>_total{rank="R"}``  (a trailing ``_total`` in
+  the native counter name is not doubled)
+- histograms -> ``hvd_<name>_bucket{rank="R",le="<2^i>"}`` cumulative
+  series per power-of-two microsecond bucket, a ``le="+Inf"`` overflow
+  series, plus ``hvd_<name>_sum`` / ``hvd_<name>_count``
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def _counter_name(name: str) -> str:
+    base = name[:-6] if name.endswith("_total") else name
+    return f"hvd_{base}_total"
+
+
+def render_prometheus(dump: Dict) -> str:
+    """Render a ``hvd.metrics()`` dict as Prometheus exposition text.
+
+    Only the local ``counters`` / ``histograms`` sections are rendered (the
+    coordinator's ``cluster`` view is rank-0-only and already labelled
+    per-rank at its source scrape).  An empty or disabled dump renders "".
+    """
+    if not dump:
+        return ""
+    rank = dump.get("rank", 0)
+    lines: List[str] = []
+    for name, value in sorted((dump.get("counters") or {}).items()):
+        metric = _counter_name(name)
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f'{metric}{{rank="{rank}"}} {int(value)}')
+    for name, h in sorted((dump.get("histograms") or {}).items()):
+        metric = f"hvd_{name}"
+        buckets = h.get("buckets") or []
+        lines.append(f"# TYPE {metric} histogram")
+        cum = 0
+        for i, b in enumerate(buckets):
+            cum += int(b)
+            if i == len(buckets) - 1:
+                le = "+Inf"  # native overflow bucket
+            else:
+                # bucket 0 is [0,1us); bucket i covers [2^(i-1), 2^i) us.
+                le = str(1 << i)
+            lines.append(f'{metric}_bucket{{rank="{rank}",le="{le}"}} {cum}')
+        lines.append(f'{metric}_sum{{rank="{rank}"}} {int(h.get("sum_us", 0))}')
+        lines.append(f'{metric}_count{{rank="{rank}"}} {int(h.get("count", 0))}')
+    return "\n".join(lines) + "\n" if lines else ""

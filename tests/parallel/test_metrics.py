@@ -1,0 +1,180 @@
+"""Cluster-wide metrics plane at np=4 over two fake hosts: local registry
+population, HOROVOD_METRICS_FILE snapshots, agreement between the
+negotiation-wait histogram and the timeline's NEGOTIATE spans (both are
+observed at the same point in the background loop, so they must agree
+closely), coordinator aggregation of the per-rank snapshots piggybacked on
+CYCLE frames (protocol v7), straggler attribution of an artificially
+delayed rank, and a merged multi-rank Perfetto trace out of
+tools/merge_timeline.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import run
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FAKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HOROVOD_HIER_FAKE_HOSTS": "2",
+}
+
+
+def _metrics_worker(tmpdir: str):
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    hvd.start_timeline(os.path.join(tmpdir, f"timeline.{r}.json"))
+    for i in range(30):
+        out = hvd.allreduce(np.full(64, float(r), np.float32), op=hvd.Sum,
+                            name=f"t.{i % 10}")
+        np.testing.assert_allclose(out, s * (s - 1) / 2.0)
+    hvd.barrier()
+    m = hvd.metrics()
+    prom = hvd.metrics_prometheus()
+    hvd.stop_timeline()
+    hvd.shutdown()
+    return {"rank": r, "metrics": m, "prometheus": prom}
+
+
+def _negotiate_span_sum_us(path: str) -> float:
+    """Sum of NEGOTIATE span durations in one rank's timeline, matching
+    B/E pairs per tid (the tensor-name hash)."""
+    with open(path) as f:
+        events = json.load(f)
+    open_ts = {}
+    total = 0.0
+    for e in events:
+        if e.get("name") != "NEGOTIATE":
+            continue
+        key = e.get("tid")
+        if e.get("ph") == "B":
+            open_ts[key] = e["ts"]
+        elif e.get("ph") == "E" and key in open_ts:
+            total += e["ts"] - open_ts.pop(key)
+    return total
+
+
+def test_metrics_registry_files_timeline_agreement_and_merge(tmp_path):
+    tmpdir = str(tmp_path)
+    env = dict(FAKE_ENV,
+               HOROVOD_METRICS="1",
+               HOROVOD_METRICS_FILE=os.path.join(tmpdir, "metrics.{rank}"),
+               HOROVOD_METRICS_INTERVAL="0.2")
+    res = run(_metrics_worker, args=(tmpdir,), np=4, env=env)
+    assert [r["rank"] for r in res] == [0, 1, 2, 3]
+
+    for r in res:
+        m = r["metrics"]
+        # Registry populated: the background loop ticked, tensors fused,
+        # every negotiation waited a measurable time.
+        assert m["enabled"], m
+        c = m["counters"]
+        assert c["cycle_count"] > 0 and c["cycle_busy_us"] >= 0
+        assert c["responses_total"] > 0
+        assert c["tensors_fused_total"] >= 30
+        assert c["bytes_fused_total"] > 0
+        neg = m["histograms"]["negotiation_wait_us"]
+        assert neg["count"] >= 30
+        assert neg["sum_us"] > 0
+        assert sum(neg["buckets"]) == neg["count"]
+        # Prometheus rendering of the same snapshot.
+        prom = r["prometheus"]
+        assert f'hvd_cycle_count_total{{rank="{r["rank"]}"}}' in prom
+        assert "hvd_negotiation_wait_us_bucket" in prom
+        assert 'le="+Inf"' in prom
+
+    # Coordinator aggregation (protocol v7 piggyback): rank 0's dump
+    # carries a populated per-rank cluster view.
+    cluster = res[0]["metrics"]["cluster"]
+    assert set(cluster) == {"0", "1", "2", "3"}
+    for rank_key, snap in cluster.items():
+        assert snap["neg_count"] > 0, (rank_key, snap)
+        assert snap["cycle_count"] > 0, (rank_key, snap)
+    # Workers carry no cluster view — it is coordinator state.
+    assert "cluster" not in res[1]["metrics"]
+
+    # HOROVOD_METRICS_FILE: each rank's snapshot exists ({rank} template),
+    # parses, and agrees with the worker-returned dump on identity.
+    for rank in range(4):
+        path = os.path.join(tmpdir, f"metrics.{rank}")
+        assert os.path.exists(path), os.listdir(tmpdir)
+        with open(path) as f:
+            snap = json.load(f)
+        assert snap["rank"] == rank
+        assert snap["counters"]["cycle_count"] > 0
+
+    # Timeline agreement: both numbers are taken at the same instant in
+    # the background loop (NEGOTIATE End <-> negotiation_wait observation),
+    # so their totals must agree within the 10% acceptance bound.
+    for r in res:
+        span_us = _negotiate_span_sum_us(
+            os.path.join(tmpdir, f"timeline.{r['rank']}.json"))
+        metric_us = r["metrics"]["histograms"]["negotiation_wait_us"][
+            "sum_us"]
+        assert span_us > 0
+        assert abs(span_us - metric_us) / span_us < 0.10, \
+            (r["rank"], span_us, metric_us)
+
+    # Merged multi-rank trace: one Perfetto-loadable JSON array with all
+    # four ranks as distinct, labelled processes.
+    merged_path = os.path.join(tmpdir, "merged.json")
+    inputs = [os.path.join(tmpdir, f"timeline.{r}.json") for r in range(4)]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "merge_timeline.py"),
+         *inputs, "-o", merged_path],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    with open(merged_path) as f:
+        merged = json.load(f)
+    pids = {e["pid"] for e in merged if e.get("ph") != "M"}
+    assert pids == {0, 1, 2, 3}
+    names = {(e["pid"], e["args"]["name"]) for e in merged
+             if e.get("name") == "process_name"}
+    assert names == {(r, f"rank {r}") for r in range(4)}
+    assert any(e.get("name") == "NEGOTIATE" for e in merged)
+
+
+def _straggler_worker(delay_rank: int, delay_s: float):
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    for i in range(15):
+        if r == delay_rank:
+            time.sleep(delay_s)
+        out = hvd.allreduce(np.full(32, 1.0, np.float32), op=hvd.Sum,
+                            name=f"st.{i}")
+        np.testing.assert_allclose(out, float(s))
+    hvd.barrier()
+    m = hvd.metrics()
+    hvd.shutdown()
+    return {"rank": r, "metrics": m}
+
+
+def test_straggler_report_names_delayed_rank():
+    env = dict(FAKE_ENV,
+               HOROVOD_METRICS="1",
+               HOROVOD_METRICS_REPORT_SECONDS="1",
+               HOROVOD_STRAGGLER_SKEW="2",
+               HOROVOD_STRAGGLER_MIN_MS="20")
+    res = run(_straggler_worker, args=(3, 0.15), np=4, env=env)
+    report = res[0]["metrics"].get("straggler_report", "")
+    assert "rank 3" in report, res[0]["metrics"]
+    # The on-time ranks must not be blamed.
+    for other in (1, 2):
+        assert f"rank {other}" not in report, report
+    assert res[0]["metrics"]["counters"]["straggler_reports_total"] > 0

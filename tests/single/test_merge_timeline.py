@@ -1,0 +1,102 @@
+"""tools/merge_timeline.py on synthetic per-rank traces with a known
+clock offset: rank identity from CLOCK_SYNC, RENDEZVOUS-based alignment
+(with CLOCK_SYNC unix_us as the fallback), pid rewriting + Perfetto
+process metadata, and repair of a truncated (crashed-rank) trace.
+"""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_spec = importlib.util.spec_from_file_location(
+    "merge_timeline", os.path.join(REPO, "tools", "merge_timeline.py"))
+mt = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(mt)
+
+
+def _trace(rank, rendezvous_ts, unix_us, spans, include_rendezvous=True):
+    """One synthetic per-rank trace: anchors + one B/E span pair each."""
+    events = [{"name": "CLOCK_SYNC", "ph": "i", "ts": 0, "pid": 0, "tid": 0,
+               "s": "p", "args": {"rank": rank, "unix_us": unix_us}}]
+    if include_rendezvous:
+        events.append({"name": "RENDEZVOUS", "ph": "i",
+                       "ts": rendezvous_ts, "pid": 0, "tid": 0, "s": "p"})
+    for ts, dur in spans:
+        events.append({"name": "NEGOTIATE", "ph": "B", "ts": ts, "pid": 0,
+                       "tid": 7, "args": {"tensor": "g"}})
+        events.append({"name": "NEGOTIATE", "ph": "E", "ts": ts + dur,
+                       "pid": 0, "tid": 7})
+    return events
+
+
+def _write(tmp_path, name, events, truncate=False):
+    path = str(tmp_path / name)
+    text = "[\n" + ",\n".join(json.dumps(e) for e in events)
+    if truncate:
+        # Crashed before Stop(): no closing bracket, event cut mid-object.
+        text += ',\n{"name":"NEGOTIATE","ph":"B","ts":99'
+    else:
+        text += "\n]\n"
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def test_rendezvous_alignment_known_offset(tmp_path):
+    # Rank 1's trace clock started 5000us later: its RENDEZVOUS reads
+    # 2000us where rank 0's reads 7000us.  After merging, the spans that
+    # happened simultaneously must land on identical timestamps.
+    p0 = _write(tmp_path, "t0.json",
+                _trace(0, 7000, 1_000_000, [(10000, 500)]))
+    p1 = _write(tmp_path, "t1.json",
+                _trace(1, 2000, 1_005_000, [(5000, 500)]))
+    merged = mt.merge([p0, p1])
+    spans = {e["pid"]: e["ts"] for e in merged
+             if e.get("name") == "NEGOTIATE" and e["ph"] == "B"}
+    assert spans == {0: 10000, 1: 10000}
+
+
+def test_clock_sync_fallback_and_rank_from_anchor(tmp_path):
+    # No RENDEZVOUS (timeline started manually after init): CLOCK_SYNC's
+    # wall-clock reading aligns instead.  File order is rank 1 first —
+    # identity must come from the anchor, not the argument order.
+    p1 = _write(tmp_path, "t1.json",
+                _trace(1, 0, 9_000_000, [(100, 50)],
+                       include_rendezvous=False))
+    p0 = _write(tmp_path, "t0.json",
+                _trace(0, 0, 9_004_000, [(100, 50)],
+                       include_rendezvous=False))
+    merged = mt.merge([p1, p0])
+    spans = {e["pid"]: e["ts"] for e in merged
+             if e.get("name") == "NEGOTIATE" and e["ph"] == "B"}
+    # Reference axis is the first input (rank 1); rank 0's clock started
+    # 4000us later, so its ts shifts by +4000.
+    assert spans == {1: 100, 0: 4100}
+    names = {e["pid"]: e["args"]["name"] for e in merged
+             if e.get("name") == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+
+
+def test_metadata_sorting_and_truncated_trace_repair(tmp_path):
+    p0 = _write(tmp_path, "t0.json", _trace(0, 1000, 0, [(2000, 100)]))
+    p1 = _write(tmp_path, "t1.json", _trace(1, 1000, 0, [(3000, 100)]),
+                truncate=True)
+    merged = mt.merge([p0, p1])
+    # The truncated file still contributes its complete events.
+    assert any(e["pid"] == 1 and e.get("name") == "NEGOTIATE"
+               for e in merged)
+    # Metadata first, then events in ts order; every event has a rank pid.
+    metas = [e for e in merged if e.get("ph") == "M"]
+    assert merged[: len(metas)] == metas
+    sort_idx = {e["pid"]: e["args"]["sort_index"] for e in metas
+                if e["name"] == "process_sort_index"}
+    assert sort_idx == {0: 0, 1: 1}
+    rest = merged[len(metas):]
+    assert [e["ts"] for e in rest] == sorted(e["ts"] for e in rest)
+    assert {e["pid"] for e in merged} == {0, 1}
+    # The whole merged list round-trips as plain JSON (Perfetto's loader
+    # accepts a bare event array).
+    json.loads(json.dumps(merged))

@@ -17,6 +17,12 @@ a large fp32 allreduce over two fake hosts with the hierarchical plane (so
 the codec engages on the cross-host leader ring), reporting cross-host
 wire bytes/step against the fp32 baseline and the max abs error the codec
 introduced.
+
+With --metrics an additional section reruns the cache_on configuration
+with HOROVOD_METRICS=1 and reports the registry's negotiation-throughput
+overhead against the metrics-off baseline (disabled is the baseline
+itself: every instrumentation site is behind one relaxed bool load, so
+disabled overhead is zero by construction).
 """
 
 import argparse
@@ -164,6 +170,10 @@ def main():
     ap.add_argument("--wire-mb", type=float, default=4.0,
                     help="fp32 payload size for the wire benchmark (MiB)")
     ap.add_argument("--wire-steps", type=int, default=10)
+    ap.add_argument("--metrics", action="store_true",
+                    help="also measure the metrics registry's negotiation "
+                         "overhead: cache_on rerun with HOROVOD_METRICS=1, "
+                         "steps/s ratio vs the metrics-off baseline")
     args = ap.parse_args()
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
@@ -190,6 +200,16 @@ def main():
             cache_on["worker_announce_bytes_per_step"]
             / max(cache_off["worker_announce_bytes_per_step"], 1.0), 3)
     print(json.dumps(summary), flush=True)
+
+    if args.metrics:
+        metrics_on = run_config("cache_on_metrics", {"HOROVOD_METRICS": "1"},
+                                args.np, args.steps, args.tensors)
+        ratio = metrics_on["steps_per_s"] / max(cache_on["steps_per_s"], 1e-9)
+        print(json.dumps({
+            "metric": "metrics_overhead",
+            "steps_ratio_on_vs_off": round(ratio, 3),
+            "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
+        }), flush=True)
 
     if args.wire_compression:
         elems = int(args.wire_mb * (1 << 20)) // 4

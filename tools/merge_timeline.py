@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Merge per-rank Horovod-TPU timeline traces into one Perfetto-loadable
+trace.
+
+Each rank writes its own Chrome-trace JSON array (HOROVOD_TIMELINE or
+hvd.start_timeline) with ts relative to that rank's own Start() and pid 0.
+This tool puts all ranks on one time axis and one trace:
+
+- clock alignment: every rank emits a RENDEZVOUS instant immediately after
+  the synchronized controller handshake in hvd.init(), so those instants
+  happened at (nearly) the same wall-clock moment on every rank.  All
+  timestamps are shifted so the RENDEZVOUS events coincide with the
+  reference rank's (the first input file's).  Traces started manually after
+  init have no RENDEZVOUS; then the CLOCK_SYNC anchor's wall-clock reading
+  (args.unix_us, taken at trace ts 0) aligns them instead — good on one
+  host, NTP-grade across hosts.  With neither anchor, timestamps pass
+  through unshifted.
+- identity: pid is rewritten to the rank (parsed from CLOCK_SYNC args.rank,
+  else the input-file order), and process_name / process_sort_index
+  metadata events make Perfetto label and order the tracks "rank N".
+- robustness: a trace cut off mid-write (rank crashed before Stop closed
+  the array) is repaired by trimming to the last complete event.
+
+Usage:  python tools/merge_timeline.py rank*.json -o merged.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+
+def load_trace(path: str) -> List[dict]:
+    """Load one per-rank trace, repairing a truncated (crashed-rank) file."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        events = json.loads(text)
+    except json.JSONDecodeError:
+        # The writer appends ",\n{event}" and only Stop() writes the closing
+        # "]"; trim back to the last complete event and close the array.
+        body = text.strip()
+        if body.startswith("["):
+            body = body[1:]
+        cut = body.rfind("}")
+        events = json.loads("[" + body[: cut + 1] + "]") if cut >= 0 else []
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome-trace JSON array")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def rank_of(events: List[dict], fallback: int) -> int:
+    for e in events:
+        if e.get("name") == "CLOCK_SYNC":
+            r = (e.get("args") or {}).get("rank", -1)
+            if isinstance(r, int) and r >= 0:
+                return r
+    return fallback
+
+
+def anchors(events: List[dict]) -> Tuple[Optional[int], Optional[int]]:
+    """(rendezvous_ts, clock_sync_unix_us) — either may be absent."""
+    rendezvous = None
+    unix_us = None
+    for e in events:
+        if e.get("name") == "RENDEZVOUS" and rendezvous is None:
+            rendezvous = e.get("ts")
+        elif e.get("name") == "CLOCK_SYNC" and unix_us is None:
+            unix_us = (e.get("args") or {}).get("unix_us")
+    return rendezvous, unix_us
+
+
+def merge(paths: List[str]) -> List[dict]:
+    traces = [load_trace(p) for p in paths]
+    ranks = [rank_of(t, i) for i, t in enumerate(traces)]
+    anchor = [anchors(t) for t in traces]
+    ref_rdv, ref_unix = anchor[0]
+
+    merged: List[dict] = []
+    for rank in sorted(set(ranks)):
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        merged.append({"name": "process_sort_index", "ph": "M", "pid": rank,
+                       "args": {"sort_index": rank}})
+    for trace, rank, (rdv, unix_us) in zip(traces, ranks, anchor):
+        if rdv is not None and ref_rdv is not None:
+            shift = ref_rdv - rdv
+        elif unix_us is not None and ref_unix is not None:
+            # ts is relative to this rank's t0; its wall clock at t0 was
+            # unix_us.  Shifting by the wall-clock skew of the t0s puts all
+            # ranks on the reference rank's axis.
+            shift = unix_us - ref_unix
+        else:
+            shift = 0
+        for e in trace:
+            out = dict(e)
+            out["pid"] = rank
+            if isinstance(out.get("ts"), (int, float)):
+                out["ts"] = out["ts"] + shift
+            merged.append(out)
+    # Stable sort keeps each rank's B-before-E ordering at equal ts.
+    merged.sort(key=lambda e: (e.get("ph") != "M",
+                               e.get("ts", 0) if e.get("ph") != "M" else 0))
+    return merged
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("inputs", nargs="+", help="per-rank timeline JSON files")
+    p.add_argument("-o", "--output", default="merged_timeline.json")
+    args = p.parse_args(argv)
+    merged = merge(args.inputs)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    n_ranks = len({e["pid"] for e in merged})
+    print(f"wrote {args.output}: {len(merged)} events from {n_ranks} ranks")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,9 @@ combination of:
            on the hier plane's cross-host leader ring; plus demotion
            combos where the knob is set on an all-local topology and the
            coordinator must turn it into a no-op
+- metrics: off / on (HOROVOD_METRICS=1) — native-core combos appended to
+           the full set; the workload asserts the registry populated
+           (cycle occupancy, negotiation-wait histogram) when enabled
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -116,6 +119,15 @@ WORKLOAD = textwrap.dedent("""
     np.testing.assert_allclose(hvd.allreduce(big, op=hvd.Sum, name="m.wire"),
                                wexp, **wtol)
 
+    # metrics axis: the registry must have seen the work done above.
+    if os.environ.get("HOROVOD_METRICS") == "1":
+        m = hvd.metrics()
+        assert m.get("enabled"), m
+        assert m["counters"]["cycle_count"] > 0, m["counters"]
+        assert m["histograms"]["negotiation_wait_us"]["count"] > 0, \
+            m["histograms"]
+        assert hvd.metrics_prometheus().startswith("# TYPE")
+
     hvd.barrier()
     hvd.shutdown()
     print(f"WORKLOAD-OK rank={r}", flush=True)
@@ -193,19 +205,21 @@ def combos(quick: bool):
     wires = ["none", "bf16", "int8"]
     if quick:
         # One covering set instead of the full product (every axis value
-        # appears; hier+none pairing is covered by tests/parallel).
-        yield ("jax", "native", 3, "on", "on", "shm", "none")
+        # appears; hier+none pairing is covered by tests/parallel).  The
+        # metrics axis stays "off" here — its on-combos live in the full
+        # set and tests/parallel/test_metrics.py covers the plane directly.
+        yield ("jax", "native", 3, "on", "on", "shm", "none", "off")
         # Same-host links: the coordinator must demote the codec (knob
         # harmless, results exact).
-        yield ("jax", "native", 2, "off", "off", "tcp", "bf16")
-        yield ("jax", "native", 3, "on", "off", "tcp0", "none")
-        yield ("jax", "native", 3, "on", "on", "hier", "bf16")
-        yield ("jax", "native", 3, "on", "off", "hier", "int8")
-        yield ("jax", "native", 1, "on", "off", "shm", "none")
-        yield ("jax", "purepy", 1, "off", "on", "shm", "none")
-        yield ("torch", "native", 2, "on", "on", "shm", "none")
-        yield ("torch", "native", 3, "off", "off", "tcp", "none")
-        yield ("torch", "purepy", 1, "on", "on", "shm", "none")
+        yield ("jax", "native", 2, "off", "off", "tcp", "bf16", "off")
+        yield ("jax", "native", 3, "on", "off", "tcp0", "none", "off")
+        yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off")
+        yield ("jax", "native", 3, "on", "off", "hier", "int8", "off")
+        yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
+        yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
+        yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
+        yield ("torch", "native", 3, "off", "off", "tcp", "none", "off")
+        yield ("torch", "purepy", 1, "on", "on", "shm", "none", "off")
         return
     for core, np_, f, c, p, w in itertools.product(cores, nps, fusion,
                                                    cache, planes, wires):
@@ -217,26 +231,33 @@ def combos(quick: bool):
             continue  # 2 ranks / 2 fake hosts has no multi-rank host
         if w != "none" and (p != "hier" or core != "native"):
             continue  # codec engages only on cross-host hops (leader ring)
-        yield ("jax", core, np_, f, c, p, w)
+        yield ("jax", core, np_, f, c, p, w, "off")
     # Demotion coverage: codec requested on an all-local flat ring.
-    yield ("jax", "native", 2, "on", "on", "tcp", "bf16")
-    yield ("jax", "native", 3, "on", "on", "shm", "int8")
+    yield ("jax", "native", 2, "on", "on", "tcp", "bf16", "off")
+    yield ("jax", "native", 3, "on", "on", "shm", "int8", "off")
+    # Metrics-axis coverage: registry populated across controller shapes
+    # (local np=1, socket, hierarchical) without disturbing the results.
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "on")
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "on")
+    yield ("jax", "native", 3, "off", "off", "tcp", "none", "on")
+    yield ("jax", "native", 3, "on", "on", "hier", "bf16", "on")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
-    yield ("torch", "native", 2, "on", "on", "shm", "none")
-    yield ("torch", "native", 2, "off", "off", "tcp", "none")
-    yield ("torch", "native", 2, "on", "off", "tcp0", "none")
-    yield ("torch", "native", 3, "on", "on", "tcp", "none")
-    yield ("torch", "native", 3, "off", "on", "shm", "none")
-    yield ("torch", "native", 3, "on", "on", "hier", "none")
-    yield ("torch", "native", 3, "on", "on", "hier", "bf16")
-    yield ("torch", "native", 3, "on", "on", "hier", "int8")
-    yield ("torch", "native", 1, "on", "on", "shm", "none")
-    yield ("torch", "purepy", 1, "on", "on", "shm", "none")
+    yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
+    yield ("torch", "native", 2, "off", "off", "tcp", "none", "off")
+    yield ("torch", "native", 2, "on", "off", "tcp0", "none", "off")
+    yield ("torch", "native", 3, "on", "on", "tcp", "none", "off")
+    yield ("torch", "native", 3, "off", "on", "shm", "none", "off")
+    yield ("torch", "native", 3, "on", "on", "hier", "none", "off")
+    yield ("torch", "native", 3, "on", "on", "hier", "bf16", "off")
+    yield ("torch", "native", 3, "on", "on", "hier", "int8", "off")
+    yield ("torch", "native", 1, "on", "on", "shm", "none", "off")
+    yield ("torch", "purepy", 1, "on", "on", "shm", "none", "off")
 
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
-              plane: str, wire: str, script: str, timeout: float) -> tuple:
+              plane: str, wire: str, metrics: str, script: str,
+              timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -248,6 +269,11 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # exact asserts (wire=none combos) and the demotion combos.
     env.pop("HOROVOD_WIRE_COMPRESSION", None)
     env.pop("HOROVOD_WIRE_COMPRESSION_MIN_BYTES", None)
+    # And the metrics axis: an ambient HOROVOD_METRICS_FILE would make
+    # every combo write snapshot files (and "off" combos assert nothing).
+    env.pop("HOROVOD_METRICS", None)
+    env.pop("HOROVOD_METRICS_FILE", None)
+    env.pop("HOROVOD_METRICS_INTERVAL", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -267,6 +293,8 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_HIER_FAKE_HOSTS"] = "2"
     if wire != "none":
         env["HOROVOD_WIRE_COMPRESSION"] = wire
+    if metrics == "on":
+        env["HOROVOD_METRICS"] = "1"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -299,12 +327,13 @@ def main() -> int:
             with open(scripts[binding], "w") as f:
                 f.write(text)
         for combo in combos(args.quick):
-            binding, core, np_, fusion, cache, plane, wire = combo
+            binding, core, np_, fusion, cache, plane, wire, metrics = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
-                     f"wire={wire}")
+                     f"wire={wire:<4} metrics={metrics}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
-                                       wire, script=scripts[binding],
+                                       wire, metrics,
+                                       script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
                   flush=True)

@@ -1,0 +1,13 @@
+# Developer entry points.  The native core builds itself on first import
+# (make -C horovod_tpu/cpp); these targets cover what CI runs.
+
+lint:
+	python tools/hvd_lint.py
+
+selftest:
+	$(MAKE) -C horovod_tpu/cpp selftest
+
+test:
+	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+.PHONY: lint selftest test

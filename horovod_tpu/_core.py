@@ -17,7 +17,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from .runtime import CoreBackend, FusedResponse, TensorEntry
+from .runtime import PROTOCOL_VERSION, CoreBackend, FusedResponse, TensorEntry
 from .utils.env import Config
 from .utils.logging import get_logger
 from .wire import DataType, OpType, ReduceOp, wire_dtype
@@ -73,6 +73,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_is_initialized.restype = c.c_int
     lib.hvd_rank.restype = c.c_int
     lib.hvd_size.restype = c.c_int
+    lib.hvd_local_rank.restype = c.c_int
+    lib.hvd_local_size.restype = c.c_int
     lib.hvd_enqueue.restype = c.c_longlong
     lib.hvd_enqueue.argtypes = [
         c.c_longlong, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_longlong,
@@ -180,7 +182,8 @@ class NativeCore(CoreBackend):
         )
         if rc != 0:
             raise NativeCoreError(
-                f"native core init failed (rc={rc}): {self._last_error()}")
+                f"native core init failed (rc={rc}, control protocol "
+                f"v{PROTOCOL_VERSION}): {self._last_error()}")
 
     def shutdown(self) -> None:
         if self._lib.hvd_is_initialized():

@@ -11,9 +11,11 @@
 #include <atomic>
 #include <cstdio>
 #include <cstring>
+#include <string>
 #include <thread>
 #include <vector>
 
+#include "metrics.h"
 #include "socket_controller.h"
 
 namespace hvdtpu {
@@ -91,12 +93,41 @@ int main() {
     }
     port = probe.port();
   }
+  // Metrics stay ON for the whole run: the rank threads increment the
+  // global registry (ring hops from ChunkedStep, shm fence waits from
+  // SockBarrier's >= kTagShmSize tags) while a dumper thread concurrently
+  // snapshots it — the increment-while-dump and fence-observe paths the
+  // TSan build must prove race-free.  The registry is relaxed atomics end
+  // to end, so zero reports is the designed outcome, not luck.
+  GlobalMetrics().enabled.store(true, std::memory_order_relaxed);
+  std::atomic<bool> stop_dumper{false};
+  std::atomic<long long> dumps{0};
+  std::thread dumper([&] {
+    while (!stop_dumper.load(std::memory_order_relaxed)) {
+      std::string json = GlobalMetrics().DumpJson(/*rank=*/0, "");
+      if (json.empty() || json.front() != '{' || json.back() != '}' ||
+          json.find("\"shm_fence_us\"") == std::string::npos) {
+        Fail("malformed concurrent metrics dump", -1);
+        return;
+      }
+      dumps.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
   std::vector<std::thread> threads;
   threads.reserve(kRanks);
   for (int r = 0; r < kRanks; ++r) {
     threads.emplace_back(RankMain, r, port);
   }
   for (auto& t : threads) t.join();
+  stop_dumper.store(true, std::memory_order_relaxed);
+  dumper.join();
+  if (dumps.load() == 0) Fail("dumper thread never completed a dump", -1);
+  // The data plane must have observed latency somewhere: shm fences when
+  // the same-host shm plane engaged, ring hops when it fell back to TCP.
+  const auto observed =
+      GlobalMetrics().shm_fence_us.count.load(std::memory_order_relaxed) +
+      GlobalMetrics().ring_hop_us.count.load(std::memory_order_relaxed);
+  if (observed == 0) Fail("metrics-enabled run observed no fence/hop", -1);
   if (failures.load() != 0) {
     std::printf("FAIL (%d)\n", failures.load());
     return 1;

@@ -191,7 +191,10 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
 def check_build(out=sys.stdout) -> None:
     import horovod_tpu as hvd
 
-    print("Horovod-TPU v%s:" % hvd.__version__, file=out)
+    from horovod_tpu.runtime import PROTOCOL_VERSION
+
+    print("Horovod-TPU v%s (control protocol v%d):"
+          % (hvd.__version__, PROTOCOL_VERSION), file=out)
     print("Available Frameworks:", file=out)
     print("    [X] JAX", file=out)
     try:

@@ -1,0 +1,290 @@
+"""hvd_lint: the cross-layer ABI/env/protocol checker.
+
+Two layers of coverage:
+- the real repo must lint clean against the committed (empty) baseline —
+  pure text analysis, no native build, so this is tier-1;
+- each pass is unit-tested on small fixture snippets, including seeded
+  mismatches (dropped argtype, bumped kProtocolVersion, undocumented env
+  var) that MUST produce findings — proving the passes can actually fail.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import hvd_lint  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# The repo itself
+# ---------------------------------------------------------------------------
+
+def test_repo_lints_clean():
+    findings = hvd_lint.run_repo(REPO)
+    assert findings == [], "\n".join(
+        f"{f.key}: {f.message}" for f in findings)
+
+
+def test_baseline_is_empty():
+    """Policy: drift gets fixed, not baselined."""
+    with open(os.path.join(REPO, "tools", "hvd_lint_baseline.json")) as f:
+        assert json.load(f)["findings"] == []
+
+
+def test_cli_exits_zero_on_repo():
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "hvd_lint.py")],
+        capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "0 new vs baseline" in run.stdout
+
+
+# ---------------------------------------------------------------------------
+# ABI pass fixtures
+# ---------------------------------------------------------------------------
+
+CPP_OK = """
+extern "C" {
+
+static void helper(int x) {}
+
+int hvd_frob(int rank, const char* name, long long nbytes) {
+  return 0;
+}
+
+long long hvd_ticket(void) {
+  return 0;
+}
+
+const char* hvd_oops(void) {
+  return "";
+}
+
+void hvd_poke(void) {
+}
+
+}  // extern "C"
+"""
+
+PY_OK = """
+def _declare(lib):
+    import ctypes as c
+    lib.hvd_frob.restype = c.c_int
+    lib.hvd_frob.argtypes = [c.c_int, c.c_char_p, c.c_longlong]
+    lib.hvd_ticket.restype = c.c_longlong
+    lib.hvd_oops.restype = c.c_char_p
+    lib.hvd_poke.restype = None
+"""
+
+
+def _abi(cpp, py):
+    return hvd_lint.abi_pass(cpp, {"horovod_tpu/_core.py": py})
+
+
+def test_abi_clean_fixture():
+    assert _abi(CPP_OK, PY_OK) == []
+
+
+def test_abi_parser_extracts_exports_not_statics():
+    exports = hvd_lint.parse_extern_c(CPP_OK)
+    assert set(exports) == {"hvd_frob", "hvd_ticket", "hvd_oops", "hvd_poke"}
+    assert exports["hvd_frob"] == ("int", ["int", "char*", "long long"])
+    assert exports["hvd_ticket"] == ("long long", [])
+
+
+def test_abi_dropped_argtype_is_found():
+    py = PY_OK.replace(", c.c_longlong]", "]")  # drop hvd_frob's 3rd arg
+    keys = {f.key for f in _abi(CPP_OK, py)}
+    assert "ABI-ARITY:hvd_frob" in keys
+
+
+def test_abi_wrong_type_is_found():
+    py = PY_OK.replace("c.c_char_p, c.c_longlong", "c.c_int, c.c_longlong")
+    keys = {f.key for f in _abi(CPP_OK, py)}
+    assert "ABI-TYPE:hvd_frob:1" in keys
+
+
+def test_abi_missing_longlong_restype_is_found():
+    # ctypes' default c_int restype silently truncates a long long return.
+    py = PY_OK.replace("lib.hvd_ticket.restype = c.c_longlong\n", "")
+    keys = {f.key for f in _abi(CPP_OK, py)}
+    assert any(k.startswith("ABI-") and "hvd_ticket" in k for k in keys)
+
+
+def test_abi_callsite_without_argtypes_is_found():
+    py = PY_OK + "\n    rc = lib.hvd_poke()\n    lib.hvd_gone(1)\n"
+    cpp = CPP_OK.replace("void hvd_poke(void) {",
+                         "void hvd_poke(int style) {")
+    keys = {f.key for f in _abi(cpp, py)}
+    assert "ABI-CALLSITE:hvd_poke" in keys   # called, args, no argtypes
+    assert "ABI-UNKNOWN-CALL:hvd_gone" in keys  # called, never exported
+
+
+# ---------------------------------------------------------------------------
+# env pass fixtures
+# ---------------------------------------------------------------------------
+
+ENV_PY = """
+IGNORED_VARS = (
+    "HOROVOD_GPU_OPERATIONS",
+)
+
+def from_env():
+    return get_int("HOROVOD_FUSION_THRESHOLD", 64)
+"""
+
+DOC_OK = """
+| Variable | Meaning |
+|---|---|
+| `HOROVOD_FUSION_THRESHOLD` | fusion bytes |
+| `HOROVOD_NATIVE_KNOB` | native thing |
+"""
+
+
+def _env(py_extra="", cc="", doc=DOC_OK):
+    py_files = {"horovod_tpu/utils/env.py": ENV_PY,
+                "horovod_tpu/other.py": py_extra}
+    cc_files = {"horovod_tpu/cpp/x.cc": cc}
+    return hvd_lint.env_pass(
+        py_files, cc_files, {"docs/api.md": doc},
+        native_read_vars={"HOROVOD_NATIVE_KNOB"} if cc else set(),
+        py_direct_vars=set(), internal_vars=set())
+
+
+def test_env_clean_fixture():
+    assert _env(cc='getenv("HOROVOD_NATIVE_KNOB")') == []
+
+
+def test_env_unmanaged_read_is_found():
+    findings = _env(py_extra='x = os.environ.get("HOROVOD_MYSTERY")',
+                    cc='getenv("HOROVOD_NATIVE_KNOB")')
+    assert {f.key for f in findings} == {"ENV-UNMANAGED:HOROVOD_MYSTERY"}
+
+
+def test_env_undocumented_native_var_is_found():
+    doc = DOC_OK.replace("| `HOROVOD_NATIVE_KNOB` | native thing |\n", "")
+    keys = {f.key for f in _env(cc='getenv("HOROVOD_NATIVE_KNOB")', doc=doc)}
+    assert "ENV-UNDOCUMENTED:HOROVOD_NATIVE_KNOB" in keys
+
+
+def test_env_unwhitelisted_cpp_getenv_is_found():
+    findings = hvd_lint.env_pass(
+        {"horovod_tpu/utils/env.py": ENV_PY},
+        {"horovod_tpu/cpp/x.cc": 'getenv("HOROVOD_SNEAKY")'},
+        {"docs/api.md": DOC_OK.replace("HOROVOD_NATIVE_KNOB",
+                                       "HOROVOD_FUSION_THRESHOLD")},
+        native_read_vars=set(), py_direct_vars=set(), internal_vars=set())
+    assert "ENV-NATIVE-UNLISTED:HOROVOD_SNEAKY" in {f.key for f in findings}
+
+
+def test_env_stale_doc_is_found():
+    doc = DOC_OK + "\n| `HOROVOD_IMAGINARY` | does not exist |\n"
+    keys = {f.key for f in _env(cc='getenv("HOROVOD_NATIVE_KNOB")', doc=doc)}
+    assert "ENV-STALE-DOC:HOROVOD_IMAGINARY" in keys
+
+
+def test_env_line_wrapped_var_prefix_not_flagged():
+    # "HOROVOD_FUSION_\nTHRESHOLD" wrapped mid-name must not register a
+    # phantom HOROVOD_FUSION doc mention.
+    doc = DOC_OK + "\nprose mentioning `HOROVOD_FUSION_\nTHRESHOLD` split\n"
+    keys = {f.key for f in _env(cc='getenv("HOROVOD_NATIVE_KNOB")', doc=doc)}
+    assert not any("HOROVOD_FUSION:" in k or k.endswith("HOROVOD_FUSION")
+                   for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# protocol pass fixtures
+# ---------------------------------------------------------------------------
+
+SC_OK = """
+constexpr uint32_t kProtocolMagic = 0x48565354;
+constexpr int kProtocolVersion = 7;
+constexpr int32_t kTagBarrier = 0x7000;
+constexpr int32_t kTagShmSize = 0x8000;
+constexpr int32_t kTagShmWrite = 0x9000;
+"""
+
+WIRE_OK = """
+enum class WireCodec : int32_t { kNone = 0, kBf16 = 1, kInt8 = 2 };
+"""
+
+CORE_OK = 'codec = {"none": 0, "bf16": 1, "int8": 2}.get(name, 0)'
+RUNTIME_OK = "PROTOCOL_VERSION = 7\n"
+ENV_CODECS_OK = 'WIRE_COMPRESSION_CODECS = ("none", "bf16", "int8")\n'
+DOC_PROTO_OK = {"docs/architecture.md": "currently `kProtocolVersion = 7`"}
+
+
+def _proto(sc=SC_OK, wire=WIRE_OK, core=CORE_OK, runtime=RUNTIME_OK,
+           env=ENV_CODECS_OK, docs=None):
+    return hvd_lint.protocol_pass(
+        sc, wire, core, runtime, env,
+        DOC_PROTO_OK if docs is None else docs)
+
+
+def test_protocol_clean_fixture():
+    assert _proto() == []
+
+
+def test_protocol_bumped_version_is_found():
+    # C++ bumped to v8, Python mirror and docs left at 7: both must flag.
+    keys = {f.key for f in _proto(sc=SC_OK.replace(
+        "kProtocolVersion = 7", "kProtocolVersion = 8"))}
+    assert "PROTO-VERSION-MIRROR" in keys
+    assert "PROTO-VERSION-DOC:docs/architecture.md" in keys
+
+
+def test_protocol_missing_mirror_is_found():
+    keys = {f.key for f in _proto(runtime="")}
+    assert "PROTO-NO-MIRROR" in keys
+
+
+def test_protocol_duplicate_tag_is_found():
+    sc = SC_OK + "constexpr int32_t kTagRogue = 0x9000;\n"
+    keys = {f.key for f in _proto(sc=sc)}
+    assert "PROTO-TAG-DUP:0x9000" in keys
+
+
+def test_protocol_fence_tag_below_threshold_is_found():
+    sc = SC_OK.replace("kTagShmWrite = 0x9000", "kTagShmWrite = 0x7800")
+    keys = {f.key for f in _proto(sc=sc)}
+    assert "PROTO-TAG-RANGE:kTagShmWrite" in keys
+
+
+def test_protocol_codec_mismatch_is_found():
+    keys = {f.key for f in _proto(core=CORE_OK.replace('"int8": 2',
+                                                       '"int8": 3'))}
+    assert "PROTO-CODEC-MIRROR" in keys
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a seeded mismatch makes the CLI exit non-zero
+# ---------------------------------------------------------------------------
+
+def test_cli_exits_nonzero_on_seeded_mismatch(tmp_path):
+    """Copy the repo's lintable surface, bump kProtocolVersion in the C++
+    only, and assert the CLI catches the drift with a non-zero exit."""
+    import shutil
+
+    for sub in ("horovod_tpu", "docs", "tools"):
+        shutil.copytree(
+            os.path.join(REPO, sub), tmp_path / sub,
+            ignore=shutil.ignore_patterns(
+                "__pycache__", "*.so", "*.o", "*selftest*"))
+    shutil.copy(os.path.join(REPO, "README.md"), tmp_path / "README.md")
+    sc = tmp_path / "horovod_tpu" / "cpp" / "socket_controller.cc"
+    text = sc.read_text()
+    assert "kProtocolVersion = 7" in text
+    sc.write_text(text.replace("kProtocolVersion = 7",
+                               "kProtocolVersion = 8"))
+    run = subprocess.run(
+        [sys.executable, str(tmp_path / "tools" / "hvd_lint.py"),
+         "--repo", str(tmp_path),
+         "--baseline", str(tmp_path / "tools" / "hvd_lint_baseline.json")],
+        capture_output=True, text=True, timeout=120)
+    assert run.returncode == 1, run.stdout + run.stderr
+    assert "PROTO-VERSION-MIRROR" in run.stdout

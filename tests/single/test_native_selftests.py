@@ -1,6 +1,9 @@
 """Native-core selftests: in-process 3-rank controller integration and the
-ThreadSanitizer race-detection build (SURVEY.md §5 — thread safety by
-design, made mechanically checkable)."""
+sanitizer matrix over it — TSan (races: negotiation, metrics registry
+increment-while-dump, shm fence paths), ASan (memory errors), UBSan
+(undefined behaviour), all with -fno-sanitize-recover so any report is a
+non-zero exit (SURVEY.md §5 — thread safety by design, made mechanically
+checkable)."""
 
 import os
 import shutil
@@ -31,8 +34,23 @@ def test_core_selftest_3ranks():
 
 
 def test_core_selftest_under_tsan():
+    """The same workload under TSan, now including the metrics-enabled
+    phase: a dumper thread snapshots the registry while 3 rank threads
+    increment it and observe shm fence / ring hop latencies."""
     out = _build_and_run("tsan_selftest")
     assert "ThreadSanitizer" not in out, out
+
+
+def test_core_selftest_under_asan():
+    out = _build_and_run("asan_selftest")
+    assert "AddressSanitizer" not in out, out
+
+
+def test_core_selftest_under_ubsan():
+    # UBSan reports carry "runtime error:"; -fno-sanitize-recover also
+    # makes any report fatal, which _build_and_run asserts via rc == 0.
+    out = _build_and_run("ubsan_selftest")
+    assert "runtime error" not in out, out
 
 
 def test_chunk_exchange_selftest():
@@ -46,8 +64,9 @@ def test_chunk_exchange_selftest():
 
 
 def test_make_selftest_target():
-    """`make selftest` builds and runs every non-TSAN selftest binary in
-    one shot — the entry point developers (and CI without pytest) use."""
+    """`make selftest` builds and runs every non-TSAN selftest binary —
+    including the ASan/UBSan variants — in one shot: the entry point
+    developers (and CI without pytest) use."""
     out = subprocess.run(["make", "selftest"], cwd=CPP_DIR,
                          capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr

@@ -1,0 +1,603 @@
+#!/usr/bin/env python
+"""hvd_lint: cross-layer ABI / env / protocol consistency checker.
+
+The framework's correctness hinges on three hand-mirrored seams, each of
+which drifts silently (a mismatch corrupts data or loses a knob, it does
+not crash):
+
+  ABI       the ``extern "C"`` surface in cpp/core_api.cc  vs  the ctypes
+            argtypes/restype declarations in _core.py
+  env       the HOROVOD_* variables read anywhere (C++ getenv, Python
+            os.environ)  vs  the central parser utils/env.py and the doc
+            tables
+  protocol  kProtocolVersion / frame tags / wire-codec ids in C++  vs  the
+            Python mirrors (runtime.PROTOCOL_VERSION, _core.py codec map,
+            env.py codec names) and the docs
+
+Each pass is a pure text analysis (no build, no import of horovod_tpu), so
+this runs in tier-1 CI on a bare checkout.  Output is a human report plus
+optional JSON; findings are compared against a committed baseline
+(tools/hvd_lint_baseline.json) so CI fails only on *new* findings.  The
+baseline is empty by policy — pre-existing drift gets fixed, not baselined.
+
+Usage:
+    python tools/hvd_lint.py                # human report, exit 1 on new findings
+    python tools/hvd_lint.py --json out.json
+    python tools/hvd_lint.py --update-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# Whitelists.  Every entry is a deliberate decision; the lint enforces that
+# the lists stay honest in both directions (an entry that no longer matches
+# reality is itself a finding).
+# ---------------------------------------------------------------------------
+
+# Symbols whose Python binding deliberately tolerates an old .so that
+# predates them (declared inside try/except, callers hasattr-guard):
+# the checker allows conditional declaration but still verifies types.
+OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2"}
+
+# HOROVOD_* variables read directly by C++ getenv (not routed through
+# utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
+# threading them through hvd_init would widen the init signature for no
+# behavioural gain.  Each MUST be documented in a doc table.
+NATIVE_READ_VARS = {
+    "HOROVOD_SHM_DISABLE",
+    "HOROVOD_RING_CHUNK_BYTES",
+    "HOROVOD_SOCKET_BUFFER_BYTES",
+    "HOROVOD_HIER_FAKE_HOSTS",
+    "HOROVOD_HOSTNAME",
+    "HOROVOD_WIRE_COMPRESSION_MIN_BYTES",
+    "HOROVOD_METRICS_REPORT_SECONDS",
+    "HOROVOD_STRAGGLER_SKEW",
+    "HOROVOD_STRAGGLER_MIN_MS",
+}
+
+# Public knobs read in Python outside utils/env.py (module-scope or
+# launcher-time concerns that never reach the core Config).  Each MUST be
+# documented in a doc table.
+PY_DIRECT_VARS = {
+    "HOROVOD_DEVICE_PLANE",
+    "HOROVOD_EXECUTOR_LANES",
+    "HOROVOD_LOG_TIMESTAMP",
+    "HOROVOD_SSH_COMMAND",
+    "HOROVOD_TPU_WORKERS",
+    "HOROVOD_TPU_PROBE_PORT",
+    "HOROVOD_LSF_INCLUDE_LAUNCH_HOST",
+    "HOROVOD_JAX_DISTRIBUTED",
+    "HOROVOD_JAX_COORDINATOR",
+    "HOROVOD_ELASTIC_DISCOVERY_INTERVAL",
+    "HOROVOD_ELASTIC_FAST_FAILURE_SECS",
+    "HOROVOD_ELASTIC_BLACKLIST_FAILURES",
+}
+
+# Infrastructure plumbing set by one launcher component and read by
+# another (secrets, worker identity, rendezvous bootstrap).  Exempt from
+# the doc-table requirement — they are not user knobs.
+INTERNAL_VARS = {
+    "HOROVOD_ELASTIC_SECRET",
+    "HOROVOD_ELASTIC_WORKER_ID",
+    "HOROVOD_ELASTIC_GENERATION",
+    "HOROVOD_ELASTIC_COORD_ADDR",
+    "HOROVOD_ELASTIC_COORD_PORT",
+    "HOROVOD_PROBE_SECRET",
+    "HOROVOD_TPU_METADATA_URL",
+    "HOROVOD_RANK_FROM_JSRUN",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    pass_name: str  # "abi" | "env" | "protocol"
+    key: str        # stable id, e.g. "ABI-ARITY:hvd_init"
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# ABI pass
+# ---------------------------------------------------------------------------
+
+# C++ parameter/return type -> the ctypes declaration _core.py must use.
+CTYPE_OF = {
+    "int": "c_int",
+    "long long": "c_longlong",
+    "double": "c_double",
+    "char*": "c_char_p",
+    "void*": "c_void_p",
+    "void**": "POINTER(c_void_p)",
+    "long long*": "POINTER(c_longlong)",
+    "int*": "POINTER(c_int)",
+}
+
+
+def _normalize_cpp_type(decl: str) -> str:
+    """'const long long* slice_counts' -> 'long long*' (identifier dropped)."""
+    decl = decl.strip()
+    m = re.match(r"^(.*?)\s*\b[A-Za-z_]\w*$", decl)
+    if m and m.group(1).strip():
+        decl = m.group(1)
+    decl = decl.replace("const", " ")
+    decl = re.sub(r"\s*\*\s*", "*", decl)     # glue stars to the type
+    decl = re.sub(r"\s+", " ", decl).strip()
+    return decl
+
+
+def parse_extern_c(cpp_text: str) -> Dict[str, Tuple[str, List[str]]]:
+    """Exported hvd_* symbols from core_api.cc: name -> (ret, [param types]).
+
+    Types are normalized C++ ('long long*'); map through CTYPE_OF to get the
+    expected ctypes declaration.
+    """
+    start = cpp_text.find('extern "C"')
+    if start < 0:
+        raise ValueError('no extern "C" block found')
+    block = cpp_text[start:]
+    out: Dict[str, Tuple[str, List[str]]] = {}
+    for m in re.finditer(
+            r'(?:^|\n)\s*((?:const\s+)?[A-Za-z_][\w ]*?[\s*]+)(hvd_\w+)'
+            r'\s*\(([^)]*)\)\s*\{', block):
+        ret_raw, name, params_raw = m.groups()
+        ret = re.sub(r"\s*\*\s*", "*", ret_raw.replace("const", " "))
+        ret = re.sub(r"\s+", " ", ret).strip()
+        params_raw = " ".join(params_raw.split())
+        params: List[str] = []
+        if params_raw and params_raw != "void":
+            params = [_normalize_cpp_type(p) for p in params_raw.split(",")]
+        out[name] = (ret, params)
+    return out
+
+
+def parse_ctypes_decls(py_text: str) -> Dict[str, dict]:
+    """argtypes/restype assignments from _core.py's _declare()."""
+    decls: Dict[str, dict] = {}
+    for m in re.finditer(r"lib\.(hvd_\w+)\.restype\s*=\s*([^\n]+)", py_text):
+        name, val = m.group(1), m.group(2).strip()
+        decls.setdefault(name, {})["restype"] = val.replace("c.", "")
+    for m in re.finditer(r"lib\.(hvd_\w+)\.argtypes\s*=\s*\[(.*?)\]",
+                         py_text, re.S):
+        name, body = m.groups()
+        args = [p.group(0).replace("c.", "")
+                for p in re.finditer(r"c\.POINTER\(c\.\w+\)|c\.\w+", body)]
+        decls.setdefault(name, {})["argtypes"] = args
+    return decls
+
+
+def parse_lib_calls(py_texts: Dict[str, str]) -> Dict[str, List[str]]:
+    """lib.hvd_* / _lib.hvd_* attribute references per symbol -> [files]."""
+    calls: Dict[str, List[str]] = {}
+    for path, text in py_texts.items():
+        # strip the declaration site so _declare() assignments don't count
+        body = re.sub(r"lib\.hvd_\w+\.(?:argtypes|restype)[^\n]*", "", text)
+        for m in re.finditer(r"\b_?lib\.(hvd_\w+)", body):
+            calls.setdefault(m.group(1), []).append(path)
+    return calls
+
+
+def abi_pass(cpp_text: str, py_texts: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    exports = parse_extern_c(cpp_text)
+    core_py = py_texts.get("horovod_tpu/_core.py", "")
+    decls = parse_ctypes_decls(core_py)
+    calls = parse_lib_calls(py_texts)
+
+    for name, (ret, params) in sorted(exports.items()):
+        decl = decls.get(name)
+        if decl is None:
+            findings.append(Finding(
+                "abi", f"ABI-UNDECLARED:{name}",
+                f"{name} is exported by core_api.cc but has no "
+                f"argtypes/restype declaration in _core.py"))
+            continue
+        argtypes = decl.get("argtypes")
+        if argtypes is not None:
+            expected = [CTYPE_OF.get(p, f"<unmapped:{p}>") for p in params]
+            if len(argtypes) != len(expected):
+                findings.append(Finding(
+                    "abi", f"ABI-ARITY:{name}",
+                    f"{name}: C++ takes {len(expected)} args, _core.py "
+                    f"declares {len(argtypes)} argtypes"))
+            else:
+                for i, (got, want) in enumerate(zip(argtypes, expected)):
+                    if got != want:
+                        findings.append(Finding(
+                            "abi", f"ABI-TYPE:{name}:{i}",
+                            f"{name} arg {i}: C++ '{params[i]}' expects "
+                            f"{want}, _core.py declares {got}"))
+        elif params:
+            findings.append(Finding(
+                "abi", f"ABI-NOARGTYPES:{name}",
+                f"{name} takes {len(params)} args but _core.py declares "
+                f"no argtypes (ctypes would guess, int-truncating "
+                f"pointers on LP64)"))
+        restype = decl.get("restype")
+        if restype is not None:
+            want_ret = None if ret == "void" else CTYPE_OF.get(ret)
+            if restype != (want_ret or "None"):
+                findings.append(Finding(
+                    "abi", f"ABI-RESTYPE:{name}",
+                    f"{name}: C++ returns '{ret}' ({want_ret}), _core.py "
+                    f"declares restype {restype}"))
+        elif ret not in ("void", "int"):
+            # ctypes defaults restype to c_int: silently truncates
+            # long long returns and corrupts pointers.
+            findings.append(Finding(
+                "abi", f"ABI-RESTYPE:{name}",
+                f"{name} returns '{ret}' but _core.py declares no restype "
+                f"(ctypes default c_int truncates it)"))
+
+    for name in sorted(set(decls) - set(exports)):
+        findings.append(Finding(
+            "abi", f"ABI-UNKNOWN:{name}",
+            f"_core.py declares {name} which core_api.cc does not export"))
+    for name, sites in sorted(calls.items()):
+        if name not in exports:
+            findings.append(Finding(
+                "abi", f"ABI-UNKNOWN-CALL:{name}",
+                f"{name} called ({sites[0]}) but not exported by "
+                f"core_api.cc"))
+        elif exports[name][1] and decls.get(name, {}).get("argtypes") is None:
+            findings.append(Finding(
+                "abi", f"ABI-CALLSITE:{name}",
+                f"{name} called ({sites[0]}) with no argtypes declared"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# env pass
+# ---------------------------------------------------------------------------
+
+_VAR = r"HOROVOD_[A-Z0-9_]*[A-Z0-9](?![A-Z0-9_])"
+
+# Read sites.  Writes (env["X"] = ...) are launcher plumbing and are not
+# obligations; a token ending in '_' is a line-wrapped prefix, not a name.
+_PY_READ_PATTERNS = [
+    re.compile(r"os\.environ\.get\(\s*[\"'](" + _VAR + ")"),
+    re.compile(r"os\.getenv\(\s*[\"'](" + _VAR + ")"),
+    re.compile(r"\benviron\[\s*[\"'](" + _VAR + r")[\"']\s*\](?!\s*=[^=])"),
+    re.compile(r"\benv\.get\(\s*[\"'](" + _VAR + ")"),
+    re.compile(r"\bget_(?:bool|int|float)\(\s*[\"'](" + _VAR + ")"),
+    re.compile(r"\b_env_number\(\s*\n?\s*[\"'](" + _VAR + ")"),
+]
+_CC_READ_PATTERN = re.compile(r"getenv\(\s*\"(" + _VAR + ")\"")
+
+
+def collect_code_reads(py_files: Dict[str, str],
+                       cc_files: Dict[str, str]) -> Tuple[Dict[str, List[str]],
+                                                          Dict[str, List[str]]]:
+    py_reads: Dict[str, List[str]] = {}
+    cc_reads: Dict[str, List[str]] = {}
+    for path, text in py_files.items():
+        for pat in _PY_READ_PATTERNS:
+            for m in pat.finditer(text):
+                py_reads.setdefault(m.group(1), []).append(path)
+    for path, text in cc_files.items():
+        for m in _CC_READ_PATTERN.finditer(text):
+            cc_reads.setdefault(m.group(1), []).append(path)
+    return py_reads, cc_reads
+
+
+def parse_env_py(env_py_text: str) -> Tuple[set, set]:
+    """(parsed, ignored) variable sets from utils/env.py.
+
+    'parsed' is every HOROVOD_* token in the file outside the IGNORED_VARS
+    tuple — the file is the single source of truth, so a mention there IS
+    the central registration.
+    """
+    m = re.search(r"IGNORED_VARS\s*=\s*\((.*?)\)", env_py_text, re.S)
+    ignored = set(re.findall(_VAR, m.group(1))) if m else set()
+    body = env_py_text
+    if m:
+        body = body[:m.start(1)] + body[m.end(1):]
+    parsed = set(re.findall(_VAR, body)) - ignored
+    return parsed, ignored
+
+
+def env_pass(py_files: Dict[str, str], cc_files: Dict[str, str],
+             doc_files: Dict[str, str],
+             native_read_vars: Optional[set] = None,
+             py_direct_vars: Optional[set] = None,
+             internal_vars: Optional[set] = None) -> List[Finding]:
+    native_read_vars = (NATIVE_READ_VARS if native_read_vars is None
+                        else native_read_vars)
+    py_direct_vars = PY_DIRECT_VARS if py_direct_vars is None else py_direct_vars
+    internal_vars = INTERNAL_VARS if internal_vars is None else internal_vars
+
+    findings: List[Finding] = []
+    env_py = py_files.get("horovod_tpu/utils/env.py", "")
+    parsed, ignored = parse_env_py(env_py)
+    py_reads, cc_reads = collect_code_reads(py_files, cc_files)
+
+    table_rows: set = set()
+    doc_mentions: set = set()
+    for _, text in doc_files.items():
+        for line in text.splitlines():
+            vars_here = set(re.findall(_VAR, line))
+            doc_mentions |= vars_here
+            if line.lstrip().startswith("|"):
+                table_rows |= vars_here
+
+    # 1. C++ getenv <-> native whitelist, exact both ways.
+    for var in sorted(set(cc_reads) - native_read_vars):
+        findings.append(Finding(
+            "env", f"ENV-NATIVE-UNLISTED:{var}",
+            f"C++ reads {var} ({cc_reads[var][0]}) but it is not in "
+            f"hvd_lint's NATIVE_READ_VARS whitelist"))
+    for var in sorted(native_read_vars - set(cc_reads)):
+        findings.append(Finding(
+            "env", f"ENV-NATIVE-STALE:{var}",
+            f"{var} is whitelisted as native-read but no C++ getenv "
+            f"reads it"))
+
+    # 2. Every Python read is centrally parsed or explicitly whitelisted.
+    known = parsed | ignored | native_read_vars | py_direct_vars | internal_vars
+    for var, sites in sorted(py_reads.items()):
+        if var not in known:
+            findings.append(Finding(
+                "env", f"ENV-UNMANAGED:{var}",
+                f"{var} read in {sites[0]} but not parsed in utils/env.py, "
+                f"not in IGNORED_VARS, and not whitelisted"))
+
+    # 3. Whitelisted Python-direct vars must actually be read somewhere.
+    for var in sorted(py_direct_vars - set(py_reads)):
+        findings.append(Finding(
+            "env", f"ENV-DIRECT-STALE:{var}",
+            f"{var} is whitelisted as python-direct but nothing reads it"))
+
+    # 4. Every public knob has a doc table row.
+    public = (parsed | native_read_vars | py_direct_vars) - internal_vars
+    for var in sorted(public - table_rows):
+        findings.append(Finding(
+            "env", f"ENV-UNDOCUMENTED:{var}",
+            f"{var} is a public knob but appears in no markdown table row "
+            f"in docs/ or README.md"))
+
+    # 5. No doc may name a var no code knows.
+    for var in sorted(doc_mentions - known):
+        findings.append(Finding(
+            "env", f"ENV-STALE-DOC:{var}",
+            f"docs name {var} but no code reads, parses, ignores, or "
+            f"whitelists it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# protocol pass
+# ---------------------------------------------------------------------------
+
+def parse_protocol_constants(sc_text: str) -> Tuple[Optional[int],
+                                                    Dict[str, int]]:
+    """(kProtocolVersion, {kTagName: value}) from socket_controller.cc."""
+    vm = re.search(r"kProtocolVersion\s*=\s*(\d+)\s*;", sc_text)
+    version = int(vm.group(1)) if vm else None
+    tags = {m.group(1): int(m.group(2), 0) for m in re.finditer(
+        r"constexpr\s+int32_t\s+(kTag\w+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)\s*;",
+        sc_text)}
+    return version, tags
+
+
+def parse_wire_codecs(wire_codec_text: str) -> Dict[str, int]:
+    """{'none': 0, 'bf16': 1, 'int8': 2} from wire_codec.h's enum."""
+    m = re.search(r"enum\s+class\s+WireCodec[^{]*\{(.*?)\}", wire_codec_text,
+                  re.S)
+    if not m:
+        return {}
+    return {em.group(1).lower(): int(em.group(2))
+            for em in re.finditer(r"k(\w+)\s*=\s*(\d+)", m.group(1))}
+
+
+def parse_py_codec_map(core_py_text: str) -> Dict[str, int]:
+    """The {'none': 0, ...} literal _core.py passes into hvd_init."""
+    m = re.search(r'\{[^{}]*"bf16"[^{}]*\}', core_py_text)
+    if not m:
+        return {}
+    return {pm.group(1): int(pm.group(2))
+            for pm in re.finditer(r'"(\w+)"\s*:\s*(\d+)', m.group(0))}
+
+
+def protocol_pass(sc_text: str, wire_codec_text: str, core_py_text: str,
+                  runtime_py_text: str, env_py_text: str,
+                  doc_files: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    version, tags = parse_protocol_constants(sc_text)
+    if version is None:
+        findings.append(Finding(
+            "protocol", "PROTO-NO-VERSION",
+            "kProtocolVersion not found in socket_controller.cc"))
+        return findings
+
+    # Python mirror.
+    pm = re.search(r"^PROTOCOL_VERSION\s*=\s*(\d+)", runtime_py_text, re.M)
+    if not pm:
+        findings.append(Finding(
+            "protocol", "PROTO-NO-MIRROR",
+            "horovod_tpu/runtime.py defines no PROTOCOL_VERSION mirror of "
+            "kProtocolVersion"))
+    elif int(pm.group(1)) != version:
+        findings.append(Finding(
+            "protocol", "PROTO-VERSION-MIRROR",
+            f"kProtocolVersion={version} but runtime.PROTOCOL_VERSION="
+            f"{pm.group(1)}"))
+
+    # Doc claims: every explicit kProtocolVersion mention must match, and
+    # at least one doc must make the claim (so a bump is forced through
+    # the docs).
+    doc_claims = 0
+    for path, text in sorted(doc_files.items()):
+        for dm in re.finditer(r"kProtocolVersion\D{0,24}?(\d+)", text):
+            doc_claims += 1
+            if int(dm.group(1)) != version:
+                findings.append(Finding(
+                    "protocol", f"PROTO-VERSION-DOC:{path}",
+                    f"{path} states kProtocolVersion={dm.group(1)} but C++ "
+                    f"says {version}"))
+    if doc_claims == 0:
+        findings.append(Finding(
+            "protocol", "PROTO-VERSION-UNDOCUMENTED",
+            "no doc states the current kProtocolVersion (a bump would be "
+            "invisible to readers)"))
+
+    # Frame tags: unique values, fence family above the SockBarrier metric
+    # threshold (kTagShmSize), op tags below it, and >=0x100 spacing so
+    # per-round (+k) and per-segment (+s) offsets cannot collide.
+    by_value: Dict[int, List[str]] = {}
+    for name, value in tags.items():
+        by_value.setdefault(value, []).append(name)
+    for value, names in sorted(by_value.items()):
+        if len(names) > 1:
+            findings.append(Finding(
+                "protocol", f"PROTO-TAG-DUP:{value:#x}",
+                f"frame tag value {value:#x} duplicated: {', '.join(names)}"))
+    fence_base = tags.get("kTagShmSize")
+    if fence_base is None:
+        findings.append(Finding(
+            "protocol", "PROTO-NO-FENCE-BASE",
+            "kTagShmSize (the SockBarrier fence-metric threshold) not found"))
+    else:
+        for name, value in sorted(tags.items()):
+            is_fence = name.startswith(("kTagShm", "kTagHier"))
+            if is_fence and value < fence_base:
+                findings.append(Finding(
+                    "protocol", f"PROTO-TAG-RANGE:{name}",
+                    f"{name}={value:#x} is a shm/hier fence tag below "
+                    f"kTagShmSize={fence_base:#x}; SockBarrier would not "
+                    f"count it as a fence"))
+            if name == "kTagBarrier" and value >= fence_base:
+                findings.append(Finding(
+                    "protocol", f"PROTO-TAG-RANGE:{name}",
+                    f"{name}={value:#x} (the user-visible barrier) sits in "
+                    f"the >= {fence_base:#x} fence-metric range"))
+    values = sorted(by_value)
+    for lo, hi in zip(values, values[1:]):
+        if hi - lo < 0x100:
+            findings.append(Finding(
+                "protocol", f"PROTO-TAG-SPACING:{hi:#x}",
+                f"tags {', '.join(by_value[lo])} ({lo:#x}) and "
+                f"{', '.join(by_value[hi])} ({hi:#x}) are {hi - lo} apart; "
+                f"round/segment offsets need >= 0x100 of headroom"))
+
+    # Wire-codec ids: wire_codec.h enum vs _core.py init map vs env.py names.
+    cpp_codecs = parse_wire_codecs(wire_codec_text)
+    py_codecs = parse_py_codec_map(core_py_text)
+    if cpp_codecs != py_codecs:
+        findings.append(Finding(
+            "protocol", "PROTO-CODEC-MIRROR",
+            f"wire codec ids disagree: wire_codec.h {cpp_codecs} vs "
+            f"_core.py {py_codecs}"))
+    em = re.search(r"WIRE_COMPRESSION_CODECS\s*=\s*\((.*?)\)", env_py_text,
+                   re.S)
+    env_names = re.findall(r'"(\w+)"', em.group(1)) if em else []
+    want_order = [n for n, _ in sorted(cpp_codecs.items(),
+                                       key=lambda kv: kv[1])]
+    if env_names != want_order:
+        findings.append(Finding(
+            "protocol", "PROTO-CODEC-NAMES",
+            f"env.py WIRE_COMPRESSION_CODECS {env_names} does not match the "
+            f"id-ordered wire_codec.h names {want_order}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _read(relpath: str) -> str:
+    with open(os.path.join(REPO, relpath), encoding="utf-8",
+              errors="replace") as f:
+        return f.read()
+
+
+def _collect(root: str, subdir: str, exts: Sequence[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    base = os.path.join(root, subdir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if any(fn.endswith(e) for e in exts):
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                with open(full, encoding="utf-8", errors="replace") as f:
+                    out[rel] = f.read()
+    return out
+
+
+def run_repo(root: str = REPO) -> List[Finding]:
+    py_files = _collect(root, "horovod_tpu", (".py",))
+    cc_files = _collect(root, os.path.join("horovod_tpu", "cpp"),
+                        (".cc", ".h"))
+    doc_files = _collect(root, "docs", (".md",))
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            doc_files["README.md"] = f.read()
+
+    findings: List[Finding] = []
+    findings += abi_pass(cc_files["horovod_tpu/cpp/core_api.cc"], py_files)
+    findings += env_pass(py_files, cc_files, doc_files)
+    findings += protocol_pass(
+        cc_files["horovod_tpu/cpp/socket_controller.cc"],
+        cc_files["horovod_tpu/cpp/wire_codec.h"],
+        py_files["horovod_tpu/_core.py"],
+        py_files["horovod_tpu/runtime.py"],
+        py_files["horovod_tpu/utils/env.py"],
+        doc_files)
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--json", metavar="PATH",
+                    help="also write the full machine-readable report here")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "tools",
+                                         "hvd_lint_baseline.json"))
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept all current findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    findings = run_repo(args.repo)
+    baseline_keys: set = set()
+    if os.path.exists(args.baseline):
+        with open(args.baseline, encoding="utf-8") as f:
+            baseline_keys = set(json.load(f).get("findings", []))
+    new = [f for f in findings if f.key not in baseline_keys]
+
+    for pass_name in ("abi", "env", "protocol"):
+        hits = [f for f in findings if f.pass_name == pass_name]
+        print(f"[{pass_name}] {len(hits)} finding(s)")
+        for f in hits:
+            marker = " " if f.key in baseline_keys else "*"
+            print(f"  {marker} {f.key}: {f.message}")
+    print(f"hvd_lint: {len(findings)} finding(s), {len(new)} new vs baseline "
+          f"({len(baseline_keys)} baselined)")
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump({"findings": [x.as_dict() for x in findings],
+                       "new": [x.key for x in new]}, f, indent=2)
+    if args.update_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump({"findings": sorted(x.key for x in findings)}, f,
+                      indent=2)
+        print(f"baseline updated: {args.baseline}")
+        return 0
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,10 @@ combination of:
            the full set; the workload asserts the registry populated
            (cycle occupancy, negotiation-wait histogram) when enabled
 
+Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
+consistency, both sets) and the ASan/UBSan selftest builds (slow, full set
+only).
+
 Usage:
     python tools/test_matrix.py              # full matrix
     python tools/test_matrix.py --quick      # one combo per axis value
@@ -43,6 +47,7 @@ import textwrap
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPP_DIR = os.path.join(REPO, "horovod_tpu", "cpp")
 
 WORKLOAD = textwrap.dedent("""
     import os
@@ -255,6 +260,39 @@ def combos(quick: bool):
     yield ("torch", "purepy", 1, "on", "on", "shm", "none", "off")
 
 
+def checks(quick: bool):
+    """Non-workload rows: static analysis and the sanitizer builds.
+
+    Yields (name, [argv, ...], cwd) — the argvs run in sequence, all must
+    exit 0.  `lint` is pure text analysis (no build) and belongs in the
+    quick set; the sanitizer rows compile the whole controller stack
+    (~1 min each on a laptop core) and are slow, so full matrix only.
+    """
+    yield ("lint",
+           [[sys.executable, os.path.join(REPO, "tools", "hvd_lint.py")]],
+           REPO)
+    if quick:
+        return
+    for target in ("asan_selftest", "ubsan_selftest"):
+        yield (target.split("_")[0],
+               [["make", target], [os.path.join(CPP_DIR, target)]],
+               CPP_DIR)
+
+
+def run_check(cmds, cwd: str, timeout: float) -> tuple:
+    t0 = time.monotonic()
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(cmd, cwd=cwd, capture_output=True,
+                                  text=True, timeout=timeout)
+        except subprocess.TimeoutExpired as exc:
+            return False, time.monotonic() - t0, f"timeout: {exc}"
+        if proc.returncode != 0:
+            return False, time.monotonic() - t0, \
+                (proc.stdout + proc.stderr)[-800:]
+    return True, time.monotonic() - t0, ""
+
+
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, script: str,
               timeout: float) -> tuple:
@@ -320,6 +358,13 @@ def main() -> int:
     args = ap.parse_args()
 
     failures = []
+    for name, cmds, cwd in checks(args.quick):
+        ok, dt, detail = run_check(cmds, cwd, args.timeout)
+        label = f"check={name}"
+        print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
+              flush=True)
+        if not ok:
+            failures.append((label, detail))
     with tempfile.TemporaryDirectory() as td:
         scripts = {}
         for binding, text in (("jax", WORKLOAD), ("torch", TORCH_WORKLOAD)):

@@ -131,10 +131,31 @@ def _declare(lib: ctypes.CDLL) -> None:
     except AttributeError:
         pass
     lib.hvd_last_error.restype = c.c_char_p
+    try:
+        # Old-ABI tolerance: a stale .so predating the fault-injection
+        # plane simply loses `horovodrun --fault-inject` pre-validation.
+        lib.hvd_fault_spec_check.restype = c.c_char_p
+        lib.hvd_fault_spec_check.argtypes = [c.c_char_p]
+    except AttributeError:
+        pass
 
 
 class NativeCoreError(RuntimeError):
     pass
+
+
+def check_fault_spec(spec: str) -> str:
+    """Validate a HOROVOD_FAULT_INJECT spec against the native parser.
+
+    Returns "" when well-formed, else the same actionable message
+    hvd.init() would fail with.  An old .so without the entry point
+    validates nothing (returns "").
+    """
+    lib = _load_library()
+    if not hasattr(lib, "hvd_fault_spec_check"):
+        return ""
+    msg = lib.hvd_fault_spec_check(spec.encode())
+    return msg.decode() if msg else ""
 
 
 class NativeCore(CoreBackend):

@@ -36,6 +36,23 @@ from .wire import (DataType, OpType, ReduceOp, numpy_dtype,
 
 log = get_logger()
 
+# Framework bindings register cleanup hooks here (torch/mpi_ops.py sweeps
+# its handle table) so shutdown — including the fast-abort path after a
+# peer failure — releases their bookkeeping: a post-abort re-init must not
+# see stale in-place write-back targets from the dead job.
+_shutdown_callbacks: List = []
+
+
+def register_shutdown_callback(fn) -> None:
+    """Register ``fn`` to run during :meth:`HorovodContext.shutdown`.
+
+    Callbacks run after the core is down and pending handles are failed;
+    exceptions are logged, never propagated (shutdown must always finish).
+    Registration is idempotent by identity."""
+    if fn not in _shutdown_callbacks:
+        _shutdown_callbacks.append(fn)
+
+
 _INT_TYPES = (
     DataType.UINT8, DataType.INT8, DataType.UINT16, DataType.INT16,
     DataType.INT32, DataType.INT64, DataType.BOOL,
@@ -249,6 +266,11 @@ class HorovodContext:
         for e in pending:
             e.error = "Horovod has been shut down"
             e.done.set()
+        for fn in list(_shutdown_callbacks):
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 - shutdown must finish
+                log.warning("shutdown callback %r failed: %s", fn, exc)
 
     # -- enqueue ------------------------------------------------------------
     def enqueue(

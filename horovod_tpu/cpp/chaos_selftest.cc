@@ -1,0 +1,298 @@
+// Chaos selftest: the full SocketController stack driven with
+// HOROVOD_FAULT_INJECT armed, one scenario per named protocol site.
+//
+// Each scenario asserts the robustness contract of the fast-abort design
+// (docs/elastic.md "Failure detection & bounds"): injected drops,
+// truncations, and corrupted tags make every rank fail FAST with a
+// culprit-naming reason — never hang — while benign injections (delays)
+// and healed ones (rendezvous drop + backoff retry) leave results
+// bit-correct.  Built plain it is an integration test; built with
+// -fsanitize=thread/address/undefined (`make tsan_chaos_selftest` etc.) it
+// proves the abort paths themselves are race- and UB-free, which matters
+// because they run concurrently with executor lanes mid-collapse.  Run by
+// tests/single/test_native_selftests.py.
+//
+// Hit indices for the data-plane sites are CALIBRATED, not hardcoded: a
+// clean run with a never-firing rule armed counts how many times each site
+// fires during Initialize (the shm-verdict handshake runs barrier fences
+// even when shm is disabled), and later scenarios target `base + 0`, the
+// first post-init hit.  This keeps the selftest correct when the init
+// handshake gains or loses a fence.
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "fault_injection.h"
+#include "metrics.h"
+#include "socket_controller.h"
+
+namespace hvdtpu {
+int GetLogLevel() { return 4; }  // errors only
+void SetLogLevel(int) {}
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+namespace {
+
+constexpr int kRanks = 3;
+
+std::atomic<int> failures{0};
+
+void Fail(const char* scenario, int rank, const std::string& what) {
+  std::fprintf(stderr, "FAIL [%s] rank %d: %s\n", scenario, rank,
+               what.c_str());
+  failures.fetch_add(1);
+}
+
+int FreePort() {
+  Listener probe;
+  if (!probe.Listen("127.0.0.1", 0)) return -1;
+  return probe.port();
+}
+
+struct RankOutcome {
+  bool init_ok = false;
+  bool completed = false;  // every cycle finished cleanly
+  std::string reason;      // abort reason (failure paths) / init error
+  double handshake_s = 0;  // failed data op -> reason latched
+  int64_t base_hits[kNumFaultSites] = {0};  // own-slot hits after init
+};
+
+// One in-process rank.  The failure path mirrors core_api.cc exactly: a
+// failed data op is followed by one more ComputeResponses (the abort
+// handshake — worker FIN / coordinator sweep + broadcast), and the reason
+// the Python layer would surface comes from WaitAbortReason().
+void ChaosRank(const char* scenario, int rank, int port, int cycles,
+               bool do_barrier, RankOutcome* out) {
+  CoreConfig cfg;
+  cfg.rank = rank;
+  cfg.size = kRanks;
+  cfg.rendezvous_addr = "127.0.0.1";
+  cfg.rendezvous_port = port;
+  SocketController ctl(cfg);
+  Status s = ctl.Initialize();
+  if (!s.ok()) {
+    out->reason = s.reason;
+    return;
+  }
+  out->init_ok = true;
+  auto& inj = GlobalFaultInjector();
+  for (int site = 0; site < kNumFaultSites; ++site) {
+    out->base_hits[site] =
+        inj.hits[site][rank].load(std::memory_order_relaxed);
+  }
+  for (int cycle = 0; s.ok() && cycle < cycles; ++cycle) {
+    TensorRequest req;
+    req.name = "c" + std::to_string(cycle);
+    req.op = OpType::ALLREDUCE;
+    req.dtype = DataType::FLOAT32;
+    req.nbytes = 1024 * 4;
+    req.shape = {1024};
+    std::vector<TensorRequest> reqs{req};
+    std::vector<Response> resps;
+    s = ctl.ComputeResponses(reqs, &resps);
+    for (size_t i = 0; s.ok() && i < resps.size(); ++i) {
+      Response& r = resps[i];
+      if (!r.error.empty()) {
+        s = Status::Error(StatusCode::ABORTED, r.error);
+        break;
+      }
+      ctl.SetCurrentSeq(r.seq);
+      std::vector<float> buf(1024, static_cast<float>(rank + 1));
+      s = ctl.AllreduceBuffer(buf.data(), 1024, DataType::FLOAT32,
+                              ReduceOp::SUM, 0);
+      if (s.ok() && (buf[0] != 6.0f || buf[1023] != 6.0f)) {
+        Fail(scenario, rank, "wrong allreduce result");
+        s = Status::Error(StatusCode::ABORTED, "wrong allreduce result");
+      }
+      if (s.ok() && do_barrier) s = ctl.Barrier(0);
+    }
+  }
+  if (s.ok()) {
+    ctl.Farewell();
+    ctl.Shutdown();
+    out->completed = true;
+    return;
+  }
+  const double t0 = MonotonicSeconds();
+  std::vector<TensorRequest> none;
+  std::vector<Response> ignored;
+  ctl.ComputeResponses(none, &ignored);
+  out->reason = ctl.WaitAbortReason();
+  if (out->reason.empty()) out->reason = s.reason;
+  out->handshake_s = MonotonicSeconds() - t0;
+  ctl.Shutdown();
+}
+
+std::vector<RankOutcome> RunScenario(const char* name, const std::string& spec,
+                                     int cycles, bool do_barrier) {
+  std::vector<RankOutcome> out(kRanks);
+  ::setenv("HOROVOD_FAULT_INJECT", spec.c_str(), 1);
+  std::string err = InitFaultInjection();
+  if (!err.empty()) {
+    Fail(name, -1, "unexpected spec error: " + err);
+    return out;
+  }
+  int port = FreePort();
+  if (port < 0) {
+    Fail(name, -1, "no free port");
+    return out;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(kRanks);
+  for (int r = 0; r < kRanks; ++r) {
+    threads.emplace_back(ChaosRank, name, r, port, cycles, do_barrier,
+                         &out[r]);
+  }
+  for (auto& t : threads) t.join();
+  return out;
+}
+
+void ExpectAllAborted(const char* name,
+                      const std::vector<RankOutcome>& out,
+                      double bound_s) {
+  for (int r = 0; r < kRanks; ++r) {
+    if (out[r].completed) {
+      Fail(name, r, "completed cleanly despite the injected fault");
+    } else if (out[r].reason.empty()) {
+      Fail(name, r, "aborted without a reason");
+    } else if (out[r].init_ok && out[r].handshake_s > bound_s) {
+      Fail(name, r,
+           "abort handshake took " + std::to_string(out[r].handshake_s) +
+               "s (bound " + std::to_string(bound_s) + "s)");
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  // Force the TCP ring so the ring-send/ring-recv/frame-header sites are
+  // on the data path (the shm handshake still runs and votes no), shrink
+  // the abort bound and rendezvous backoff to keep the run fast, and keep
+  // metrics ON so the abort counters/histogram are exercised concurrently
+  // with the collapsing planes (what the sanitizer builds must prove safe).
+  ::setenv("HOROVOD_SHM_DISABLE", "1", 1);
+  ::setenv("HOROVOD_ABORT_PROPAGATION_TIMEOUT", "1", 1);
+  ::setenv("HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS", "10", 1);
+  GlobalMetrics().enabled.store(true, std::memory_order_relaxed);
+
+  // --- spec parser: valid accepted, malformed rejected with a message ----
+  if (!ParseFaultSpec("ring-send:*:1:delay:250,frame-header:3:0:corrupt-tag",
+                      nullptr)
+           .empty()) {
+    Fail("parse", -1, "valid spec rejected");
+  }
+  const char* bad[] = {
+      "nosite:*:*:drop",        "ring-send:*:*",
+      "ring-send:x:*:drop",     "ring-send:*:x:drop",
+      "ring-send:*:*:explode",  "ring-send:*:*:delay",
+      "ring-send:*:*:drop:arg",
+  };
+  for (const char* b : bad) {
+    if (ParseFaultSpec(b, nullptr).empty()) {
+      Fail("parse", -1, std::string("malformed spec accepted: ") + b);
+    }
+  }
+
+  // --- calibration: armed-but-never-firing rule, clean lockstep run ------
+  auto cal = RunScenario("calibrate", "frame-header:200000000:0:drop",
+                         /*cycles=*/3, /*do_barrier=*/true);
+  for (int r = 0; r < kRanks; ++r) {
+    if (!cal[r].completed) {
+      Fail("calibrate", r, "did not complete: " + cal[r].reason);
+    }
+  }
+  if (failures.load() != 0) {
+    std::printf("FAIL (%d)\n", failures.load());
+    return 1;
+  }
+  const int64_t rs1 = cal[1].base_hits[kFaultRingSend];
+  const int64_t rr2 = cal[2].base_hits[kFaultRingRecv];
+  const int64_t fh1 = cal[1].base_hits[kFaultFrameHeader];
+  const int64_t sf1 = cal[1].base_hits[kFaultShmFence];
+  if (rs1 <= 0 || fh1 <= 0) {
+    Fail("calibrate", 1, "init fences never hit the ring/frame hooks");
+  }
+
+  // --- rendezvous-accept drop: the worker's backoff retry heals it -------
+  auto rz = RunScenario("rendezvous", "rendezvous-accept:0:1:drop",
+                        /*cycles=*/2, /*do_barrier=*/false);
+  for (int r = 0; r < kRanks; ++r) {
+    if (!rz[r].completed) {
+      Fail("rendezvous", r, "did not recover from the dropped HELLO: " +
+                                rz[r].reason);
+    }
+  }
+
+  // --- delay: benign, results stay bit-correct, counter observes it ------
+  const int64_t faults_before =
+      GlobalMetrics().faults_injected_total.load(std::memory_order_relaxed);
+  auto dl = RunScenario(
+      "delay", "ring-send:" + std::to_string(rs1) + ":1:delay:100",
+      /*cycles=*/2, /*do_barrier=*/false);
+  for (int r = 0; r < kRanks; ++r) {
+    if (!dl[r].completed) {
+      Fail("delay", r, "delay injection broke the job: " + dl[r].reason);
+    }
+  }
+  if (GlobalMetrics().faults_injected_total.load(std::memory_order_relaxed) <=
+      faults_before) {
+    Fail("delay", -1, "faults_injected_total never incremented");
+  }
+
+  // --- corrupt-tag: every rank fails fast, bounded, no hang --------------
+  ExpectAllAborted(
+      "corrupt-tag",
+      RunScenario("corrupt-tag",
+                  "frame-header:" + std::to_string(fh1) + ":1:corrupt-tag",
+                  /*cycles=*/2, /*do_barrier=*/false),
+      /*bound_s=*/6.0);
+
+  // --- ring-recv drop: dead data socket mid-ring -------------------------
+  ExpectAllAborted(
+      "ring-recv",
+      RunScenario("ring-recv",
+                  "ring-recv:" + std::to_string(rr2) + ":2:drop",
+                  /*cycles=*/2, /*do_barrier=*/false),
+      /*bound_s=*/6.0);
+
+  // --- coordinator-recv drop: the ABORT broadcast names the culprit ------
+  const int64_t prop_before =
+      GlobalMetrics().abort_propagation_us.count.load(
+          std::memory_order_relaxed);
+  auto cd = RunScenario("coordinator-recv", "coordinator-recv:0:1:drop",
+                        /*cycles=*/2, /*do_barrier=*/false);
+  ExpectAllAborted("coordinator-recv", cd, /*bound_s=*/6.0);
+  if (cd[2].init_ok && cd[2].reason.find("rank 1") == std::string::npos) {
+    Fail("coordinator-recv", 2,
+         "survivor's reason does not name the culprit: " + cd[2].reason);
+  }
+  if (GlobalMetrics().abort_propagation_us.count.load(
+          std::memory_order_relaxed) <= prop_before) {
+    Fail("coordinator-recv", -1,
+         "abort_propagation_us never observed the broadcast latency");
+  }
+
+  // --- shm-fence drop: the dissemination fence collapses -----------------
+  ExpectAllAborted(
+      "shm-fence",
+      RunScenario("shm-fence",
+                  "shm-fence:" + std::to_string(sf1) + ":1:drop",
+                  /*cycles=*/2, /*do_barrier=*/true),
+      /*bound_s=*/6.0);
+
+  ::unsetenv("HOROVOD_FAULT_INJECT");
+  InitFaultInjection();
+  if (failures.load() != 0) {
+    std::printf("FAIL (%d)\n", failures.load());
+    return 1;
+  }
+  std::printf("PASS\n");
+  return 0;
+}

@@ -156,6 +156,12 @@ class Controller {
   // it yet (reference: stall_inspector.cc per-rank missing lists).
   virtual std::string StallReport(double older_than_s) { return ""; }
 
+  // Blocks (bounded by the abort-propagation timeout) until this rank has
+  // learned why the job is aborting — the coordinator's ABORT broadcast
+  // names the culprit rank/host — and returns that reason, or "" if none
+  // arrived in time.  Local controller: no peers, nothing to wait for.
+  virtual std::string WaitAbortReason() { return ""; }
+
   // Cumulative negotiation ctrl-channel payload bytes (sent, received) by
   // this rank — the cache bit-vector fast path's measurable effect: cache
   // hits travel as 16-byte (id, handle) pairs instead of full request

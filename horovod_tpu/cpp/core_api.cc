@@ -25,6 +25,7 @@
 
 #include "common.h"
 #include "controller.h"
+#include "fault_injection.h"
 #include "logging.h"
 #include "metrics.h"
 #include "parameter_manager.h"
@@ -73,6 +74,18 @@ struct GlobalState {
 };
 
 GlobalState* g = nullptr;
+
+// Init failures tear down `g` before returning, which would leave
+// hvd_last_error() answering "not initialized" — losing the reason
+// (e.g. a malformed HOROVOD_FAULT_INJECT parse error) exactly when the
+// caller needs it.  Failed-init reasons park here instead.
+std::mutex init_err_mu;
+std::string init_error;
+
+void SetInitError(const std::string& msg) {
+  std::lock_guard<std::mutex> l(init_err_mu);
+  init_error = msg;
+}
 
 void SetLastError(const std::string& msg) {
   std::lock_guard<std::mutex> l(g->err_mu);
@@ -204,6 +217,9 @@ void BackgroundLoop() {
         }
       } else {
         HVD_LOG(ERROR) << "negotiation failed: " << s.reason;
+        // Mark the abort on the trace so a merged multi-rank timeline shows
+        // when each survivor learned of the failure.
+        g->timeline.Instant("ABORT");
       }
       FailAllOutstanding("Horovod negotiation failed: " + s.reason);
       continue;
@@ -375,6 +391,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int timeline_mark_cycles, double stall_warn_s,
              double stall_shutdown_s, int log_level) {
   if (g != nullptr) return -1;
+  SetInitError("");  // a fresh attempt must not inherit a stale reason
   g = new GlobalState();
   auto& cfg = g->cfg;
   cfg.rank = rank;
@@ -403,6 +420,21 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
   g->fusion_threshold.store(fusion);
 
+  // Fault injection (HOROVOD_FAULT_INJECT) arms before any thread exists so
+  // hit counters are deterministic from the first frame.  A malformed spec
+  // fails init loudly: silently running a chaos test with zero faults armed
+  // would pass for the wrong reason.
+  {
+    std::string ferr = InitFaultInjection();
+    if (!ferr.empty()) {
+      SetInitError(ferr);
+      HVD_LOG(ERROR) << "init failed: " << ferr;
+      delete g;
+      g = nullptr;
+      return -2;
+    }
+  }
+
   // The registry is process-global (instrumentation points sit below the
   // GlobalState), so re-init within one process starts from zero.
   GlobalMetrics().Reset();
@@ -426,7 +458,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   }
   Status s = g->controller->Initialize();
   if (!s.ok()) {
-    SetLastError(s.reason);
+    SetInitError(s.reason);
     HVD_LOG(ERROR) << "init failed: " << s.reason;
     GlobalMetrics().enabled.store(false, std::memory_order_relaxed);
     delete g;
@@ -560,7 +592,17 @@ static void SetSeq(long long seq) {
 
 static int StatusToInt(const Status& s) {
   if (s.ok()) return 0;
-  SetLastError(s.reason);
+  std::string reason = s.reason;
+  if (s.code == StatusCode::ABORTED) {
+    // A data-plane socket failure only says "peer died"; the coordinator's
+    // ABORT broadcast (bounded wait) names the culprit rank/host.  Fold it
+    // in so the HorovodInternalError the executor raises is actionable.
+    std::string why = g->controller->WaitAbortReason();
+    if (!why.empty() && reason.find(why) == std::string::npos) {
+      reason += " [" + why + "]";
+    }
+  }
+  SetLastError(reason);
   return -static_cast<int>(s.code);
 }
 
@@ -750,9 +792,21 @@ void hvd_stop_timeline() {
 }
 
 const char* hvd_last_error() {
-  if (g == nullptr) return "not initialized";
+  if (g == nullptr) {
+    std::lock_guard<std::mutex> l(init_err_mu);
+    return init_error.empty() ? "not initialized" : init_error.c_str();
+  }
   std::lock_guard<std::mutex> l(g->err_mu);
   return g->last_error.c_str();
+}
+
+// Validate a HOROVOD_FAULT_INJECT spec without arming anything: returns ""
+// when well-formed, else the same actionable message init would fail with.
+// Lets horovodrun --fault-inject reject typos before spawning np workers.
+const char* hvd_fault_spec_check(const char* spec) {
+  static thread_local std::string err;
+  err = ParseFaultSpec(spec ? spec : "", nullptr);
+  return err.c_str();
 }
 
 }  // extern "C"

@@ -42,9 +42,12 @@ void MetricsRegistry::Reset() {
   bytes_fused_total.store(0, std::memory_order_relaxed);
   stall_warnings_total.store(0, std::memory_order_relaxed);
   straggler_reports_total.store(0, std::memory_order_relaxed);
+  aborts_total.store(0, std::memory_order_relaxed);
+  faults_injected_total.store(0, std::memory_order_relaxed);
   negotiation_wait_us.Reset();
   ring_hop_us.Reset();
   shm_fence_us.Reset();
+  abort_propagation_us.Reset();
 }
 
 std::string MetricsRegistry::DumpJson(int rank,
@@ -66,10 +69,14 @@ std::string MetricsRegistry::DumpJson(int rank,
      << stall_warnings_total.load(std::memory_order_relaxed)
      << ",\"straggler_reports_total\":"
      << straggler_reports_total.load(std::memory_order_relaxed)
+     << ",\"aborts_total\":" << aborts_total.load(std::memory_order_relaxed)
+     << ",\"faults_injected_total\":"
+     << faults_injected_total.load(std::memory_order_relaxed)
      << "},\"histograms\":{"
      << "\"negotiation_wait_us\":" << negotiation_wait_us.Json()
      << ",\"ring_hop_us\":" << ring_hop_us.Json()
-     << ",\"shm_fence_us\":" << shm_fence_us.Json() << "}";
+     << ",\"shm_fence_us\":" << shm_fence_us.Json()
+     << ",\"abort_propagation_us\":" << abort_propagation_us.Json() << "}";
   if (!extra_json.empty()) os << ',' << extra_json;
   os << "}";
   return os.str();

@@ -72,10 +72,16 @@ struct MetricsRegistry {
   std::atomic<int64_t> stall_warnings_total{0};
   std::atomic<int64_t> straggler_reports_total{0};
 
+  // Failure plane: ABORT frames sent/observed and fault-injection rule
+  // fires (fault_injection.h).
+  std::atomic<int64_t> aborts_total{0};
+  std::atomic<int64_t> faults_injected_total{0};
+
   // Latency distributions.
   Histogram negotiation_wait_us;  // enqueue -> fused response mapped back
   Histogram ring_hop_us;          // one pipelined chunk exchange step
   Histogram shm_fence_us;         // shm/hier dissemination-barrier fences
+  Histogram abort_propagation_us;  // coordinator ABORT send -> worker observe
 
   void Reset();
 

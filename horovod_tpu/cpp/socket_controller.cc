@@ -3,12 +3,17 @@
 #include <poll.h>
 #include <unistd.h>
 
+#include <cerrno>
+
 #include <algorithm>
+#include <chrono>
 #include <cstdlib>
 #include <cstring>
 #include <sstream>
+#include <thread>
 #include <unordered_map>
 
+#include "fault_injection.h"
 #include "logging.h"
 
 namespace hvdtpu {
@@ -21,10 +26,11 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-// v7: metrics snapshot trailer on worker CYCLE frames (v6 added the
-// wire_comp codec byte in responses, v5 the host key in the rendezvous
-// HELLO/book + the hier bit in responses)
-constexpr int32_t kProtocolVersion = 7;
+// v8: ABORT control frames + worker failure FIN sentinel (v7 added the
+// metrics snapshot trailer on worker CYCLE frames, v6 the wire_comp codec
+// byte in responses, v5 the host key in the rendezvous HELLO/book + the
+// hier bit in responses)
+constexpr int32_t kProtocolVersion = 8;
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -63,6 +69,10 @@ constexpr int32_t kTagHierVerdict = 0x12000;
 // mismatch rather than decode garbage.
 constexpr int32_t kTagCompReduceScatter = 0x12800;
 constexpr int32_t kTagCompAllgather = 0x13000;
+// Fast-abort control frame (protocol v8): rides the ctrl channel in the
+// responses position as [-2][kTagAbort][reason][culprit_rank][culprit_host]
+// [f64 send wallclock]; the tag double-checks the sentinel parse.
+constexpr int32_t kTagAbort = 0x13800;
 
 // Broadcasts at least this large take the pipelined chain instead of the
 // binomial tree.  A protocol constant: the algorithm choice must agree on
@@ -71,6 +81,14 @@ constexpr int32_t kTagCompAllgather = 0x13000;
 // but HOROVOD_RING_CHUNK_BYTES=0 (pipelining off) selects different wire
 // protocols and must be uniform across ranks, as documented in socketio.h.
 constexpr int64_t kBroadcastChainBytes = 1 << 20;
+
+// Wall-clock seconds (system_clock): the abort-propagation latency spans
+// PROCESSES, so the monotonic clock (per-process epoch) cannot measure it.
+double WallSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
 
 }  // namespace
 
@@ -110,6 +128,29 @@ SocketController::SocketController(const CoreConfig& cfg)
     char* end = nullptr;
     double v = std::strtod(env, &end);
     if (end && *end == '\0' && v >= 0) straggler_min_us_ = v * 1000.0;
+  }
+  // Fast-abort propagation bound: how long a rank waits for the
+  // coordinator's ABORT (culprit attribution) after observing a local
+  // failure, before failing with its own less-specific reason.
+  if (const char* env = ::getenv("HOROVOD_ABORT_PROPAGATION_TIMEOUT")) {
+    char* end = nullptr;
+    double v = std::strtod(env, &end);
+    if (end && *end == '\0' && v > 0) abort_timeout_s_ = v;
+  }
+  // Rendezvous retry policy (worker->coordinator connect): attempts and
+  // the exponential-backoff base; the overall budget stays bounded by
+  // kConnectTimeoutS regardless.
+  if (const char* env = ::getenv("HOROVOD_RENDEZVOUS_RETRIES")) {
+    char* end = nullptr;
+    long long v = std::strtoll(env, &end, 10);
+    if (end && *end == '\0' && v > 0) {
+      rendezvous_retries_ = static_cast<int>(std::min<long long>(v, 10000));
+    }
+  }
+  if (const char* env = ::getenv("HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS")) {
+    char* end = nullptr;
+    long long v = std::strtoll(env, &end, 10);
+    if (end && *end == '\0' && v >= 0) rendezvous_backoff_base_ms_ = v;
   }
   if (is_coordinator()) {
     cluster_.resize(cfg.size);
@@ -202,6 +243,15 @@ Status SocketController::Initialize() {
         return Status::Error(StatusCode::INVALID_ARGUMENT,
                              "bad HELLO from worker");
       }
+      if (FaultInjectionOn()) {
+        // Site rank = the REMOTE worker being accepted; drop closes its
+        // connection so the worker exercises the rendezvous retry/backoff.
+        FaultAction fa = FaultCheck(kFaultRendezvousAccept, rank);
+        if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+          s.Close();
+          continue;
+        }
+      }
       addrs[rank] = s.PeerAddr();
       ports[rank] = data_port;
       hosts[rank] = host_key;
@@ -227,26 +277,64 @@ Status SocketController::Initialize() {
       }
     }
   } else {
-    if (!coord_ctrl_.Connect(cfg_.rendezvous_addr, cfg_.rendezvous_port,
-                             kConnectTimeoutS)) {
-      return Status::Error(StatusCode::PRECONDITION_ERROR,
-                           "worker failed to reach coordinator at " +
-                               cfg_.rendezvous_addr + ":" +
-                               std::to_string(cfg_.rendezvous_port));
-    }
-    Writer hello;
-    hello.PutI32(kProtocolMagic);
-    hello.PutI32(kProtocolVersion);
-    hello.PutI32(cfg_.rank);
-    hello.PutI32(data_listener_.port());
-    hello.PutString(hosts[cfg_.rank]);
-    if (!coord_ctrl_.SendFrame(hello.data())) {
-      return Status::Error(StatusCode::PRECONDITION_ERROR, "HELLO failed");
-    }
+    // Rendezvous with exponential backoff + deterministic jitter: refused/
+    // dropped connections during startup (coordinator not listening yet,
+    // an accept-side injected drop) are RETRYABLE; permission and
+    // address-family errors are fatal immediately so a misconfigured job
+    // fails in milliseconds, not after the full connect budget.  One
+    // attempt spans connect + HELLO + book — a coordinator that accepts
+    // and then drops us before the book must also re-enter the loop.
     std::string book;
-    if (!coord_ctrl_.RecvFrame(&book)) {
-      return Status::Error(StatusCode::PRECONDITION_ERROR,
-                           "failed to receive mesh address book");
+    bool joined = false;
+    const double deadline = MonotonicSeconds() + kConnectTimeoutS;
+    long long delay_ms = rendezvous_backoff_base_ms_;
+    for (int attempt = 0; attempt < rendezvous_retries_; ++attempt) {
+      if (MonotonicSeconds() > deadline) break;
+      if (attempt > 0) {
+        // Exponential up to ~1 s, minus a deterministic per-rank jitter
+        // (up to half the delay) so same-host workers de-collide without
+        // non-reproducible randomness.
+        long long d = std::min<long long>(delay_ms, 1000);
+        if (d > 0) {
+          d -= static_cast<long long>(
+              (static_cast<unsigned long long>(cfg_.rank) * 2654435761ULL +
+               static_cast<unsigned long long>(attempt)) %
+              static_cast<unsigned long long>(d / 2 + 1));
+          std::this_thread::sleep_for(std::chrono::milliseconds(d));
+        }
+        delay_ms = std::min<long long>(delay_ms * 2, 1000);
+      }
+      coord_ctrl_ = Socket();
+      if (!coord_ctrl_.ConnectOnce(cfg_.rendezvous_addr,
+                                   cfg_.rendezvous_port)) {
+        if (!ConnectErrnoRetryable(coord_ctrl_.last_errno())) {
+          return Status::Error(
+              StatusCode::PRECONDITION_ERROR,
+              "worker cannot reach coordinator at " + cfg_.rendezvous_addr +
+                  ":" + std::to_string(cfg_.rendezvous_port) + ": " +
+                  std::strerror(coord_ctrl_.last_errno()) +
+                  " (fatal, not retrying)");
+        }
+        continue;
+      }
+      Writer hello;
+      hello.PutI32(kProtocolMagic);
+      hello.PutI32(kProtocolVersion);
+      hello.PutI32(cfg_.rank);
+      hello.PutI32(data_listener_.port());
+      hello.PutString(hosts[cfg_.rank]);
+      if (!coord_ctrl_.SendFrame(hello.data())) continue;
+      if (!coord_ctrl_.RecvFrame(&book)) continue;
+      joined = true;
+      break;
+    }
+    if (!joined) {
+      return Status::Error(
+          StatusCode::PRECONDITION_ERROR,
+          "worker failed to reach coordinator at " + cfg_.rendezvous_addr +
+              ":" + std::to_string(cfg_.rendezvous_port) + " within " +
+              std::to_string(rendezvous_retries_) + " attempts / " +
+              std::to_string(static_cast<int>(kConnectTimeoutS)) + "s");
     }
     Reader r(book);
     for (int rank = 0; rank < cfg_.size; ++rank) {
@@ -433,6 +521,13 @@ void SocketController::Shutdown() {
   if (!initialized_) return;
   initialized_ = false;
   aborted_ = true;
+  {
+    // Expire any WaitAbortReason waiters: no ABORT is coming once the
+    // sockets close, and teardown must not serve the propagation timeout.
+    std::lock_guard<std::mutex> l(abort_mu_);
+    abort_wait_deadline_ = -1;
+  }
+  abort_cv_.notify_all();
   coord_ctrl_.Close();
   for (auto& s : ctrl_socks_) s.Close();
   for (auto& s : peer_socks_) s.Close();
@@ -470,9 +565,228 @@ void SocketController::Shutdown() {
 
 Status SocketController::ComputeResponses(
     std::vector<TensorRequest>& new_requests, std::vector<Response>* out) {
-  if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  if (aborted_) {
+    // An executor lane observed a data-plane failure before the control
+    // plane did.  Workers send a best-effort failure FIN and await the
+    // coordinator's ABORT so the error names the culprit; the coordinator
+    // sweeps its ctrl sockets for one and broadcasts.  Clean teardown
+    // (farewell/Shutdown) keeps the plain fast path.
+    if (peer_shutdown_ || !initialized_) {
+      return Status::Error(StatusCode::ABORTED, "controller down");
+    }
+    return is_coordinator() ? CoordinatorAbortSweep()
+                            : WorkerAbortHandshake();
+  }
   return is_coordinator() ? CoordinatorCycle(new_requests, out)
                           : WorkerCycle(new_requests, out);
+}
+
+// ---------------------------------------------------------------------------
+// Fast-abort propagation (protocol v8)
+// ---------------------------------------------------------------------------
+
+void SocketController::SetAbortReason(const std::string& reason) {
+  {
+    std::lock_guard<std::mutex> l(abort_mu_);
+    if (abort_reason_.empty()) abort_reason_ = reason;
+  }
+  abort_cv_.notify_all();
+}
+
+std::string SocketController::AbortReason() {
+  std::lock_guard<std::mutex> l(abort_mu_);
+  return abort_reason_;
+}
+
+std::string SocketController::WaitAbortReason() {
+  std::unique_lock<std::mutex> l(abort_mu_);
+  if (!abort_reason_.empty()) return abort_reason_;
+  // The wait budget is charged ONCE, at the first waiter: stacked executor
+  // lanes blocking here serially must not multiply the propagation bound.
+  if (abort_wait_deadline_ == 0) {
+    abort_wait_deadline_ = MonotonicSeconds() + abort_timeout_s_;
+  }
+  while (abort_reason_.empty()) {
+    const double left = abort_wait_deadline_ - MonotonicSeconds();
+    if (left <= 0) break;
+    abort_cv_.wait_for(l, std::chrono::duration<double>(left));
+  }
+  return abort_reason_;
+}
+
+Status SocketController::BroadcastAbortAndFail(int culprit_rank,
+                                               const std::string& why) {
+  aborted_ = true;
+  std::string culprit_host;
+  if (culprit_rank >= 0 &&
+      culprit_rank < static_cast<int>(host_keys_.size())) {
+    culprit_host = host_keys_[culprit_rank];
+  }
+  std::string msg = "collective aborted: " + why;
+  if (culprit_rank >= 0) {
+    msg += " (culprit rank " + std::to_string(culprit_rank) + ", host " +
+           (culprit_host.empty() ? "?" : culprit_host) + ")";
+  }
+  if (!abort_broadcast_done_) {
+    abort_broadcast_done_ = true;
+    Writer w;
+    w.PutI32(-2);  // ABORT sentinel in the responses position
+    w.PutI32(kTagAbort);
+    w.PutString(why);
+    w.PutI32(culprit_rank);
+    w.PutString(culprit_host);
+    w.PutF64(WallSeconds());
+    int notified = 0;
+    for (int rank = 1; rank < cfg_.size; ++rank) {
+      if (rank == culprit_rank || departed_ranks_.count(rank)) continue;
+      if (!ctrl_socks_[rank].valid()) continue;
+      if (ctrl_socks_[rank].SendFrame(w.data())) ++notified;
+    }
+    if (MetricsOn()) {
+      GlobalMetrics().aborts_total.fetch_add(1, std::memory_order_relaxed);
+    }
+    HVD_LOG(ERROR) << "broadcast ABORT to " << notified
+                   << " survivors: " << msg;
+    SetAbortReason(msg);
+  }
+  return Status::Error(StatusCode::ABORTED, msg);
+}
+
+Status SocketController::HandleAbortFrame(Reader* rd) {
+  aborted_ = true;
+  got_abort_ = true;
+  const int32_t tag = rd->GetI32();
+  std::string why = rd->GetString();
+  const int32_t culprit = rd->GetI32();
+  const std::string host = rd->GetString();
+  const double sent_ts = rd->GetF64();
+  if (!rd->ok() || tag != kTagAbort) {
+    const std::string msg = "malformed ABORT frame from coordinator";
+    SetAbortReason(msg);
+    return Status::Error(StatusCode::ABORTED, msg);
+  }
+  if (MetricsOn()) {
+    auto& m = GlobalMetrics();
+    m.aborts_total.fetch_add(1, std::memory_order_relaxed);
+    // Cross-process latency: wall clock, clamped (hosts may skew).
+    m.abort_propagation_us.ObserveSeconds(
+        std::max(0.0, WallSeconds() - sent_ts));
+  }
+  std::string msg = "aborted by coordinator: " + why;
+  if (culprit >= 0) {
+    msg += " (culprit rank " + std::to_string(culprit) + ", host " +
+           (host.empty() ? "?" : host) + ")";
+  }
+  SetAbortReason(msg);
+  return Status::Error(StatusCode::ABORTED, msg);
+}
+
+Status SocketController::WorkerAbortHandshake() {
+  {
+    std::lock_guard<std::mutex> l(abort_mu_);
+    if (!abort_reason_.empty()) {
+      return Status::Error(StatusCode::ABORTED, abort_reason_);
+    }
+  }
+  if (got_abort_ || !coord_ctrl_.valid()) {
+    return Status::Error(StatusCode::ABORTED, "controller down");
+  }
+  if (!fin_sent_) {
+    fin_sent_ = true;
+    Writer w;
+    w.PutI32(-2);  // failure FIN in the cycle-frame position
+    w.PutString("rank " + std::to_string(cfg_.rank) +
+                " observed a data-plane failure");
+    coord_ctrl_.SendFrame(w.data());  // best effort
+  }
+  // Drain the ctrl channel toward the coordinator's ABORT, bounded by the
+  // propagation timeout.  Stale RESPONSES frames from the cycle in flight
+  // when the failure hit are discarded.
+  const double deadline = MonotonicSeconds() + abort_timeout_s_;
+  while (MonotonicSeconds() < deadline) {
+    pollfd pfd{coord_ctrl_.fd(), POLLIN, 0};
+    const int rc = ::poll(&pfd, 1, 200);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (rc == 0) continue;
+    std::string frame;
+    if (!coord_ctrl_.RecvFrame(&frame)) break;  // coordinator died too
+    Reader rd(frame);
+    const int32_t n = rd.GetI32();
+    if (n == -1) {
+      peer_shutdown_ = true;
+      const std::string msg = "coordinator shut down the job";
+      SetAbortReason(msg);
+      return Status::Error(StatusCode::ABORTED, msg);
+    }
+    if (n == -2) return HandleAbortFrame(&rd);
+  }
+  const std::string msg =
+      "data-plane failure on rank " + std::to_string(cfg_.rank) +
+      " (no coordinator ABORT within " + std::to_string(abort_timeout_s_) +
+      "s)";
+  SetAbortReason(msg);
+  return Status::Error(StatusCode::ABORTED, msg);
+}
+
+Status SocketController::CoordinatorAbortSweep() {
+  {
+    std::lock_guard<std::mutex> l(abort_mu_);
+    if (!abort_reason_.empty()) {
+      return Status::Error(StatusCode::ABORTED, abort_reason_);
+    }
+  }
+  if (abort_broadcast_done_) {
+    return Status::Error(StatusCode::ABORTED, "controller down");
+  }
+  // Find the culprit: poll the live ctrl sockets for a failure FIN or a
+  // dead connection, bounded by the propagation timeout.  Normal CYCLE
+  // frames from ranks that have not noticed yet are discarded — the job
+  // is aborting either way.
+  int culprit = -1;
+  std::string why;
+  const double deadline = MonotonicSeconds() + abort_timeout_s_;
+  while (culprit < 0 && MonotonicSeconds() < deadline) {
+    std::vector<pollfd> pfds;
+    std::vector<int> ranks;
+    for (int rank = 1; rank < cfg_.size; ++rank) {
+      if (departed_ranks_.count(rank) || !ctrl_socks_[rank].valid()) continue;
+      pfds.push_back(pollfd{ctrl_socks_[rank].fd(), POLLIN, 0});
+      ranks.push_back(rank);
+    }
+    if (pfds.empty()) break;
+    const int rc = ::poll(pfds.data(), static_cast<nfds_t>(pfds.size()), 200);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (rc == 0) continue;
+    for (size_t i = 0; i < pfds.size() && culprit < 0; ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      const int rank = ranks[i];
+      std::string frame;
+      if (!ctrl_socks_[rank].RecvFrame(&frame)) {
+        culprit = rank;
+        why = "lost connection to rank " + std::to_string(rank);
+        break;
+      }
+      Reader rd(frame);
+      const int32_t n_cached = rd.GetI32();
+      if (n_cached == -2) {  // failure FIN
+        culprit = rank;
+        why = rd.GetString();
+        if (!rd.ok() || why.empty()) {
+          why = "rank " + std::to_string(rank) + " reported a failure";
+        }
+        break;
+      }
+      if (n_cached == -1) departed_ranks_.insert(rank);
+    }
+  }
+  if (culprit < 0) why = "coordinator observed a local failure";
+  return BroadcastAbortAndFail(culprit, why);
 }
 
 void SocketController::Announce(int rank, TensorRequest req,
@@ -630,11 +944,18 @@ Status SocketController::CoordinatorCycle(
   for (auto& r : new_requests) Announce(0, std::move(r), &errors);
   for (int rank = 1; rank < cfg_.size; ++rank) {
     if (departed_ranks_.count(rank)) continue;
+    if (FaultInjectionOn()) {
+      // Site rank = the REMOTE worker whose frame is being gathered;
+      // closing its ctrl socket makes the recv below fail like a death.
+      FaultAction fa = FaultCheck(kFaultCoordinatorRecv, rank);
+      if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+        ctrl_socks_[rank].Close();
+      }
+    }
     std::string frame;
     if (!ctrl_socks_[rank].RecvFrame(&frame)) {
-      aborted_ = true;
-      return Status::Error(StatusCode::ABORTED,
-                           "lost connection to rank " + std::to_string(rank));
+      return BroadcastAbortAndFail(
+          rank, "lost connection to rank " + std::to_string(rank));
     }
     ctrl_recv_.fetch_add(frame.size(), std::memory_order_relaxed);
     Reader rd(frame);
@@ -643,6 +964,13 @@ Status SocketController::CoordinatorCycle(
       departed_ranks_.insert(rank);
       HVD_LOG(INFO) << "rank " << rank << " shut down cleanly";
       continue;
+    }
+    if (n_cached == -2) {  // failure FIN: the worker saw a failure first
+      std::string why = rd.GetString();
+      if (!rd.ok() || why.empty()) {
+        why = "rank " + std::to_string(rank) + " reported a failure";
+      }
+      return BroadcastAbortAndFail(rank, why);
     }
     for (int32_t i = 0; i < n_cached; ++i) {
       int64_t id = rd.GetI64();
@@ -804,10 +1132,9 @@ Status SocketController::CoordinatorCycle(
     if (departed_ranks_.count(rank)) continue;
     ctrl_sent_.fetch_add(payload.size(), std::memory_order_relaxed);
     if (!ctrl_socks_[rank].SendFrame(payload)) {
-      aborted_ = true;
-      return Status::Error(StatusCode::ABORTED,
-                           "failed to send responses to rank " +
-                               std::to_string(rank));
+      return BroadcastAbortAndFail(rank,
+                                   "failed to send responses to rank " +
+                                       std::to_string(rank));
     }
   }
   if (MetricsOn()) {
@@ -965,8 +1292,14 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   if (n == -1) {  // coordinator farewell: the job is ending deliberately
     peer_shutdown_ = true;
     aborted_ = true;
+    // Latch the reason so WaitAbortReason callers return immediately
+    // instead of burning the propagation timeout at clean teardown.
+    SetAbortReason("coordinator shut down the job");
     return Status::Error(StatusCode::ABORTED,
                          "coordinator shut down the job");
+  }
+  if (n == -2) {  // coordinator ABORT broadcast (protocol v8)
+    return HandleAbortFrame(&rd);
   }
   out->clear();
   out->reserve(n);
@@ -1101,6 +1434,10 @@ Status SocketController::Members(int psid, std::vector<int>* members,
 }
 
 void SocketController::PutFrameHeader(Writer* w, int64_t seq, int32_t tag) {
+  if (FaultInjectionOn() &&
+      FaultCheck(kFaultFrameHeader, cfg_.rank) == FaultAction::kCorruptTag) {
+    tag ^= 0x5A5A;  // the receiver must fail fast on the header mismatch
+  }
   w->PutI64(seq);
   w->PutI32(tag);
 }
@@ -1126,6 +1463,23 @@ Status SocketController::ExchangeStep(std::vector<Socket>& socks, int send_to,
                                       const std::string& frame,
                                       int recv_from, std::string* in) {
   if (aborted_) return Status::Error(StatusCode::ABORTED, "controller down");
+  if (FaultInjectionOn()) {
+    FaultAction fa = FaultCheck(kFaultRingSend, cfg_.rank);
+    if (fa == FaultAction::kDrop) {
+      socks[send_to].Close();
+    } else if (fa == FaultAction::kTruncate) {
+      // Length prefix + half the payload, then cut: the peer sees a
+      // mid-frame EOF instead of a clean close.
+      uint32_t len = static_cast<uint32_t>(frame.size());
+      socks[send_to].SendAll(&len, 4);
+      socks[send_to].SendAll(frame.data(), frame.size() / 2);
+      socks[send_to].Close();
+    }
+    fa = FaultCheck(kFaultRingRecv, cfg_.rank);
+    if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+      socks[recv_from].Close();
+    }
+  }
   CountSend(send_to, static_cast<int64_t>(frame.size()));
   if (!DuplexExchange(socks[send_to], frame, socks[recv_from], in,
                       [this] { return aborted_.load(); })) {
@@ -1148,6 +1502,26 @@ Status SocketController::ChunkedStep(
   Writer w;
   PutFrameHeader(&w, current_seq_, tag);
   const int64_t hdr = static_cast<int64_t>(w.data().size());
+  if (FaultInjectionOn()) {
+    FaultAction fa = FaultCheck(kFaultRingSend, cfg_.rank);
+    if (fa == FaultAction::kDrop) {
+      socks[send_to].Close();
+    } else if (fa == FaultAction::kTruncate) {
+      // Frame a full first chunk but deliver only half its payload, then
+      // cut: the peer dies mid-chunk, not at a frame boundary.
+      const int64_t cb = chunk_bytes > 0 ? chunk_bytes : (1 << 19);
+      const int64_t chunk = std::min<int64_t>(send_len, cb);
+      uint32_t flen = static_cast<uint32_t>(hdr + chunk);
+      socks[send_to].SendAll(&flen, 4);
+      socks[send_to].SendAll(w.data().data(), w.data().size());
+      if (chunk > 0) socks[send_to].SendAll(send_base, chunk / 2);
+      socks[send_to].Close();
+    }
+    fa = FaultCheck(kFaultRingRecv, cfg_.rank);
+    if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+      socks[recv_from].Close();
+    }
+  }
   CountSend(send_to, send_len + hdr,
             (raw_len < 0 ? send_len : raw_len) + hdr);
   const double hop_t0 = MetricsOn() ? MonotonicSeconds() : 0.0;
@@ -1894,6 +2268,15 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
   // not plane bookkeeping.
   const double fence_t0 =
       tag_base >= kTagShmSize && MetricsOn() ? MonotonicSeconds() : 0.0;
+  if (FaultInjectionOn()) {
+    // shm-fence faults target the FENCE (not a specific peer socket):
+    // drop/truncate close the next-neighbor link the first round uses, so
+    // the whole fence collapses deterministically.
+    FaultAction fa = FaultCheck(kFaultShmFence, cfg_.rank);
+    if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+      if (m > 1) socks[members[(idx + 1) % m]].Close();
+    }
+  }
   // Dissemination barrier: ceil(log2(m)) duplex rounds.
   for (int k = 1; k < m; k <<= 1) {
     const int to = members[(idx + k) % m];

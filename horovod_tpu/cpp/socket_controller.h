@@ -28,6 +28,7 @@
 #pragma once
 
 #include <atomic>
+#include <condition_variable>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -77,6 +78,15 @@ class SocketController : public Controller {
   Status Barrier(int process_set_id) override;
 
   std::string StallReport(double older_than_s) override;
+
+  // Abort-reason plumbing (fast-abort propagation, protocol v8): the first
+  // ABORT observed (coordinator broadcast or locally detected peer death)
+  // latches a reason naming the culprit; WaitAbortReason blocks — bounded
+  // by HOROVOD_ABORT_PROPAGATION_TIMEOUT, charged only once across stacked
+  // waiters — so an executor whose own exchange failed FIRST still reports
+  // the coordinator's culprit attribution instead of a bare socket error.
+  std::string WaitAbortReason() override;
+  std::string AbortReason();
 
   // Per-process-set data channels (the NCCL-communicator analog): a
   // dedicated socket mesh among the set's members, so collectives on
@@ -216,6 +226,25 @@ class SocketController : public Controller {
                           std::vector<Response>* out);
   Status WorkerCycle(std::vector<TensorRequest>& new_requests,
                      std::vector<Response>* out);
+
+  // -- fast-abort propagation (protocol v8) ---------------------------------
+  // Coordinator: broadcast ABORT(reason, culprit rank/host) on every live
+  // ctrl socket (best-effort), latch the reason, and return the ABORTED
+  // status every caller of the failed cycle sees.  Idempotent: only the
+  // first call broadcasts.
+  Status BroadcastAbortAndFail(int culprit_rank, const std::string& why);
+  // First-writer-wins reason latch + wakeup for WaitAbortReason.
+  void SetAbortReason(const std::string& reason);
+  // Entry path when the executor observed a local data-plane failure
+  // before the control plane did (aborted_ set, ComputeResponses called):
+  // workers send a best-effort failure FIN and await the coordinator's
+  // ABORT; the coordinator sweeps ctrl sockets for the culprit and
+  // broadcasts.  Both are bounded by abort_timeout_s_.
+  Status WorkerAbortHandshake();
+  Status CoordinatorAbortSweep();
+  // Parse the body of a [-2][kTagAbort]... frame (worker side): latches
+  // the reason, observes propagation latency, returns the ABORTED status.
+  Status HandleAbortFrame(Reader* rd);
   void Announce(int rank, TensorRequest req, std::vector<Response>* errors);
   void UpdateCachesAndSeq(std::vector<Response>* responses);
 
@@ -245,7 +274,8 @@ class SocketController : public Controller {
       int64_t raw_len = -1);
   // Frame helpers: every data frame is [i64 seq][i32 tag][raw payload];
   // seq/tag mismatches mean the mesh desynced and abort the job.
-  static void PutFrameHeader(Writer* w, int64_t seq, int32_t tag);
+  // Non-static: the frame-header fault-injection hook needs cfg_.rank.
+  void PutFrameHeader(Writer* w, int64_t seq, int32_t tag);
   Status CheckFrameHeader(Reader* rd, int32_t tag, const char* what);
 
   Status RingAllreduce(std::vector<Socket>& socks, void* buf, int64_t count,
@@ -424,6 +454,22 @@ class SocketController : public Controller {
   std::set<int> departed_ranks_;            // clean-exited workers
   int32_t last_joined_ = -1;
   bool peer_shutdown_ = false;
+  // -- fast-abort state (protocol v8) --------------------------------------
+  // abort_mu_ guards abort_reason_/abort_wait_deadline_; abort_cv_ wakes
+  // WaitAbortReason when the reason latches.  The bools are only touched
+  // from the single background (negotiation) thread.
+  std::mutex abort_mu_;
+  std::condition_variable abort_cv_;
+  std::string abort_reason_;
+  double abort_wait_deadline_ = 0;  // first WaitAbortReason sets it once
+  bool fin_sent_ = false;           // worker failure FIN sent (send once)
+  bool got_abort_ = false;          // coordinator's ABORT already received
+  bool abort_broadcast_done_ = false;  // coordinator broadcast once
+  // HOROVOD_ABORT_PROPAGATION_TIMEOUT / HOROVOD_RENDEZVOUS_RETRIES /
+  // HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS (ctor reads the env).
+  double abort_timeout_s_ = 2.0;
+  int rendezvous_retries_ = 30;
+  long long rendezvous_backoff_base_ms_ = 50;
   int64_t arrival_counter_ = 0;
   int64_t seq_counter_ = 0;   // global data-op sequence (all ranks agree)
   // seq for the next data op on this lane thread (thread_local so

@@ -193,6 +193,56 @@ bool Socket::Connect(const std::string& addr, int port, double timeout_s) {
   return false;
 }
 
+bool Socket::ConnectOnce(const std::string& addr, int port) {
+  last_errno_ = 0;
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, addr.c_str(), &sa.sin_addr) != 1) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(addr.c_str(), nullptr, &hints, &res) != 0 || !res) {
+      // Name resolution may come up after the worker does, exactly like
+      // the listener: report it as retryable.
+      last_errno_ = EAGAIN;
+      return false;
+    }
+    sa.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
+  }
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    last_errno_ = errno;
+    return false;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  TuneDataSocketBuffers(fd);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    last_errno_ = errno;
+    ::close(fd);
+    return false;
+  }
+  fd_ = fd;
+  return true;
+}
+
+bool ConnectErrnoRetryable(int err) {
+  switch (err) {
+    case ECONNREFUSED:
+    case ETIMEDOUT:
+    case EHOSTUNREACH:
+    case ENETUNREACH:
+    case EAGAIN:
+    case EINTR:
+      return true;
+    default:
+      return false;
+  }
+}
+
 bool Socket::SendAll(const void* p, size_t n) {
   const char* c = static_cast<const char*>(p);
   size_t sent = 0;

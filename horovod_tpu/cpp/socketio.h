@@ -101,6 +101,12 @@ class Socket {
   Socket& operator=(Socket&& o) noexcept;
 
   bool Connect(const std::string& addr, int port, double timeout_s);
+  // Single connect attempt, no internal retry loop: the caller owns the
+  // retry policy (rendezvous exponential backoff).  On failure last_errno()
+  // holds the connect errno (resolve failures report EAGAIN — retryable,
+  // DNS may come up after the worker).
+  bool ConnectOnce(const std::string& addr, int port);
+  int last_errno() const { return last_errno_; }
   bool SendFrame(const std::string& payload);
   bool RecvFrame(std::string* payload);
   // Raw (unframed) helpers for bulk data-plane payloads.
@@ -118,7 +124,14 @@ class Socket {
 
  private:
   int fd_ = -1;
+  int last_errno_ = 0;
 };
+
+// Whether a failed connect attempt is worth retrying: refused/timed-out/
+// unreachable mean the peer may simply not be up yet (startup race);
+// permission and address-family errors will never heal and must fail
+// immediately with a named cause.
+bool ConnectErrnoRetryable(int err);
 
 // Simultaneously send one frame on `send_sock` and receive one frame from
 // `recv_sock` without deadlocking — ring/pairwise collective steps have every

@@ -47,8 +47,16 @@ def run(func):
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as exc:
-                log.warning("elastic: collective failed (%s); restoring "
-                            "last committed state", exc)
+                msg = str(exc)
+                if "culprit rank" in msg:
+                    # Fast-abort attribution (socket_controller.cc ABORT
+                    # broadcast): the coordinator named the failed peer, so
+                    # log it — on a TPU pod this is usually the preempted VM.
+                    log.warning("elastic: aborted by a peer failure — %s; "
+                                "restoring last committed state", msg)
+                else:
+                    log.warning("elastic: collective failed (%s); restoring "
+                                "last committed state", exc)
                 if not _client.is_elastic_worker():
                     raise
                 state.restore()

@@ -54,6 +54,8 @@ def _env_number(name: str, default, cast):
 
 BLACKLIST_FAILURES = _env_number(
     "HOROVOD_ELASTIC_BLACKLIST_FAILURES", 2, int)
+BLACKLIST_BASE_SECS = _env_number(
+    "HOROVOD_ELASTIC_BLACKLIST_BASE_SECS", 60.0, float)
 DISCOVERY_INTERVAL_S = _env_number(
     "HOROVOD_ELASTIC_DISCOVERY_INTERVAL", 1.0, float)
 FAST_FAILURE_S = _env_number(
@@ -161,7 +163,14 @@ class ElasticDriver:
 
         self._lock = threading.Lock()
         self._workers: Dict[str, _Worker] = {}      # worker_id -> worker
-        self._blacklist: set = set()
+        # host -> blacklist expiry (monotonic).  Unlike the reference's
+        # permanent blacklist (registration.py), entries EXPIRE with
+        # exponential backoff: a preempted-and-restored TPU VM re-enters
+        # the pool after BLACKLIST_BASE_SECS, while a host that keeps
+        # crash-looping sits out 1x, 2x, 4x, ... the base (capped at 64x).
+        self._blacklist: Dict[str, float] = {}
+        self._blacklist_counts: Dict[str, int] = {}  # host -> times listed
+        self._clock = time.monotonic  # injectable for expiry tests
         self._failures: Dict[str, List[float]] = {}  # host -> failure times
         self._generation = -1
         self._formed_size = 0     # size of the last formed generation
@@ -293,9 +302,29 @@ class ElasticDriver:
             sys.stdout.write(f"{tag}<stdout>: {line}")
             sys.stdout.flush()
 
+    def _blacklisted(self, host: str, now: Optional[float] = None) -> bool:
+        """True while ``host`` is serving a blacklist sentence.  Expired
+        entries are dropped on observation (the count persists, so a repeat
+        offence doubles the next sentence)."""
+        expiry = self._blacklist.get(host)
+        if expiry is None:
+            return False
+        if (self._clock() if now is None else now) >= expiry:
+            del self._blacklist[host]
+            return False
+        return True
+
+    def _blacklist_host(self, host: str, now: float) -> float:
+        """(Re-)blacklist ``host``; returns the sentence length in secs."""
+        count = self._blacklist_counts.get(host, 0) + 1
+        self._blacklist_counts[host] = count
+        duration = BLACKLIST_BASE_SECS * (2 ** min(count - 1, 6))
+        self._blacklist[host] = now + duration
+        return duration
+
     def _monitor(self, w: _Worker) -> None:
         rc = w.proc.wait()
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             w.dead = True
             if rc == 0:
@@ -312,10 +341,11 @@ class ElasticDriver:
                           if now - t < 4 * FAST_FAILURE_S]
                 self._failures[w.host] = recent
                 if (len(recent) >= BLACKLIST_FAILURES
-                        and w.host not in self._blacklist):
-                    self._blacklist.add(w.host)
+                        and not self._blacklisted(w.host, now)):
+                    duration = self._blacklist_host(w.host, now)
                     print(f"elastic driver: blacklisting host {w.host} "
-                          f"after {len(recent)} fast failures",
+                          f"after {len(recent)} fast failures "
+                          f"(expires in {duration:.0f}s)",
                           file=sys.stderr)
         if self.verbose:
             print(f"elastic driver: worker {w.worker_id} exited rc={rc}",
@@ -325,7 +355,7 @@ class ElasticDriver:
     # -- generations ---------------------------------------------------------
     def _target_hosts(self) -> Dict[str, int]:
         hosts = self.discovery.find_available_hosts()
-        return {h: s for h, s in hosts.items() if h not in self._blacklist}
+        return {h: s for h, s in hosts.items() if not self._blacklisted(h)}
 
     def _form_generation(self) -> bool:
         """One rendezvous round.  Returns False if the job must abort."""
@@ -344,7 +374,7 @@ class ElasticDriver:
                   + ("; reusing previous host set" if prev else ""),
                   file=sys.stderr)
             target = {h: s for h, s in (prev or {}).items()
-                      if h not in self._blacklist}
+                      if not self._blacklisted(h)}
 
         cap = self.max_np if self.max_np else sum(target.values())
         slots = []

@@ -79,6 +79,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="codec for fp32 allreduce payloads on cross-host "
                         "ring hops; accumulation stays fp32 "
                         "(HOROVOD_WIRE_COMPRESSION)")
+    p.add_argument("--fault-inject", default=None, metavar="SPEC",
+                   help="deterministic fault injection for chaos testing: "
+                        "comma-separated site:cycle:rank:action[:arg] rules "
+                        "exported to every worker as HOROVOD_FAULT_INJECT "
+                        "(validated before any worker spawns; see "
+                        "docs/observability.md)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=None)
@@ -178,6 +184,8 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.wire_compression:
         env["HOROVOD_WIRE_COMPRESSION"] = args.wire_compression
+    if args.fault_inject:
+        env["HOROVOD_FAULT_INJECT"] = args.fault_inject
     if args.stall_check_disable:
         env["HOROVOD_STALL_CHECK_DISABLE"] = "1"
     if args.stall_check_warning_time_seconds is not None:
@@ -321,6 +329,19 @@ def _run(args: argparse.Namespace) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+    if args.fault_inject:
+        # Pre-validate the spec against the native parser so a typo fails
+        # here with one actionable message instead of failing hvd.init()
+        # on every spawned worker at once.
+        try:
+            from .._core import check_fault_spec
+
+            err = check_fault_spec(args.fault_inject)
+        except Exception:
+            err = ""  # no native core on the launch host; workers validate
+        if err:
+            print(f"error: --fault-inject: {err}", file=sys.stderr)
+            return 2
     if args.host_discovery_script or args.tpu_discovery \
             or args.min_np is not None:
         from .elastic_driver import run_elastic

@@ -43,7 +43,7 @@ log = get_logger()
 # Mirror of kProtocolVersion in cpp/socket_controller.cc — the two MUST move
 # together (tools/hvd_lint.py enforces it).  Exposed so launcher diagnostics
 # and rendezvous error messages can name the wire generation they speak.
-PROTOCOL_VERSION = 7
+PROTOCOL_VERSION = 8
 
 
 @dataclasses.dataclass

@@ -25,7 +25,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 import torch
 
-from ..context import HorovodContext
+from ..context import HorovodContext, register_shutdown_callback
 from ..process_sets import ProcessSet, _resolve_psid
 from ..wire import OpType, ReduceOp
 
@@ -134,8 +134,27 @@ class _HandleTable:
         with self._lock:
             return self._entries.pop(handle, (None, None))
 
+    def sweep(self) -> List[int]:
+        """Drop every outstanding entry, returning the swept handles."""
+        with self._lock:
+            handles = list(self._entries)
+            self._entries.clear()
+        return handles
+
 
 _handles = _HandleTable()
+
+
+def _sweep_on_shutdown() -> None:
+    # Abort/shutdown sweep: outstanding async ops will never be
+    # synchronized (the core failed them), so forget their torch-side
+    # bookkeeping — the strong tensor references and in-place write-back
+    # targets — or a post-abort hvd.init() in an elastic retry loop would
+    # see stale handles from the dead job.
+    _handles.sweep()
+
+
+register_shutdown_callback(_sweep_on_shutdown)
 
 # Reference-parity ReduceOp aliases (horovod.torch exposes these names).
 Average = ReduceOp.AVERAGE

@@ -27,6 +27,19 @@ IGNORED_VARS = (
     "HOROVOD_ADASUM_MPI_CHUNK_SIZE",
 )
 
+# Robustness knobs consumed natively (C++ getenv) below the ctypes ABI,
+# registered here for discoverability (hvd_lint's NATIVE_READ_VARS is the
+# enforcement side):
+#   HOROVOD_FAULT_INJECT              deterministic fault-injection spec,
+#                                     comma-separated site:cycle:rank:action[:arg]
+#   HOROVOD_ABORT_PROPAGATION_TIMEOUT seconds a failed worker waits for the
+#                                     coordinator's ABORT broadcast before
+#                                     raising with a generic reason
+#   HOROVOD_RENDEZVOUS_RETRIES        rendezvous connect attempts before
+#                                     giving up on the coordinator
+#   HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS  base delay of the exponential
+#                                     rendezvous retry backoff
+
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, same default as reference
 DEFAULT_CYCLE_TIME_MS = 1.0
 DEFAULT_CACHE_CAPACITY = 1024

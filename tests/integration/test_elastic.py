@@ -427,6 +427,40 @@ def test_elastic_discovery_flap_within_one_poll():
         assert proc.stderr.count(" formed with ") == 1, proc.stderr
 
 
+def test_blacklist_sentence_expires_and_backs_off():
+    """The blacklist is a sentence, not a death warrant: entries expire
+    after BLACKLIST_BASE_SECS, each repeat offence doubles the sentence,
+    and the doubling caps at 64x.  Driven directly with an injected clock
+    (no processes)."""
+    from horovod_tpu.runner import elastic_driver as ed
+
+    drv = ed.ElasticDriver(ed.FixedHosts({"badhost": 2}), ["true"],
+                           min_np=1, max_np=None)
+    t = [1000.0]
+    drv._clock = lambda: t[0]
+    base = ed.BLACKLIST_BASE_SECS
+
+    assert drv._blacklist_host("badhost", t[0]) == base
+    assert drv._blacklisted("badhost")
+    assert "badhost" not in drv._target_hosts()   # filtered while serving
+    t[0] += base - 1
+    assert drv._blacklisted("badhost")            # still serving
+    t[0] += 2
+    assert not drv._blacklisted("badhost")        # sentence served
+    assert drv._target_hosts() == {"badhost": 2}  # back in the pool
+
+    # Repeat offence: the count persisted, so the sentence doubles...
+    assert drv._blacklist_host("badhost", t[0]) == 2 * base
+    t[0] += 2 * base + 1
+    assert not drv._blacklisted("badhost")
+    # ...and keeps doubling up to the 64x cap, never beyond.
+    for _ in range(10):
+        duration = drv._blacklist_host("badhost", t[0])
+    assert duration == 64 * base
+    # An unrelated host starts at the base sentence.
+    assert drv._blacklist_host("otherhost", t[0]) == base
+
+
 def test_elastic_min_np_not_met_fails_cleanly():
     """VERDICT r4 #8b: repeated fast worker deaths blacklist the only
     host; with min-np unreachable the driver must fail the job cleanly

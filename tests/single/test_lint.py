@@ -249,6 +249,14 @@ def test_protocol_duplicate_tag_is_found():
     assert "PROTO-TAG-DUP:0x9000" in keys
 
 
+def test_protocol_abort_tag_collision_is_found():
+    # A kTagAbort seeded onto an existing tag value (the v8 fast-abort
+    # frame must own its own tag) is caught as a duplicate.
+    sc = SC_OK + "constexpr int32_t kTagAbort = 0x9000;\n"
+    keys = {f.key for f in _proto(sc=sc)}
+    assert "PROTO-TAG-DUP:0x9000" in keys
+
+
 def test_protocol_fence_tag_below_threshold_is_found():
     sc = SC_OK.replace("kTagShmWrite = 0x9000", "kTagShmWrite = 0x7800")
     keys = {f.key for f in _proto(sc=sc)}
@@ -278,9 +286,9 @@ def test_cli_exits_nonzero_on_seeded_mismatch(tmp_path):
     shutil.copy(os.path.join(REPO, "README.md"), tmp_path / "README.md")
     sc = tmp_path / "horovod_tpu" / "cpp" / "socket_controller.cc"
     text = sc.read_text()
-    assert "kProtocolVersion = 7" in text
-    sc.write_text(text.replace("kProtocolVersion = 7",
-                               "kProtocolVersion = 8"))
+    assert "kProtocolVersion = 8" in text
+    sc.write_text(text.replace("kProtocolVersion = 8",
+                               "kProtocolVersion = 9"))
     run = subprocess.run(
         [sys.executable, str(tmp_path / "tools" / "hvd_lint.py"),
          "--repo", str(tmp_path),

@@ -63,6 +63,31 @@ def test_chunk_exchange_selftest():
     _build_and_run("chunk_exchange_selftest")
 
 
+def test_chaos_selftest():
+    """Fault-injection spec parsing, calibrated hit-index triggering, and
+    the v8 fast-abort machinery: kTagAbort broadcast with culprit
+    attribution, bounded abort handshakes, rendezvous backoff healing a
+    dropped HELLO, and benign delay injection with bit-correct results."""
+    _build_and_run("chaos_selftest")
+
+
+def test_chaos_selftest_under_tsan():
+    """The abort paths run concurrently with executor lanes mid-collapse;
+    TSan proves the collapse itself is race-free."""
+    out = _build_and_run("tsan_chaos_selftest")
+    assert "ThreadSanitizer" not in out, out
+
+
+def test_chaos_selftest_under_asan():
+    out = _build_and_run("asan_chaos_selftest")
+    assert "AddressSanitizer" not in out, out
+
+
+def test_chaos_selftest_under_ubsan():
+    out = _build_and_run("ubsan_chaos_selftest")
+    assert "runtime error" not in out, out
+
+
 def test_make_selftest_target():
     """`make selftest` builds and runs every non-TSAN selftest binary —
     including the ASan/UBSan variants — in one shot: the entry point

@@ -47,7 +47,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Symbols whose Python binding deliberately tolerates an old .so that
 # predates them (declared inside try/except, callers hasattr-guard):
 # the checker allows conditional declaration but still verifies types.
-OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2"}
+OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
+                    "hvd_fault_spec_check"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
@@ -63,6 +64,10 @@ NATIVE_READ_VARS = {
     "HOROVOD_METRICS_REPORT_SECONDS",
     "HOROVOD_STRAGGLER_SKEW",
     "HOROVOD_STRAGGLER_MIN_MS",
+    "HOROVOD_FAULT_INJECT",
+    "HOROVOD_ABORT_PROPAGATION_TIMEOUT",
+    "HOROVOD_RENDEZVOUS_RETRIES",
+    "HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS",
 }
 
 # Public knobs read in Python outside utils/env.py (module-scope or
@@ -81,6 +86,7 @@ PY_DIRECT_VARS = {
     "HOROVOD_ELASTIC_DISCOVERY_INTERVAL",
     "HOROVOD_ELASTIC_FAST_FAILURE_SECS",
     "HOROVOD_ELASTIC_BLACKLIST_FAILURES",
+    "HOROVOD_ELASTIC_BLACKLIST_BASE_SECS",
 }
 
 # Infrastructure plumbing set by one launcher component and read by

@@ -25,8 +25,11 @@ combination of:
            (cycle occupancy, negotiation-wait histogram) when enabled
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
-consistency, both sets) and the ASan/UBSan selftest builds (slow, full set
-only).
+consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
+contract, both sets), and — full set only — the ASan/UBSan selftest
+builds, the `chaos` fault-injection/fast-abort selftest, and the np=4
+fault-injection pytest (`fault-np4`: abort bound, corrupt-tag fail-fast,
+elastic recovery under --fault-inject).
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -261,15 +264,23 @@ def combos(quick: bool):
 
 
 def checks(quick: bool):
-    """Non-workload rows: static analysis and the sanitizer builds.
+    """Non-workload rows: static analysis, the sanitizer builds, and the
+    fault axis.
 
-    Yields (name, [argv, ...], cwd) — the argvs run in sequence, all must
-    exit 0.  `lint` is pure text analysis (no build) and belongs in the
-    quick set; the sanitizer rows compile the whole controller stack
-    (~1 min each on a laptop core) and are slow, so full matrix only.
+    Yields (name, [argv, ...], cwd[, timeout]) — the argvs run in
+    sequence, all must exit 0.  `lint` is pure text analysis (no build)
+    and belongs in the quick set, as does `fault-spec` (the parser
+    contract the quick chaos story rests on); the sanitizer rows compile
+    the whole controller stack (~1 min each on a laptop core), and the
+    chaos/np=4 fault rows exercise whole-job collapse, so full matrix
+    only.
     """
     yield ("lint",
            [[sys.executable, os.path.join(REPO, "tools", "hvd_lint.py")]],
+           REPO)
+    yield ("fault-spec",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "single", "test_fault_spec.py")]],
            REPO)
     if quick:
         return
@@ -277,6 +288,17 @@ def checks(quick: bool):
         yield (target.split("_")[0],
                [["make", target], [os.path.join(CPP_DIR, target)]],
                CPP_DIR)
+    yield ("chaos",
+           [["make", "chaos_selftest"],
+            [os.path.join(CPP_DIR, "chaos_selftest")]],
+           CPP_DIR)
+    # Whole-job collapse measured from Python: injected rank death within
+    # the abort bound, corrupt-tag fail-fast, elastic --fault-inject
+    # recovery.  Three multi-process scenarios: give them their own cap.
+    yield ("fault-np4",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_fault_injection.py")]],
+           REPO, 600.0)
 
 
 def run_check(cmds, cwd: str, timeout: float) -> tuple:
@@ -312,6 +334,9 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     env.pop("HOROVOD_METRICS", None)
     env.pop("HOROVOD_METRICS_FILE", None)
     env.pop("HOROVOD_METRICS_INTERVAL", None)
+    # An ambient fault-injection spec would sabotage every workload combo
+    # (that's its job); faults belong to the dedicated check rows only.
+    env.pop("HOROVOD_FAULT_INJECT", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -358,8 +383,10 @@ def main() -> int:
     args = ap.parse_args()
 
     failures = []
-    for name, cmds, cwd in checks(args.quick):
-        ok, dt, detail = run_check(cmds, cwd, args.timeout)
+    for row in checks(args.quick):
+        name, cmds, cwd = row[:3]
+        timeout = row[3] if len(row) > 3 else args.timeout
+        ok, dt, detail = run_check(cmds, cwd, timeout)
         label = f"check={name}"
         print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
               flush=True)

@@ -120,6 +120,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_data_plane_stats2.argtypes = [
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
         c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+    try:
+        # Old-ABI tolerance: a stale .so predating the v9 leader tree
+        # loses ctrl_plane_stats() (degrades to zeros), nothing else.
+        lib.hvd_ctrl_plane_stats.argtypes = [
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong),
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+    except AttributeError:
+        pass
     lib.hvd_start_timeline.argtypes = [c.c_char_p, c.c_int]
     lib.hvd_stop_timeline.argtypes = []
     try:
@@ -410,6 +418,28 @@ class NativeCore(CoreBackend):
         self._lib.hvd_negotiation_stats(ctypes.byref(sent),
                                         ctypes.byref(recv))
         return {"ctrl_sent": sent.value, "ctrl_recv": recv.value}
+
+    def ctrl_plane_stats(self) -> dict:
+        """Cumulative negotiation ctrl-plane frame and payload-byte counters
+        for this rank.  On the coordinator, ctrl_msgs_recv per cycle is the
+        leader-tree (HOROVOD_CONTROL_TREE, protocol v9) acceptance metric:
+        flat mode receives one frame per worker per cycle, tree mode one per
+        local child plus one aggregate per remote host.  An old .so without
+        the entry point returns zeros."""
+        if not hasattr(self._lib, "hvd_ctrl_plane_stats"):
+            return {"ctrl_msgs_sent": 0, "ctrl_msgs_recv": 0,
+                    "ctrl_bytes_sent": 0, "ctrl_bytes_recv": 0}
+        msgs_sent = ctypes.c_longlong()
+        msgs_recv = ctypes.c_longlong()
+        bytes_sent = ctypes.c_longlong()
+        bytes_recv = ctypes.c_longlong()
+        self._lib.hvd_ctrl_plane_stats(
+            ctypes.byref(msgs_sent), ctypes.byref(msgs_recv),
+            ctypes.byref(bytes_sent), ctypes.byref(bytes_recv))
+        return {"ctrl_msgs_sent": msgs_sent.value,
+                "ctrl_msgs_recv": msgs_recv.value,
+                "ctrl_bytes_sent": bytes_sent.value,
+                "ctrl_bytes_recv": bytes_recv.value}
 
     def data_plane_stats(self) -> dict:
         """Cumulative host-data-plane bytes sent by this rank, split by

@@ -67,11 +67,11 @@ struct RankOutcome {
 // failed data op is followed by one more ComputeResponses (the abort
 // handshake — worker FIN / coordinator sweep + broadcast), and the reason
 // the Python layer would surface comes from WaitAbortReason().
-void ChaosRank(const char* scenario, int rank, int port, int cycles,
+void ChaosRank(const char* scenario, int rank, int size, int port, int cycles,
                bool do_barrier, RankOutcome* out) {
   CoreConfig cfg;
   cfg.rank = rank;
-  cfg.size = kRanks;
+  cfg.size = size;
   cfg.rendezvous_addr = "127.0.0.1";
   cfg.rendezvous_port = port;
   SocketController ctl(cfg);
@@ -106,7 +106,8 @@ void ChaosRank(const char* scenario, int rank, int port, int cycles,
       std::vector<float> buf(1024, static_cast<float>(rank + 1));
       s = ctl.AllreduceBuffer(buf.data(), 1024, DataType::FLOAT32,
                               ReduceOp::SUM, 0);
-      if (s.ok() && (buf[0] != 6.0f || buf[1023] != 6.0f)) {
+      const float want = static_cast<float>(size * (size + 1) / 2);
+      if (s.ok() && (buf[0] != want || buf[1023] != want)) {
         Fail(scenario, rank, "wrong allreduce result");
         s = Status::Error(StatusCode::ABORTED, "wrong allreduce result");
       }
@@ -130,8 +131,9 @@ void ChaosRank(const char* scenario, int rank, int port, int cycles,
 }
 
 std::vector<RankOutcome> RunScenario(const char* name, const std::string& spec,
-                                     int cycles, bool do_barrier) {
-  std::vector<RankOutcome> out(kRanks);
+                                     int cycles, bool do_barrier,
+                                     int size = kRanks) {
+  std::vector<RankOutcome> out(size);
   ::setenv("HOROVOD_FAULT_INJECT", spec.c_str(), 1);
   std::string err = InitFaultInjection();
   if (!err.empty()) {
@@ -144,9 +146,9 @@ std::vector<RankOutcome> RunScenario(const char* name, const std::string& spec,
     return out;
   }
   std::vector<std::thread> threads;
-  threads.reserve(kRanks);
-  for (int r = 0; r < kRanks; ++r) {
-    threads.emplace_back(ChaosRank, name, r, port, cycles, do_barrier,
+  threads.reserve(size);
+  for (int r = 0; r < size; ++r) {
+    threads.emplace_back(ChaosRank, name, r, size, port, cycles, do_barrier,
                          &out[r]);
   }
   for (auto& t : threads) t.join();
@@ -156,7 +158,7 @@ std::vector<RankOutcome> RunScenario(const char* name, const std::string& spec,
 void ExpectAllAborted(const char* name,
                       const std::vector<RankOutcome>& out,
                       double bound_s) {
-  for (int r = 0; r < kRanks; ++r) {
+  for (int r = 0; r < static_cast<int>(out.size()); ++r) {
     if (out[r].completed) {
       Fail(name, r, "completed cleanly despite the injected fault");
     } else if (out[r].reason.empty()) {
@@ -286,6 +288,29 @@ int main() {
                   "shm-fence:" + std::to_string(sf1) + ":1:drop",
                   /*cycles=*/2, /*do_barrier=*/true),
       /*bound_s=*/6.0);
+
+  // --- leader-recv drop: v9 leader tree, a host leader (NOT the
+  // coordinator) loses its child mid-cycle.  np=4 over 2 fake hosts puts
+  // ranks {2,3} on host 1 with rank 2 as their leader; dropping child 3's
+  // cycle frame at leader 2 kills that link, the leader's FIN climbs to
+  // the coordinator with the culprit, and every rank — including the
+  // orphaned child, which drains the direct ABORT off its coordinator
+  // link — aborts bounded with rank 3 named through the tree.
+  ::setenv("HOROVOD_HIER_FAKE_HOSTS", "2", 1);
+  ::setenv("HOROVOD_CONTROL_TREE", "on", 1);
+  auto lr = RunScenario("leader-recv", "leader-recv:0:3:drop",
+                        /*cycles=*/2, /*do_barrier=*/false, /*size=*/4);
+  ::unsetenv("HOROVOD_CONTROL_TREE");
+  ::unsetenv("HOROVOD_HIER_FAKE_HOSTS");
+  ExpectAllAborted("leader-recv", lr, /*bound_s=*/6.0);
+  if (lr[1].init_ok && lr[1].reason.find("rank 3") == std::string::npos) {
+    Fail("leader-recv", 1,
+         "worker on the healthy host does not name the culprit through "
+         "the tree: " + lr[1].reason);
+  }
+  if (lr[3].init_ok && lr[3].reason.empty()) {
+    Fail("leader-recv", 3, "orphaned child aborted without a reason");
+  }
 
   ::unsetenv("HOROVOD_FAULT_INJECT");
   InitFaultInjection();

@@ -171,6 +171,19 @@ class Controller {
     *recv = 0;
   }
 
+  // Ctrl-plane traffic counters: frames and payload bytes this rank sent /
+  // received on negotiation links (coordinator, leader-tree parent, and —
+  // on leaders — child links).  On the coordinator this is the choke-point
+  // measurement the v9 leader tree exists to shrink: messages per cycle
+  // drop from O(ranks) to O(local ranks + hosts).  Local controller: zero.
+  virtual void CtrlPlaneStats(int64_t* msgs_sent, int64_t* msgs_recv,
+                              int64_t* bytes_sent, int64_t* bytes_recv) const {
+    *msgs_sent = 0;
+    *msgs_recv = 0;
+    *bytes_sent = 0;
+    *bytes_recv = 0;
+  }
+
  protected:
   CoreConfig cfg_;
   ProcessSetTable process_sets_;

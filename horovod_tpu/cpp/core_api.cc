@@ -734,6 +734,21 @@ void hvd_negotiation_stats(long long* sent, long long* recv) {
   *recv = r;
 }
 
+// Ctrl-plane frame + byte counters (protocol v9): on the coordinator,
+// msgs_recv per negotiation cycle is the leader-tree acceptance metric —
+// O(ranks) flat vs O(local ranks + hosts) with the tree engaged.
+void hvd_ctrl_plane_stats(long long* msgs_sent, long long* msgs_recv,
+                          long long* bytes_sent, long long* bytes_recv) {
+  *msgs_sent = *msgs_recv = *bytes_sent = *bytes_recv = 0;
+  if (g == nullptr) return;
+  int64_t ms = 0, mr = 0, bs = 0, br = 0;
+  g->controller->CtrlPlaneStats(&ms, &mr, &bs, &br);
+  *msgs_sent = ms;
+  *msgs_recv = mr;
+  *bytes_sent = bs;
+  *bytes_recv = br;
+}
+
 // Data-plane byte accounting split by locality (host plane only): bytes
 // sent to ranks sharing this rank's host key vs. bytes crossing hosts.
 // Lets tests assert the hierarchical composition actually shrinks

@@ -1,0 +1,232 @@
+// Control-plane soak: 256 in-process ranks (threads + loopback sockets)
+// driving the negotiation lock-step with CoreConfig.ctrl_only, which skips
+// the O(n^2) data mesh / shm / hierarchy so one machine can hold np=256.
+//
+// Two phases over 16 fake hosts (HOROVOD_HIER_FAKE_HOSTS):
+//   flat  (HOROVOD_CONTROL_TREE=off): every worker talks to rank 0.
+//   tree  (HOROVOD_CONTROL_TREE=on):  host leaders aggregate, so rank 0
+//         sees (local ranks - 1) + (hosts - 1) frames per cycle.
+// The acceptance assert is the tentpole claim made mechanically checkable:
+// coordinator inbound control messages per cycle drop O(n) -> O(hosts),
+// i.e. flat >= 8x tree at 256 ranks / 16 hosts (255 vs 30 = 8.5x).
+//
+// Rendezvous runs with HOROVOD_RENDEZVOUS_ACCEPTORS=8 so the 255-way HELLO
+// herd also soaks the sharded acceptor path.  Built with the sanitizer
+// matrix (`make tsan_ctrl_soak_selftest` etc.) this proves the leader
+// cycle, aggregate parsing, and counter paths race-free at scale.  Run by
+// tests/single/test_native_selftests.py and `make selftest`.
+
+#include <sys/resource.h>
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "socket_controller.h"
+
+namespace hvdtpu {
+int GetLogLevel() { return 4; }  // errors only
+void SetLogLevel(int) {}
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+namespace {
+
+int failures = 0;
+
+void Fail(const char* phase, int rank, const std::string& what) {
+  std::fprintf(stderr, "FAIL [%s] rank %d: %s\n", phase, rank, what.c_str());
+  ++failures;
+}
+
+int FreePort() {
+  Listener probe;
+  if (!probe.Listen("127.0.0.1", 0)) return -1;
+  return probe.port();
+}
+
+// Reusable rendezvous-style barrier: the main thread participates so it can
+// snapshot the coordinator's counters while every rank thread is parked
+// between negotiation phases (no cycle in flight).
+class Barrier {
+ public:
+  explicit Barrier(int n) : n_(n) {}
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    const int gen = gen_;
+    if (++count_ == n_) {
+      count_ = 0;
+      ++gen_;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] { return gen != gen_; });
+    }
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  const int n_;
+  int count_ = 0;
+  int gen_ = 0;
+};
+
+struct Phase {
+  Barrier init, start, done, exit_;
+  explicit Phase(int n) : init(n), start(n), done(n), exit_(n) {}
+};
+
+void SoakRank(const char* phase_name, int rank, int size, int port,
+              int cycles, Phase* ph, SocketController** slot,
+              std::string* err) {
+  CoreConfig cfg;
+  cfg.rank = rank;
+  cfg.size = size;
+  cfg.rendezvous_addr = "127.0.0.1";
+  cfg.rendezvous_port = port;
+  cfg.ctrl_only = true;
+  SocketController ctl(cfg);
+  *slot = &ctl;
+  Status s = ctl.Initialize();
+  if (!s.ok()) {
+    *err = "init: " + s.reason;
+    *slot = nullptr;
+  }
+  ph->init.Wait();
+  ph->start.Wait();
+  if (err->empty()) {
+    for (int cycle = 0; cycle < cycles; ++cycle) {
+      TensorRequest req;
+      req.name = "soak" + std::to_string(cycle);
+      req.op = OpType::ALLREDUCE;
+      req.dtype = DataType::FLOAT32;
+      req.nbytes = 4 * 16;
+      req.shape = {16};
+      std::vector<TensorRequest> reqs{req};
+      std::vector<Response> resps;
+      s = ctl.ComputeResponses(reqs, &resps);
+      if (!s.ok()) {
+        *err = "cycle " + std::to_string(cycle) + ": " + s.reason;
+        break;
+      }
+      if (resps.size() != 1 || !resps[0].error.empty()) {
+        *err = "cycle " + std::to_string(cycle) + ": bad response";
+        break;
+      }
+    }
+  }
+  ph->done.Wait();
+  ph->exit_.Wait();
+  if (err->empty()) ctl.Farewell();
+  ctl.Shutdown();
+  *slot = nullptr;
+}
+
+// Runs one negotiation phase at `size` ranks and returns the coordinator's
+// inbound control messages per cycle (measured between two full-quiescence
+// barriers, so rendezvous and farewell traffic never pollute the number).
+int64_t RunPhase(const char* name, const char* tree_mode, int size,
+                 int cycles) {
+  ::setenv("HOROVOD_CONTROL_TREE", tree_mode, 1);
+  const int port = FreePort();
+  if (port < 0) {
+    Fail(name, -1, "no free port");
+    return -1;
+  }
+  Phase ph(size + 1);
+  std::vector<SocketController*> ctls(size, nullptr);
+  std::vector<std::string> errs(size);
+  std::vector<std::thread> threads;
+  threads.reserve(size);
+  for (int r = 0; r < size; ++r) {
+    threads.emplace_back(SoakRank, name, r, size, port, cycles, &ph,
+                         &ctls[r], &errs[r]);
+  }
+  ph.init.Wait();
+  int64_t ms0 = 0, mr0 = 0, bs0 = 0, br0 = 0;
+  if (ctls[0]) ctls[0]->CtrlPlaneStats(&ms0, &mr0, &bs0, &br0);
+  ph.start.Wait();
+  ph.done.Wait();
+  int64_t ms1 = 0, mr1 = 0, bs1 = 0, br1 = 0;
+  if (ctls[0]) ctls[0]->CtrlPlaneStats(&ms1, &mr1, &bs1, &br1);
+  ph.exit_.Wait();
+  for (auto& t : threads) t.join();
+  for (int r = 0; r < size; ++r) {
+    if (!errs[r].empty()) Fail(name, r, errs[r]);
+  }
+  if (failures != 0) return -1;
+  const int64_t recv_per_cycle = (mr1 - mr0) / cycles;
+  std::printf(
+      "[%s] np=%d cycles=%d coordinator: recv %lld msgs/cycle "
+      "(%lld bytes/cycle), sent %lld msgs/cycle\n",
+      name, size, cycles, static_cast<long long>(recv_per_cycle),
+      static_cast<long long>((br1 - br0) / cycles),
+      static_cast<long long>((ms1 - ms0) / cycles));
+  return recv_per_cycle;
+}
+
+}  // namespace
+
+int main() {
+  // 256 in-process ranks keep both ends of every control socket in one
+  // process; don't depend on the caller's `ulimit -n`.
+  struct rlimit rl;
+  if (::getrlimit(RLIMIT_NOFILE, &rl) == 0 && rl.rlim_cur < rl.rlim_max) {
+    rl.rlim_cur = rl.rlim_max;
+    ::setrlimit(RLIMIT_NOFILE, &rl);
+  }
+  // CTRL_SOAK_NP / CTRL_SOAK_HOSTS let a developer push this to
+  // np=512 by hand; CI runs the 256/16 acceptance geometry.
+  int np = 256;
+  int hosts = 16;
+  if (const char* env = ::getenv("CTRL_SOAK_NP")) np = std::atoi(env);
+  if (const char* env = ::getenv("CTRL_SOAK_HOSTS")) hosts = std::atoi(env);
+  if (np < 16 || hosts < 2 || np % hosts != 0) {
+    std::fprintf(stderr, "bad soak geometry np=%d hosts=%d\n", np, hosts);
+    return 1;
+  }
+  ::setenv("HOROVOD_HIER_FAKE_HOSTS", std::to_string(hosts).c_str(), 1);
+  ::setenv("HOROVOD_RENDEZVOUS_ACCEPTORS", "8", 1);
+  ::setenv("HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS", "10", 1);
+  ::setenv("HOROVOD_ABORT_PROPAGATION_TIMEOUT", "2", 1);
+
+  const int cycles = 3;
+  const int64_t flat = RunPhase("flat", "off", np, cycles);
+  const int64_t tree = RunPhase("tree", "on", np, cycles);
+  if (failures == 0 && (flat < 0 || tree <= 0)) {
+    Fail("soak", -1, "phase produced no measurement");
+  }
+  if (failures == 0) {
+    // Flat: one frame from each of the other np-1 ranks per cycle.
+    if (flat < np - 1) {
+      Fail("flat", 0,
+           "coordinator saw " + std::to_string(flat) +
+               " msgs/cycle, expected >= " + std::to_string(np - 1));
+    }
+    // Tree: local children + remote leaders only.
+    const int64_t tree_expect = (np / hosts - 1) + (hosts - 1);
+    if (tree != tree_expect) {
+      Fail("tree", 0,
+           "coordinator saw " + std::to_string(tree) +
+               " msgs/cycle, expected " + std::to_string(tree_expect));
+    }
+    // The acceptance bar: O(n) -> O(hosts) is at least an 8x cut here.
+    if (tree > 0 && flat < 8 * tree) {
+      Fail("soak", -1,
+           "flat/tree ratio " + std::to_string(flat) + "/" +
+               std::to_string(tree) + " is below the required 8x");
+    }
+  }
+
+  if (failures != 0) {
+    std::printf("FAIL (%d)\n", failures);
+    return 1;
+  }
+  std::printf("PASS\n");
+  return 0;
+}

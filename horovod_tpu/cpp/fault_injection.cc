@@ -23,12 +23,12 @@ FaultInjector& GlobalFaultInjector() {
 namespace {
 
 const char* const kSiteNames[kNumFaultSites] = {
-    "rendezvous-accept", "coordinator-recv", "ring-send",
-    "ring-recv",         "shm-fence",        "frame-header"};
+    "rendezvous-accept", "coordinator-recv", "ring-send",  "ring-recv",
+    "shm-fence",         "frame-header",     "leader-recv"};
 
 constexpr const char* kValidSites =
     "rendezvous-accept, coordinator-recv, ring-send, ring-recv, shm-fence, "
-    "frame-header";
+    "frame-header, leader-recv";
 constexpr const char* kValidActions =
     "drop, truncate, delay (arg = ms), corrupt-tag, die (arg = optional "
     "flag-file path)";

@@ -7,7 +7,7 @@
 //
 // Spec (HOROVOD_FAULT_INJECT): comma-separated `site:cycle:rank:action[:arg]`
 //   site   = rendezvous-accept | coordinator-recv | ring-send | ring-recv |
-//            shm-fence | frame-header
+//            shm-fence | frame-header | leader-recv
 //   cycle  = '*' (every matching hit) or a 0-based hit index at that
 //            (site, rank) — one-shot, latched once fired
 //   rank   = '*' or the acting rank (for coordinator-side sites: the REMOTE
@@ -35,7 +35,10 @@ enum FaultSite : int {
   kFaultRingRecv = 3,
   kFaultShmFence = 4,
   kFaultFrameHeader = 5,
-  kNumFaultSites = 6,
+  // v9 leader tree: a host leader receiving a child's CYCLE frame.  The
+  // rank field is the REMOTE child rank (mirror of coordinator-recv).
+  kFaultLeaderRecv = 6,
+  kNumFaultSites = 7,
 };
 
 enum class FaultAction : int {

@@ -44,6 +44,10 @@ void MetricsRegistry::Reset() {
   straggler_reports_total.store(0, std::memory_order_relaxed);
   aborts_total.store(0, std::memory_order_relaxed);
   faults_injected_total.store(0, std::memory_order_relaxed);
+  ctrl_msgs_sent.store(0, std::memory_order_relaxed);
+  ctrl_msgs_recv.store(0, std::memory_order_relaxed);
+  ctrl_bytes_sent.store(0, std::memory_order_relaxed);
+  ctrl_bytes_recv.store(0, std::memory_order_relaxed);
   negotiation_wait_us.Reset();
   ring_hop_us.Reset();
   shm_fence_us.Reset();
@@ -72,6 +76,14 @@ std::string MetricsRegistry::DumpJson(int rank,
      << ",\"aborts_total\":" << aborts_total.load(std::memory_order_relaxed)
      << ",\"faults_injected_total\":"
      << faults_injected_total.load(std::memory_order_relaxed)
+     << ",\"ctrl_msgs_sent\":"
+     << ctrl_msgs_sent.load(std::memory_order_relaxed)
+     << ",\"ctrl_msgs_recv\":"
+     << ctrl_msgs_recv.load(std::memory_order_relaxed)
+     << ",\"ctrl_bytes_sent\":"
+     << ctrl_bytes_sent.load(std::memory_order_relaxed)
+     << ",\"ctrl_bytes_recv\":"
+     << ctrl_bytes_recv.load(std::memory_order_relaxed)
      << "},\"histograms\":{"
      << "\"negotiation_wait_us\":" << negotiation_wait_us.Json()
      << ",\"ring_hop_us\":" << ring_hop_us.Json()

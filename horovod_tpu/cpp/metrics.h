@@ -77,6 +77,15 @@ struct MetricsRegistry {
   std::atomic<int64_t> aborts_total{0};
   std::atomic<int64_t> faults_injected_total{0};
 
+  // Control-plane traffic (protocol v9): negotiation frames and payload
+  // bytes moved on this rank's ctrl links.  On the coordinator,
+  // ctrl_msgs_recv per cycle is the leader-tree acceptance metric —
+  // O(ranks) flat vs O(local ranks + hosts) with the tree engaged.
+  std::atomic<int64_t> ctrl_msgs_sent{0};
+  std::atomic<int64_t> ctrl_msgs_recv{0};
+  std::atomic<int64_t> ctrl_bytes_sent{0};
+  std::atomic<int64_t> ctrl_bytes_recv{0};
+
   // Latency distributions.
   Histogram negotiation_wait_us;  // enqueue -> fused response mapped back
   Histogram ring_hop_us;          // one pipelined chunk exchange step

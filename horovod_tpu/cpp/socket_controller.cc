@@ -26,11 +26,18 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-// v8: ABORT control frames + worker failure FIN sentinel (v7 added the
-// metrics snapshot trailer on worker CYCLE frames, v6 the wire_comp codec
-// byte in responses, v5 the host key in the rendezvous HELLO/book + the
-// hier bit in responses)
-constexpr int32_t kProtocolVersion = 8;
+// v9: leader-tree control plane — the coordinator-authoritative ctrl_tree
+// bit trailing the rendezvous book, the [-3] leader aggregate frame in the
+// cycle position, and the culprit rank trailing failure FINs (v8 added
+// ABORT control frames + the worker failure FIN sentinel, v7 the metrics
+// snapshot trailer on worker CYCLE frames, v6 the wire_comp codec byte in
+// responses, v5 the host key in the rendezvous HELLO/book + the hier bit
+// in responses)
+constexpr int32_t kProtocolVersion = 9;
+// Mesh-HELLO psid for child->leader ctrl-tree links: negative, so it can
+// never collide with a real process-set id (those start at 1) and always
+// lands in the pending-channel stash when it races a mesh establishment.
+constexpr int32_t kCtrlTreePsid = -7;
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -152,6 +159,28 @@ SocketController::SocketController(const CoreConfig& cfg)
     long long v = std::strtoll(env, &end, 10);
     if (end && *end == '\0' && v >= 0) rendezvous_backoff_base_ms_ = v;
   }
+  // Leader-tree control plane (protocol v9).  Only the COORDINATOR's mode
+  // matters — its decision rides the rendezvous book — but every rank
+  // parses the env for symmetry; unrecognized values behave like "auto".
+  if (const char* env = ::getenv("HOROVOD_CONTROL_TREE")) {
+    std::string v = env;
+    if (v == "auto" || v == "on" || v == "off") {
+      control_tree_mode_ = v;
+    } else if (!v.empty()) {
+      HVD_LOG(WARNING) << "unrecognized HOROVOD_CONTROL_TREE=" << v
+                       << " (expected auto|on|off); using auto";
+    }
+  }
+  // Rendezvous acceptor shards: N threads accepting HELLOs concurrently on
+  // the coordinator's listener, so a thundering herd of np connects drains
+  // in parallel instead of through one serial accept loop.
+  if (const char* env = ::getenv("HOROVOD_RENDEZVOUS_ACCEPTORS")) {
+    char* end = nullptr;
+    long long v = std::strtoll(env, &end, 10);
+    if (end && *end == '\0' && v > 0) {
+      rendezvous_acceptors_ = static_cast<int>(std::min<long long>(v, 64));
+    }
+  }
   if (is_coordinator()) {
     cluster_.resize(cfg.size);
     announce_prev_.assign(cfg.size, {0, 0});
@@ -188,6 +217,8 @@ Status SocketController::Initialize() {
   std::vector<std::string> hosts(cfg_.size);
   ports[cfg_.rank] = data_listener_.port();
   hosts[cfg_.rank] = HostKey(cfg_.rank, cfg_.size);
+  // v9: coordinator-authoritative leader-tree verdict, carried in the book.
+  bool ctrl_tree_decision = false;
 
   if (is_coordinator()) {
     if (!listener_.Listen("0.0.0.0", cfg_.rendezvous_port)) {
@@ -196,86 +227,124 @@ Status SocketController::Initialize() {
                                std::to_string(cfg_.rendezvous_port));
     }
     ctrl_socks_.resize(cfg_.size);
-    int needed = cfg_.size - 1;
-    double deadline = MonotonicSeconds() + kConnectTimeoutS;
-    while (needed > 0) {
-      if (MonotonicSeconds() > deadline) {
-        return Status::Error(StatusCode::PRECONDITION_ERROR,
-                             "rendezvous timeout waiting for workers");
-      }
-      Socket s = listener_.Accept(1.0);
-      if (!s.valid()) continue;
-      // Bound the HELLO read: a connect-and-stay-silent stray must not
-      // block the accept loop past the rendezvous deadline.
-      s.SetRecvTimeout(5.0);
-      std::string hello;
-      if (!s.RecvFrame(&hello)) {
-        HVD_LOG(WARNING) << "dropping silent/broken rendezvous connection "
-                         << "from " << s.PeerAddr();
-        continue;
-      }
-      Reader r(hello);
-      int32_t magic = r.GetI32();
-      if (magic != kProtocolMagic) {
-        // Not one of ours (port scanner, stale client, or a pre-v2 build
-        // whose HELLO starts with its rank): drop and keep waiting rather
-        // than failing the whole rendezvous.
-        HVD_LOG(WARNING)
-            << "dropping rendezvous connection from " << s.PeerAddr()
-            << " with bad protocol magic (stray client, or a worker from "
-               "an older horovod_tpu build)";
-        continue;
-      }
-      int32_t version = r.GetI32();
-      if (version != kProtocolVersion) {
-        return Status::Error(
-            StatusCode::PRECONDITION_ERROR,
-            "protocol version mismatch: coordinator v" +
-                std::to_string(kProtocolVersion) + ", worker v" +
-                std::to_string(version) +
-                " — all ranks must run the same horovod_tpu build");
-      }
-      int rank = r.GetI32();
-      int data_port = r.GetI32();
-      std::string host_key = r.GetString();
-      if (!r.ok() || rank <= 0 || rank >= cfg_.size ||
-          ctrl_socks_[rank].valid()) {
-        return Status::Error(StatusCode::INVALID_ARGUMENT,
-                             "bad HELLO from worker");
-      }
-      if (FaultInjectionOn()) {
-        // Site rank = the REMOTE worker being accepted; drop closes its
-        // connection so the worker exercises the rendezvous retry/backoff.
-        FaultAction fa = FaultCheck(kFaultRendezvousAccept, rank);
-        if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
-          s.Close();
+    // Sharded rendezvous (protocol v9): N acceptor threads drain the HELLO
+    // herd concurrently off one non-blocking listener.  All book-keeping
+    // happens under rv_mu; per-thread fatal findings land in rv_err and
+    // stop every shard.  The worker-side exponential backoff (PR 5)
+    // absorbs whatever the backlog still drops.
+    const int acceptors =
+        std::max(1, std::min(rendezvous_acceptors_, cfg_.size - 1));
+    std::mutex rv_mu;
+    std::string rv_err;
+    int rv_needed = cfg_.size - 1;
+    const double deadline = MonotonicSeconds() + kConnectTimeoutS;
+    auto accept_shard = [&]() {
+      while (true) {
+        {
+          std::lock_guard<std::mutex> l(rv_mu);
+          if (rv_needed <= 0 || !rv_err.empty()) return;
+        }
+        if (MonotonicSeconds() > deadline) return;
+        Socket s = listener_.Accept(0.2);
+        if (!s.valid()) continue;
+        // Bound the HELLO read: a connect-and-stay-silent stray must not
+        // block this shard past the rendezvous deadline.
+        s.SetRecvTimeout(5.0);
+        std::string hello;
+        if (!s.RecvFrame(&hello)) {
+          HVD_LOG(WARNING) << "dropping silent/broken rendezvous connection "
+                           << "from " << s.PeerAddr();
           continue;
         }
+        Reader r(hello);
+        int32_t magic = r.GetI32();
+        if (magic != kProtocolMagic) {
+          // Not one of ours (port scanner, stale client, or a pre-v2 build
+          // whose HELLO starts with its rank): drop and keep waiting rather
+          // than failing the whole rendezvous.
+          HVD_LOG(WARNING)
+              << "dropping rendezvous connection from " << s.PeerAddr()
+              << " with bad protocol magic (stray client, or a worker from "
+                 "an older horovod_tpu build)";
+          continue;
+        }
+        int32_t version = r.GetI32();
+        if (version != kProtocolVersion) {
+          std::lock_guard<std::mutex> l(rv_mu);
+          if (rv_err.empty()) {
+            rv_err = "protocol version mismatch: coordinator v" +
+                     std::to_string(kProtocolVersion) + ", worker v" +
+                     std::to_string(version) +
+                     " — all ranks must run the same horovod_tpu build";
+          }
+          return;
+        }
+        int rank = r.GetI32();
+        int data_port = r.GetI32();
+        std::string host_key = r.GetString();
+        std::lock_guard<std::mutex> l(rv_mu);
+        if (!r.ok() || rank <= 0 || rank >= cfg_.size ||
+            ctrl_socks_[rank].valid()) {
+          if (rv_err.empty()) rv_err = "bad HELLO from worker";
+          return;
+        }
+        if (FaultInjectionOn()) {
+          // Site rank = the REMOTE worker being accepted; drop closes its
+          // connection so the worker exercises the rendezvous retry/backoff.
+          FaultAction fa = FaultCheck(kFaultRendezvousAccept, rank);
+          if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+            s.Close();
+            continue;
+          }
+        }
+        addrs[rank] = s.PeerAddr();
+        ports[rank] = data_port;
+        hosts[rank] = host_key;
+        s.SetRecvTimeout(0);  // ctrl-channel reads are blocking again
+        ctrl_socks_[rank] = std::move(s);
+        --rv_needed;
       }
-      addrs[rank] = s.PeerAddr();
-      ports[rank] = data_port;
-      hosts[rank] = host_key;
-      s.SetRecvTimeout(0);  // ctrl-channel reads are blocking again
-      ctrl_socks_[rank] = std::move(s);
-      --needed;
+    };
+    std::vector<std::thread> shards;
+    shards.reserve(acceptors - 1);
+    for (int i = 1; i < acceptors; ++i) shards.emplace_back(accept_shard);
+    accept_shard();
+    for (auto& t : shards) t.join();
+    if (!rv_err.empty()) {
+      return Status::Error(rv_err.find("mismatch") != std::string::npos
+                               ? StatusCode::PRECONDITION_ERROR
+                               : StatusCode::INVALID_ARGUMENT,
+                           rv_err);
+    }
+    if (rv_needed > 0) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "rendezvous timeout waiting for workers");
     }
     // Broadcast the address book over the ctrl channel.  Host keys ride
     // along so every rank sees the SAME host grouping — workers cannot
     // derive it from addresses (their view of rank 0's address differs
-    // from the coordinator's own).
+    // from the coordinator's own).  v9 appends the coordinator's
+    // authoritative ctrl_tree verdict: divergent HOROVOD_CONTROL_TREE
+    // envs cannot split the ring into mixed flat/tree halves.
+    const bool tree_on = DecideCtrlTree(control_tree_mode_, hosts);
     Writer book;
     for (int rank = 0; rank < cfg_.size; ++rank) {
       book.PutString(addrs[rank]);
       book.PutI32(ports[rank]);
       book.PutString(hosts[rank]);
     }
+    book.PutI32(tree_on ? 1 : 0);
     for (int rank = 1; rank < cfg_.size; ++rank) {
+      ctrl_msgs_sent_.fetch_add(1, std::memory_order_relaxed);
+      ctrl_sent_.fetch_add(static_cast<int64_t>(book.data().size()),
+                           std::memory_order_relaxed);
       if (!ctrl_socks_[rank].SendFrame(book.data())) {
         return Status::Error(StatusCode::PRECONDITION_ERROR,
                              "failed to send address book to rank " +
                                  std::to_string(rank));
       }
     }
+    ctrl_tree_decision = tree_on;
   } else {
     // Rendezvous with exponential backoff + deterministic jitter: refused/
     // dropped connections during startup (coordinator not listening yet,
@@ -342,6 +411,14 @@ Status SocketController::Initialize() {
       ports[rank] = r.GetI32();
       hosts[rank] = r.GetString();
     }
+    // v9 trailer: the coordinator's ctrl_tree verdict.  The worker's own
+    // HOROVOD_CONTROL_TREE is advisory only — obeying the book is what
+    // keeps a mixed-env job from splitting into flat and tree halves.
+    ctrl_tree_decision = (r.GetI32() == 1) && r.ok();
+    if (!r.ok()) {
+      return Status::Error(StatusCode::PRECONDITION_ERROR,
+                           "malformed rendezvous address book");
+    }
     // Workers reach rank 0 by the address they rendezvoused through.
     addrs[0] = cfg_.rendezvous_addr;
   }
@@ -351,18 +428,193 @@ Status SocketController::Initialize() {
   mesh_addrs_ = addrs;
   mesh_ports_ = ports;
   host_keys_ = hosts;
+  ComputeCtrlTree(ctrl_tree_decision);
   std::vector<int> all_ranks(cfg_.size);
   for (int i = 0; i < cfg_.size; ++i) all_ranks[i] = i;
-  Status s = ConnectMesh(all_ranks, /*psid=*/0, &peer_socks_);
-  if (!s.ok()) return s;
-  s = MaybeOpenShm(0, all_ranks);
-  if (!s.ok()) return s;
-  s = MaybeSetupHier(0, all_ranks);
-  if (!s.ok()) return s;
+  if (!cfg_.ctrl_only) {
+    // ctrl_only (C++ selftests) skips the O(n^2) data-plane mesh so an
+    // in-process np=256 control-plane soak stays within fd/time budgets.
+    Status s = ConnectMesh(all_ranks, /*psid=*/0, &peer_socks_);
+    if (!s.ok()) return s;
+    s = MaybeOpenShm(0, all_ranks);
+    if (!s.ok()) return s;
+    s = MaybeSetupHier(0, all_ranks);
+    if (!s.ok()) return s;
+  }
+  Status ts = SetupCtrlTreeLinks();
+  if (!ts.ok()) return ts;
   hierarchical_.store(cfg_.hierarchical, std::memory_order_relaxed);
   wire_compression_.store(cfg_.wire_compression, std::memory_order_relaxed);
   initialized_ = true;
   return Status::OK();
+}
+
+// ---- leader tree (protocol v9) --------------------------------------------
+
+bool SocketController::DecideCtrlTree(const std::string& mode,
+                                      const std::vector<std::string>& hosts) {
+  if (mode == "off") return false;
+  std::set<std::string> distinct(hosts.begin(), hosts.end());
+  if (distinct.size() < 2) return false;  // single host: tree = pure overhead
+  if (mode == "on") return true;
+  // auto: multi-host AND big enough that per-rank coordinator fan-in is the
+  // bottleneck worth an extra hop of latency.
+  return hosts.size() >= 8;
+}
+
+void SocketController::ComputeCtrlTree(bool on) {
+  tree_ = CtrlTree();
+  if (!on) return;
+  // Group ranks by host key in first-appearance order over rank order —
+  // the SAME grouping MaybeSetupHier computes, so the ctrl tree and the
+  // hierarchical data plane agree on what "a host" is.
+  std::vector<std::vector<int>> groups;
+  std::map<std::string, int> group_of;
+  for (int r = 0; r < cfg_.size; ++r) {
+    auto it = group_of.find(host_keys_[r]);
+    if (it == group_of.end()) {
+      group_of.emplace(host_keys_[r], static_cast<int>(groups.size()));
+      groups.push_back({r});
+    } else {
+      groups[it->second].push_back(r);
+    }
+  }
+  tree_.on = true;
+  for (const auto& g : groups) {
+    tree_.leaders.push_back(g[0]);
+    if (group_of[host_keys_[cfg_.rank]] ==
+        static_cast<int>(tree_.leaders.size()) - 1) {
+      tree_.my_leader = g[0];
+      if (g[0] == cfg_.rank) {
+        tree_.my_children.assign(g.begin() + 1, g.end());
+      }
+    }
+  }
+  HVD_LOG(INFO) << "rank " << cfg_.rank << ": ctrl tree on, "
+                << groups.size() << " hosts, leader rank " << tree_.my_leader
+                << (IsTreeLeader()
+                        ? ", " + std::to_string(tree_.my_children.size()) +
+                              " children"
+                        : "");
+}
+
+Status SocketController::SetupCtrlTreeLinks() {
+  if (!tree_.on) return Status::OK();
+  if (is_coordinator() || cfg_.rank == tree_.my_leader) {
+    // Leaders (and the coordinator, leader of host 0) accept ctrl-tree
+    // HELLOs from this host's other ranks on the mesh data listener.  The
+    // coordinator's host-0 children keep coord_ctrl_ as their up-link, so
+    // it expects none here.
+    int needed = static_cast<int>(tree_.my_children.size());
+    if (is_coordinator()) needed = 0;
+    // A child that finished its psid-0 mesh before this leader did may have
+    // dialed already — ConnectMesh parked the unknown psid in the channel
+    // stash.  Drain it before accepting fresh connections.
+    if (needed > 0) {
+      std::lock_guard<std::mutex> l(mesh_mu_);
+      for (int c : tree_.my_children) {
+        auto it = pending_channel_.find({c, kCtrlTreePsid});
+        if (it != pending_channel_.end()) {
+          tree_child_socks_[c] = std::move(it->second);
+          pending_channel_.erase(it);
+          --needed;
+        }
+      }
+    }
+    double deadline = MonotonicSeconds() + kConnectTimeoutS;
+    while (needed > 0) {
+      // A child's ctrl-tree HELLO can race a psid-0 mesh dial from the
+      // same rank; ConnectMesh stashes unknown psids, and symmetrically we
+      // stash a mesh HELLO... except psid-0 mesh setup already completed
+      // before this call, so any arriving connection here is either a
+      // ctrl-tree HELLO or a later channel dial (stash it).
+      Socket s = data_listener_.Accept(1.0);
+      if (!s.valid()) {
+        if (MonotonicSeconds() > deadline) {
+          return Status::Error(StatusCode::PRECONDITION_ERROR,
+                               "ctrl-tree rendezvous timeout: leader rank " +
+                                   std::to_string(cfg_.rank) + " still " +
+                                   std::to_string(needed) + " children short");
+        }
+        continue;
+      }
+      s.SetRecvTimeout(5.0);
+      std::string hello;
+      if (!s.RecvFrame(&hello)) continue;
+      Reader r(hello);
+      int32_t rank = r.GetI32();
+      int32_t psid = r.GetI32();
+      if (!r.ok() || rank <= cfg_.rank || rank >= cfg_.size) {
+        return Status::Error(StatusCode::INVALID_ARGUMENT,
+                             "bad ctrl-tree HELLO at leader rank " +
+                                 std::to_string(cfg_.rank));
+      }
+      s.SetRecvTimeout(0);
+      if (psid != kCtrlTreePsid) {
+        // A channel-mesh dial arriving early: park it for EstablishChannel.
+        std::lock_guard<std::mutex> l(mesh_mu_);
+        pending_channel_[{rank, psid}] = std::move(s);
+        continue;
+      }
+      if (std::find(tree_.my_children.begin(), tree_.my_children.end(),
+                    static_cast<int>(rank)) == tree_.my_children.end()) {
+        return Status::Error(StatusCode::INVALID_ARGUMENT,
+                             "ctrl-tree HELLO from rank " +
+                                 std::to_string(rank) +
+                                 " which is not a child of leader rank " +
+                                 std::to_string(cfg_.rank));
+      }
+      tree_child_socks_[rank] = std::move(s);
+      --needed;
+    }
+    return Status::OK();
+  }
+  if (tree_.my_leader == 0) return Status::OK();  // host-0 child: coord_ctrl_
+  // Child of a non-coordinator leader: dial the leader's mesh listener with
+  // a ctrl-tree HELLO.  Child rank > leader rank always holds (leader is
+  // the host's first rank), matching the mesh dial direction.
+  Socket s;
+  if (!s.Connect(mesh_addrs_[tree_.my_leader], mesh_ports_[tree_.my_leader],
+                 kConnectTimeoutS)) {
+    return Status::Error(StatusCode::PRECONDITION_ERROR,
+                         "ctrl-tree connect to leader rank " +
+                             std::to_string(tree_.my_leader) + " failed");
+  }
+  Writer hello;
+  hello.PutI32(cfg_.rank);
+  hello.PutI32(kCtrlTreePsid);
+  if (!s.SendFrame(hello.data())) {
+    return Status::Error(StatusCode::PRECONDITION_ERROR,
+                         "ctrl-tree HELLO to leader rank " +
+                             std::to_string(tree_.my_leader) + " failed");
+  }
+  tree_parent_ = std::move(s);
+  return Status::OK();
+}
+
+Socket& SocketController::UpLink() {
+  // The negotiation up-link: tree children of non-coordinator leaders talk
+  // to their leader; everyone else (flat mode, host-0 children, leaders
+  // themselves) talks straight to the coordinator.
+  if (tree_.on && !is_coordinator() && tree_.my_leader != 0 &&
+      tree_.my_leader != cfg_.rank && tree_parent_.valid()) {
+    return tree_parent_;
+  }
+  return coord_ctrl_;
+}
+
+Socket* SocketController::TreeChildSock(int rank) {
+  if (is_coordinator() && tree_.my_leader == 0) {
+    // Coordinator's own children live in ctrl_socks_ (rendezvous links).
+    if (rank > 0 && rank < static_cast<int>(ctrl_socks_.size()) &&
+        ctrl_socks_[rank].valid()) {
+      return &ctrl_socks_[rank];
+    }
+    return nullptr;
+  }
+  auto it = tree_child_socks_.find(rank);
+  if (it == tree_child_socks_.end() || !it->second.valid()) return nullptr;
+  return &it->second;
 }
 
 Status SocketController::ConnectMesh(const std::vector<int>& members,
@@ -513,7 +765,13 @@ void SocketController::Farewell() {
       }
     }
   } else {
-    coord_ctrl_.SendFrame(w.data());  // best effort
+    if (IsTreeLeader()) {
+      // Release this host's children first ([-1] in the responses
+      // position, same frame the coordinator's farewell would produce), so
+      // none of them blocks on a leader that is about to close its links.
+      FanDownToChildren(w.data(), nullptr);
+    }
+    UpLink().SendFrame(w.data());  // best effort; a leader forwards it up
   }
 }
 
@@ -529,6 +787,8 @@ void SocketController::Shutdown() {
   }
   abort_cv_.notify_all();
   coord_ctrl_.Close();
+  tree_parent_.Close();
+  for (auto& kv : tree_child_socks_) kv.second.Close();
   for (auto& s : ctrl_socks_) s.Close();
   for (auto& s : peer_socks_) s.Close();
   {
@@ -577,8 +837,9 @@ Status SocketController::ComputeResponses(
     return is_coordinator() ? CoordinatorAbortSweep()
                             : WorkerAbortHandshake();
   }
-  return is_coordinator() ? CoordinatorCycle(new_requests, out)
-                          : WorkerCycle(new_requests, out);
+  if (is_coordinator()) return CoordinatorCycle(new_requests, out);
+  if (IsTreeLeader()) return LeaderCycle(new_requests, out);
+  return WorkerCycle(new_requests, out);
 }
 
 // ---------------------------------------------------------------------------
@@ -697,31 +958,64 @@ Status SocketController::WorkerAbortHandshake() {
     w.PutI32(-2);  // failure FIN in the cycle-frame position
     w.PutString("rank " + std::to_string(cfg_.rank) +
                 " observed a data-plane failure");
+    w.PutI32(cfg_.rank);  // v9: explicit culprit so leaders forward losslessly
+    // Up the tree AND direct to the coordinator: if this rank's leader is
+    // the thing that died, the direct path still attributes the failure.
+    if (tree_parent_.valid()) tree_parent_.SendFrame(w.data());
     coord_ctrl_.SendFrame(w.data());  // best effort
   }
-  // Drain the ctrl channel toward the coordinator's ABORT, bounded by the
+  // Drain the ctrl channels toward the coordinator's ABORT, bounded by the
   // propagation timeout.  Stale RESPONSES frames from the cycle in flight
-  // when the failure hit are discarded.
+  // when the failure hit are discarded.  The ABORT may arrive direct
+  // (coord_ctrl_) or forwarded by this rank's leader (tree_parent_); a
+  // leader running this handshake fans every terminal frame down to its
+  // children before acting on it, so the subtree never waits out the
+  // timeout just because its leader learned first.
   const double deadline = MonotonicSeconds() + abort_timeout_s_;
   while (MonotonicSeconds() < deadline) {
-    pollfd pfd{coord_ctrl_.fd(), POLLIN, 0};
-    const int rc = ::poll(&pfd, 1, 200);
+    pollfd pfds[2];
+    Socket* socks[2];
+    nfds_t npfd = 0;
+    if (coord_ctrl_.valid()) {
+      pfds[npfd] = pollfd{coord_ctrl_.fd(), POLLIN, 0};
+      socks[npfd++] = &coord_ctrl_;
+    }
+    if (tree_parent_.valid()) {
+      pfds[npfd] = pollfd{tree_parent_.fd(), POLLIN, 0};
+      socks[npfd++] = &tree_parent_;
+    }
+    if (npfd == 0) break;
+    const int rc = ::poll(pfds, npfd, 200);
     if (rc < 0) {
       if (errno == EINTR) continue;
       break;
     }
     if (rc == 0) continue;
-    std::string frame;
-    if (!coord_ctrl_.RecvFrame(&frame)) break;  // coordinator died too
-    Reader rd(frame);
-    const int32_t n = rd.GetI32();
-    if (n == -1) {
-      peer_shutdown_ = true;
-      const std::string msg = "coordinator shut down the job";
-      SetAbortReason(msg);
-      return Status::Error(StatusCode::ABORTED, msg);
+    bool any_dead = false;
+    for (nfds_t i = 0; i < npfd; ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      std::string frame;
+      if (!socks[i]->RecvFrame(&frame)) {
+        socks[i]->Close();
+        // The direct coordinator link dying means no ABORT is coming.
+        if (socks[i] == &coord_ctrl_) any_dead = true;
+        continue;
+      }
+      Reader rd(frame);
+      const int32_t n = rd.GetI32();
+      if (n == -1) {
+        FanDownToChildren(frame, nullptr);
+        peer_shutdown_ = true;
+        const std::string msg = "coordinator shut down the job";
+        SetAbortReason(msg);
+        return Status::Error(StatusCode::ABORTED, msg);
+      }
+      if (n == -2) {
+        FanDownToChildren(frame, nullptr);
+        return HandleAbortFrame(&rd);
+      }
     }
-    if (n == -2) return HandleAbortFrame(&rd);
+    if (any_dead) break;
   }
   const std::string msg =
       "data-plane failure on rank " + std::to_string(cfg_.rank) +
@@ -780,9 +1074,15 @@ Status SocketController::CoordinatorAbortSweep() {
         if (!rd.ok() || why.empty()) {
           why = "rank " + std::to_string(rank) + " reported a failure";
         }
+        // v9: an explicit culprit trailer — a leader forwarding a child's
+        // FIN is the SENDER but not the culprit.
+        const int32_t c = rd.GetI32();
+        if (rd.ok() && c >= 0 && c < cfg_.size) culprit = c;
         break;
       }
       if (n_cached == -1) departed_ranks_.insert(rank);
+      // n_cached == -3 (a leader's aggregate from the cycle in flight) and
+      // plain CYCLE frames are equally stale here: discard and keep polling.
     }
   }
   if (culprit < 0) why = "coordinator observed a local failure";
@@ -940,14 +1240,35 @@ Status SocketController::CoordinatorCycle(
       ++it;
     }
   }
-  // Own announcements first (deterministic: coordinator, then rank order).
+  // Own announcements first (deterministic: coordinator, then source order).
   for (auto& r : new_requests) Announce(0, std::move(r), &errors);
-  for (int rank = 1; rank < cfg_.size; ++rank) {
+  // Gather sources.  Flat: every worker.  Tree (v9): this host's children
+  // (individual frames) plus the other hosts' leaders ([-3] aggregates) —
+  // the O(ranks) -> O(local ranks + hosts) reduction the tree exists for.
+  std::vector<int> sources;
+  if (tree_.on) {
+    sources = tree_.my_children;
+    for (int l : tree_.leaders) {
+      if (l != 0) sources.push_back(l);
+    }
+  } else {
+    for (int rank = 1; rank < cfg_.size; ++rank) sources.push_back(rank);
+  }
+  for (int rank : sources) {
     if (departed_ranks_.count(rank)) continue;
+    const bool is_leader_src =
+        tree_.on && std::find(tree_.leaders.begin(), tree_.leaders.end(),
+                              rank) != tree_.leaders.end();
     if (FaultInjectionOn()) {
-      // Site rank = the REMOTE worker whose frame is being gathered;
-      // closing its ctrl socket makes the recv below fail like a death.
-      FaultAction fa = FaultCheck(kFaultCoordinatorRecv, rank);
+      // Site rank = the REMOTE peer whose frame is being gathered; closing
+      // its ctrl socket makes the recv below fail like a death.  In tree
+      // mode the coordinator doubles as host 0's leader, so its own-host
+      // children are leader-recv sites; remote leaders stay
+      // coordinator-recv.
+      const FaultSite site = (tree_.on && !is_leader_src)
+                                 ? kFaultLeaderRecv
+                                 : kFaultCoordinatorRecv;
+      FaultAction fa = FaultCheck(site, rank);
       if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
         ctrl_socks_[rank].Close();
       }
@@ -957,55 +1278,48 @@ Status SocketController::CoordinatorCycle(
       return BroadcastAbortAndFail(
           rank, "lost connection to rank " + std::to_string(rank));
     }
-    ctrl_recv_.fetch_add(frame.size(), std::memory_order_relaxed);
+    CountCtrlRecv(frame.size());
     Reader rd(frame);
     int32_t n_cached = rd.GetI32();
-    if (n_cached == -1) {  // BYE: clean worker exit
+    if (n_cached == -1) {  // BYE: clean exit
       departed_ranks_.insert(rank);
       HVD_LOG(INFO) << "rank " << rank << " shut down cleanly";
+      if (is_leader_src) {
+        // A departing leader severs its subtree: any child still running
+        // has lost its up-link, so the coordinator stops expecting its
+        // announcements rather than hanging tensors on a mute host.
+        for (int r = 1; r < cfg_.size; ++r) {
+          if (r != rank && host_keys_[r] == host_keys_[rank] &&
+              departed_ranks_.insert(r).second) {
+            HVD_LOG(INFO) << "rank " << r << " departed with its leader "
+                          << rank;
+          }
+        }
+      }
       continue;
     }
-    if (n_cached == -2) {  // failure FIN: the worker saw a failure first
+    if (n_cached == -2) {  // failure FIN: the peer saw a failure first
       std::string why = rd.GetString();
       if (!rd.ok() || why.empty()) {
         why = "rank " + std::to_string(rank) + " reported a failure";
       }
-      return BroadcastAbortAndFail(rank, why);
+      int culprit = rank;
+      // v9: explicit culprit trailer (a leader forwards a child's FIN
+      // verbatim — the sender is not the culprit).
+      const int32_t c = rd.GetI32();
+      if (rd.ok() && c >= 0 && c < cfg_.size) culprit = c;
+      return BroadcastAbortAndFail(culprit, why);
     }
-    for (int32_t i = 0; i < n_cached; ++i) {
-      int64_t id = rd.GetI64();
-      int64_t handle = rd.GetI64();
-      TensorRequest req;
-      if (cache_.Get(id, &req)) {
-        req.handle = handle;  // the announcer's own current submission
-        Announce(rank, std::move(req), &errors);
-      } else {
-        Response e;
-        e.error = "response cache divergence: unknown cache id " +
-                  std::to_string(id) + " from rank " + std::to_string(rank);
-        errors.push_back(std::move(e));
+    if (n_cached == -3) {  // v9 leader aggregate
+      if (!is_leader_src || !ParseAggregate(rank, &rd, &errors)) {
+        return BroadcastAbortAndFail(rank,
+                                     "malformed aggregate frame from rank " +
+                                         std::to_string(rank));
       }
+      continue;
     }
-    int32_t n_full = rd.GetI32();
-    for (int32_t i = 0; i < n_full; ++i) {
-      Announce(rank, DeserializeRequest(&rd), &errors);
-    }
-    // v7 trailer: the worker's piggybacked metrics snapshot (cumulative;
-    // absent marker when its registry is disabled).
-    int32_t has_metrics = rd.GetI32();
-    if (has_metrics == 1) {
-      RankMetricsSnapshot s;
-      s.neg_count = rd.GetI64();
-      s.neg_sum_us = rd.GetI64();
-      s.neg_p50_us = rd.GetI64();
-      s.neg_p99_us = rd.GetI64();
-      s.cycle_busy_us = rd.GetI64();
-      s.cycle_idle_us = rd.GetI64();
-      s.cycle_count = rd.GetI64();
-      s.updated_at = MonotonicSeconds();
-      std::lock_guard<std::mutex> l(metrics_mu_);
-      cluster_[rank] = s;
-    }
+    ParseCachedPairs(rank, n_cached, &rd, &errors);
+    ParseFullAndMetrics(rank, rd.GetI32(), &rd, &errors);
   }
 
   // Collect ready tensors in deterministic (arrival-order) sequence.
@@ -1123,14 +1437,16 @@ Status SocketController::CoordinatorCycle(
   out->insert(out->begin(), errors.begin(), errors.end());
   UpdateCachesAndSeq(out);
 
-  // Broadcast the identical response list to every worker.
+  // Broadcast the identical response list down the gather topology: every
+  // direct source gets one frame; tree leaders fan their copy out to their
+  // children verbatim.
   Writer w;
   w.PutI32(static_cast<int32_t>(out->size()));
   for (const auto& r : *out) SerializeResponse(r, &w);
   const std::string payload = w.data();
-  for (int rank = 1; rank < cfg_.size; ++rank) {
+  for (int rank : sources) {
     if (departed_ranks_.count(rank)) continue;
-    ctrl_sent_.fetch_add(payload.size(), std::memory_order_relaxed);
+    CountCtrlSend(payload.size());
     if (!ctrl_socks_[rank].SendFrame(payload)) {
       return BroadcastAbortAndFail(rank,
                                    "failed to send responses to rank " +
@@ -1234,8 +1550,8 @@ std::string SocketController::ClusterMetricsJson() {
   return os.str();
 }
 
-Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
-                                     std::vector<Response>* out) {
+std::string SocketController::BuildCycleFrame(
+    const std::vector<TensorRequest>& new_requests) {
   Writer w;
   // Cache hits travel as (id, handle) pairs — the id is the reference's
   // bit-vector fast path; the per-submission handle rides along so a
@@ -1276,34 +1592,14 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
   } else {
     w.PutI32(0);
   }
-  ctrl_sent_.fetch_add(w.data().size(), std::memory_order_relaxed);
-  if (!coord_ctrl_.SendFrame(w.data())) {
-    aborted_ = true;
-    return Status::Error(StatusCode::ABORTED, "lost coordinator (send)");
-  }
-  std::string frame;
-  if (!coord_ctrl_.RecvFrame(&frame)) {
-    aborted_ = true;
-    return Status::Error(StatusCode::ABORTED, "lost coordinator (recv)");
-  }
-  ctrl_recv_.fetch_add(frame.size(), std::memory_order_relaxed);
-  Reader rd(frame);
-  int32_t n = rd.GetI32();
-  if (n == -1) {  // coordinator farewell: the job is ending deliberately
-    peer_shutdown_ = true;
-    aborted_ = true;
-    // Latch the reason so WaitAbortReason callers return immediately
-    // instead of burning the propagation timeout at clean teardown.
-    SetAbortReason("coordinator shut down the job");
-    return Status::Error(StatusCode::ABORTED,
-                         "coordinator shut down the job");
-  }
-  if (n == -2) {  // coordinator ABORT broadcast (protocol v8)
-    return HandleAbortFrame(&rd);
-  }
+  return std::string(w.data());
+}
+
+void SocketController::ParseResponsesTail(Reader* rd, int32_t n,
+                                          std::vector<Response>* out) {
   out->clear();
   out->reserve(n);
-  for (int32_t i = 0; i < n; ++i) out->push_back(DeserializeResponse(&rd));
+  for (int32_t i = 0; i < n; ++i) out->push_back(DeserializeResponse(rd));
   // Local seq counter mirrors the coordinator's (sanity only) and caches are
   // updated from the metas carried by each response — identical on all
   // ranks, so cache ids agree without extra synchronisation.
@@ -1320,7 +1616,342 @@ Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
       }
     }
   }
+}
+
+Status SocketController::WorkerCycle(std::vector<TensorRequest>& new_requests,
+                                     std::vector<Response>* out) {
+  const std::string payload = BuildCycleFrame(new_requests);
+  Socket& up = UpLink();
+  const bool via_leader = (&up == &tree_parent_);
+  CountCtrlSend(payload.size());
+  if (!up.SendFrame(payload)) {
+    aborted_ = true;
+    // A dead leader is not a dead job: the coordinator's direct ABORT
+    // broadcast still reaches this rank on coord_ctrl_, so run the
+    // handshake for real culprit attribution instead of guessing.
+    if (via_leader) return WorkerAbortHandshake();
+    return Status::Error(StatusCode::ABORTED, "lost coordinator (send)");
+  }
+  std::string frame;
+  if (!up.RecvFrame(&frame)) {
+    aborted_ = true;
+    if (via_leader) return WorkerAbortHandshake();
+    return Status::Error(StatusCode::ABORTED, "lost coordinator (recv)");
+  }
+  CountCtrlRecv(frame.size());
+  Reader rd(frame);
+  int32_t n = rd.GetI32();
+  if (n == -1) {  // coordinator farewell: the job is ending deliberately
+    peer_shutdown_ = true;
+    aborted_ = true;
+    // Latch the reason so WaitAbortReason callers return immediately
+    // instead of burning the propagation timeout at clean teardown.
+    SetAbortReason("coordinator shut down the job");
+    return Status::Error(StatusCode::ABORTED,
+                         "coordinator shut down the job");
+  }
+  if (n == -2) {  // coordinator ABORT broadcast (protocol v8)
+    return HandleAbortFrame(&rd);
+  }
+  ParseResponsesTail(&rd, n, out);
   return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Leader tree cycles (protocol v9)
+// ---------------------------------------------------------------------------
+
+void SocketController::ParseCachedPairs(int rank, int32_t n_cached, Reader* rd,
+                                        std::vector<Response>* errors) {
+  for (int32_t i = 0; i < n_cached; ++i) {
+    int64_t id = rd->GetI64();
+    int64_t handle = rd->GetI64();
+    TensorRequest req;
+    if (cache_.Get(id, &req)) {
+      req.handle = handle;  // the announcer's own current submission
+      Announce(rank, std::move(req), errors);
+    } else {
+      Response e;
+      e.error = "response cache divergence: unknown cache id " +
+                std::to_string(id) + " from rank " + std::to_string(rank);
+      errors->push_back(std::move(e));
+    }
+  }
+}
+
+void SocketController::ParseFullAndMetrics(int rank, int32_t n_full,
+                                           Reader* rd,
+                                           std::vector<Response>* errors) {
+  for (int32_t i = 0; i < n_full; ++i) {
+    Announce(rank, DeserializeRequest(rd), errors);
+  }
+  // v7 trailer: the rank's piggybacked metrics snapshot (cumulative;
+  // absent marker when its registry is disabled).
+  int32_t has_metrics = rd->GetI32();
+  if (has_metrics == 1) {
+    RankMetricsSnapshot s;
+    s.neg_count = rd->GetI64();
+    s.neg_sum_us = rd->GetI64();
+    s.neg_p50_us = rd->GetI64();
+    s.neg_p99_us = rd->GetI64();
+    s.cycle_busy_us = rd->GetI64();
+    s.cycle_idle_us = rd->GetI64();
+    s.cycle_count = rd->GetI64();
+    s.updated_at = MonotonicSeconds();
+    std::lock_guard<std::mutex> l(metrics_mu_);
+    if (rank >= 0 && rank < static_cast<int>(cluster_.size())) {
+      cluster_[rank] = s;
+    }
+  }
+}
+
+bool SocketController::ParseAggregate(int leader, Reader* rd,
+                                      std::vector<Response>* errors) {
+  // v9 aggregate: [n_groups] { [i64 cache_id][i32 k] k x ([i32 rank]
+  // [i64 handle]) } [n_rest] { [i32 rank][string rest] } — the leader's
+  // host-merged cached announcements, then each member's un-merged frame
+  // tail (full requests + metrics trailer), or its whole BYE frame.
+  const int32_t n_groups = rd->GetI32();
+  if (!rd->ok() || n_groups < 0) return false;
+  for (int32_t g = 0; g < n_groups; ++g) {
+    const int64_t id = rd->GetI64();
+    const int32_t k = rd->GetI32();
+    if (!rd->ok() || k < 0) return false;
+    TensorRequest cached_req;
+    const bool known = cache_.Get(id, &cached_req);
+    for (int32_t i = 0; i < k; ++i) {
+      const int32_t rank = rd->GetI32();
+      const int64_t handle = rd->GetI64();
+      if (!rd->ok() || rank < 0 || rank >= cfg_.size) return false;
+      if (known) {
+        TensorRequest req = cached_req;
+        req.handle = handle;
+        Announce(rank, std::move(req), errors);
+      } else {
+        Response e;
+        e.error = "response cache divergence: unknown cache id " +
+                  std::to_string(id) + " from rank " + std::to_string(rank);
+        errors->push_back(std::move(e));
+      }
+    }
+  }
+  const int32_t n_rest = rd->GetI32();
+  if (!rd->ok() || n_rest < 0) return false;
+  for (int32_t i = 0; i < n_rest; ++i) {
+    const int32_t rank = rd->GetI32();
+    if (!rd->ok() || rank < 0 || rank >= cfg_.size) return false;
+    const std::string rest = rd->GetString();
+    if (!rd->ok()) return false;
+    Reader rr(rest);
+    const int32_t first = rr.GetI32();
+    if (first == -1) {  // the member's BYE, forwarded by its leader
+      departed_ranks_.insert(rank);
+      HVD_LOG(INFO) << "rank " << rank << " shut down cleanly (via leader "
+                    << leader << ")";
+      continue;
+    }
+    if (first < 0) return false;
+    ParseFullAndMetrics(rank, first, &rr, errors);
+    if (!rr.ok()) return false;
+  }
+  return rd->ok();
+}
+
+bool SocketController::FanDownToChildren(const std::string& frame,
+                                         int* failed_child) {
+  bool ok = true;
+  for (auto& [rank, sock] : tree_child_socks_) {
+    if (tree_departed_children_.count(rank) || !sock.valid()) continue;
+    CountCtrlSend(frame.size());
+    if (!sock.SendFrame(frame)) {
+      if (failed_child) *failed_child = rank;
+      ok = false;
+    }
+  }
+  return ok;
+}
+
+Status SocketController::LeaderFinUp(int culprit, const std::string& why,
+                                     const std::string* forward_frame) {
+  aborted_ = true;
+  if (!fin_sent_) {
+    fin_sent_ = true;
+    if (forward_frame != nullptr) {
+      // A child's failure FIN, forwarded verbatim — its v9 culprit trailer
+      // already names the child.
+      coord_ctrl_.SendFrame(*forward_frame);
+    } else {
+      Writer w;
+      w.PutI32(-2);  // failure FIN in the cycle-frame position
+      w.PutString(why);
+      w.PutI32(culprit);
+      coord_ctrl_.SendFrame(w.data());  // best effort
+    }
+  }
+  // Await the coordinator's ABORT (and fan it down to surviving children)
+  // so every rank of this host reports the same culprit.
+  return WorkerAbortHandshake();
+}
+
+Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
+                                     std::vector<Response>* out) {
+  // An empty member tail is [n_full=0][has_metrics=0]: skip it in the
+  // aggregate — idle ranks then cost 12 bytes (rank + empty pair list)
+  // instead of a whole frame.
+  static const std::string kEmptyTail(8, '\0');
+  const std::string own = BuildCycleFrame(new_requests);
+  // id -> (rank, handle) announcements merged across this host.  std::map
+  // keeps aggregate bytes deterministic.
+  std::map<int64_t, std::vector<std::pair<int32_t, int64_t>>> groups;
+  std::vector<std::pair<int32_t, std::string>> rests;
+  auto merge_frame = [&](int32_t rank, const std::string& frame) -> bool {
+    Reader rd(frame);
+    const int32_t n_cached = rd.GetI32();
+    if (!rd.ok() || n_cached < 0) return false;
+    for (int32_t i = 0; i < n_cached; ++i) {
+      const int64_t id = rd.GetI64();
+      const int64_t handle = rd.GetI64();
+      groups[id].emplace_back(rank, handle);
+    }
+    if (!rd.ok()) return false;
+    std::string rest(rd.cursor(), rd.remaining());
+    if (rest != kEmptyTail) rests.emplace_back(rank, std::move(rest));
+    return true;
+  };
+  merge_frame(cfg_.rank, own);
+  for (int child : tree_.my_children) {
+    if (tree_departed_children_.count(child)) continue;
+    Socket* cs = TreeChildSock(child);
+    if (cs == nullptr) continue;
+    if (FaultInjectionOn()) {
+      // Site rank = the REMOTE child whose frame this leader is gathering;
+      // closing the link makes the recv below fail like a child death.
+      FaultAction fa = FaultCheck(kFaultLeaderRecv, child);
+      if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
+        cs->Close();
+      }
+    }
+    std::string frame;
+    if (!cs->RecvFrame(&frame)) {
+      return LeaderFinUp(child,
+                         "leader rank " + std::to_string(cfg_.rank) +
+                             " lost connection to rank " +
+                             std::to_string(child),
+                         nullptr);
+    }
+    CountCtrlRecv(frame.size());
+    Reader rd(frame);
+    const int32_t first = rd.GetI32();
+    if (first == -1) {  // child BYE: forward the whole frame as its tail
+      tree_departed_children_.insert(child);
+      rests.emplace_back(child, frame);
+      continue;
+    }
+    if (first == -2) {  // child failure FIN: forward verbatim, abort
+      std::string why = rd.GetString();
+      int culprit = child;
+      const int32_t c = rd.GetI32();
+      if (rd.ok() && c >= 0 && c < cfg_.size) culprit = c;
+      if (!rd.ok() || why.empty()) {
+        why = "rank " + std::to_string(child) + " reported a failure";
+      }
+      return LeaderFinUp(culprit, why, &frame);
+    }
+    if (!merge_frame(child, frame)) {
+      return LeaderFinUp(child,
+                         "malformed cycle frame from rank " +
+                             std::to_string(child),
+                         nullptr);
+    }
+  }
+  Writer w;
+  w.PutI32(-3);  // leader aggregate sentinel in the cycle-frame position
+  w.PutI32(static_cast<int32_t>(groups.size()));
+  for (const auto& [id, members] : groups) {
+    w.PutI64(id);
+    w.PutI32(static_cast<int32_t>(members.size()));
+    for (const auto& [rank, handle] : members) {
+      w.PutI32(rank);
+      w.PutI64(handle);
+    }
+  }
+  w.PutI32(static_cast<int32_t>(rests.size()));
+  for (const auto& [rank, rest] : rests) {
+    w.PutI32(rank);
+    w.PutString(rest);
+  }
+  CountCtrlSend(w.data().size());
+  if (!coord_ctrl_.SendFrame(w.data())) {
+    aborted_ = true;
+    return LeaderLostCoordinator("lost coordinator (send)");
+  }
+  std::string resp;
+  if (!coord_ctrl_.RecvFrame(&resp)) {
+    aborted_ = true;
+    return LeaderLostCoordinator("lost coordinator (recv)");
+  }
+  CountCtrlRecv(resp.size());
+  // Fan the coordinator's frame down BEFORE parsing: children unblock in
+  // parallel with this rank's own deserialization, and terminal frames
+  // (farewell, ABORT) reach the subtree even when this leader errors out.
+  int failed_child = -1;
+  if (!FanDownToChildren(resp, &failed_child)) {
+    return LeaderFinUp(failed_child,
+                       "leader rank " + std::to_string(cfg_.rank) +
+                           " failed to forward responses to rank " +
+                           std::to_string(failed_child),
+                       nullptr);
+  }
+  Reader rd(resp);
+  const int32_t n = rd.GetI32();
+  if (n == -1) {
+    peer_shutdown_ = true;
+    aborted_ = true;
+    SetAbortReason("coordinator shut down the job");
+    return Status::Error(StatusCode::ABORTED,
+                         "coordinator shut down the job");
+  }
+  if (n == -2) return HandleAbortFrame(&rd);
+  ParseResponsesTail(&rd, n, out);
+  return Status::OK();
+}
+
+Status SocketController::LeaderLostCoordinator(const std::string& what) {
+  // The subtree's only path to the coordinator is gone: synthesize the
+  // ABORT the coordinator can no longer send, so children fail within the
+  // propagation bound instead of blocking on a mute leader.
+  Writer w;
+  w.PutI32(-2);
+  w.PutI32(kTagAbort);
+  w.PutString("leader rank " + std::to_string(cfg_.rank) +
+              " lost the coordinator");
+  w.PutI32(-1);        // no culprit rank: the coordinator itself is gone
+  w.PutString("");     // culprit host unknown
+  w.PutF64(WallSeconds());
+  FanDownToChildren(w.data(), nullptr);
+  const std::string msg = what;
+  SetAbortReason(msg);
+  return Status::Error(StatusCode::ABORTED, msg);
+}
+
+void SocketController::CountCtrlSend(int64_t bytes) {
+  ctrl_msgs_sent_.fetch_add(1, std::memory_order_relaxed);
+  ctrl_sent_.fetch_add(bytes, std::memory_order_relaxed);
+  if (MetricsOn()) {
+    auto& m = GlobalMetrics();
+    m.ctrl_msgs_sent.fetch_add(1, std::memory_order_relaxed);
+    m.ctrl_bytes_sent.fetch_add(bytes, std::memory_order_relaxed);
+  }
+}
+
+void SocketController::CountCtrlRecv(int64_t bytes) {
+  ctrl_msgs_recv_.fetch_add(1, std::memory_order_relaxed);
+  ctrl_recv_.fetch_add(bytes, std::memory_order_relaxed);
+  if (MetricsOn()) {
+    auto& m = GlobalMetrics();
+    m.ctrl_msgs_recv.fetch_add(1, std::memory_order_relaxed);
+    m.ctrl_bytes_recv.fetch_add(bytes, std::memory_order_relaxed);
+  }
 }
 
 void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {

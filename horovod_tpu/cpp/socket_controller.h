@@ -106,6 +106,17 @@ class SocketController : public Controller {
     *recv = ctrl_recv_.load(std::memory_order_relaxed);
   }
 
+  // Ctrl-plane frame + byte counters (protocol v9).  On the coordinator the
+  // msgs_recv rate per cycle is the leader-tree acceptance metric: flat mode
+  // receives size-1 frames per cycle, tree mode local_children + hosts-1.
+  void CtrlPlaneStats(int64_t* msgs_sent, int64_t* msgs_recv,
+                      int64_t* bytes_sent, int64_t* bytes_recv) const override {
+    *msgs_sent = ctrl_msgs_sent_.load(std::memory_order_relaxed);
+    *msgs_recv = ctrl_msgs_recv_.load(std::memory_order_relaxed);
+    *bytes_sent = ctrl_sent_.load(std::memory_order_relaxed);
+    *bytes_recv = ctrl_recv_.load(std::memory_order_relaxed);
+  }
+
   // Autotuned categorical knob: announce steady-state tensors via cache
   // ids (default) or as full requests.  Per-rank safe — inserts stay
   // deterministic either way, so cache ids never diverge across ranks.
@@ -202,6 +213,10 @@ class SocketController : public Controller {
   // writes, Python reads — relaxed atomics suffice for monotone counters).
   std::atomic<int64_t> ctrl_sent_{0};
   std::atomic<int64_t> ctrl_recv_{0};
+  // Ctrl-channel frame counters (protocol v9): one increment per CYCLE /
+  // RESPONSES / aggregate / abort frame moved on a negotiation link.
+  std::atomic<int64_t> ctrl_msgs_sent_{0};
+  std::atomic<int64_t> ctrl_msgs_recv_{0};
   // Data-plane payload byte counters keyed by destination host locality:
   // `data_sent_*` are bytes on the wire, `data_raw_*` the fp32-equivalent
   // payload (equal unless a compressed ring encoded the send).
@@ -226,6 +241,92 @@ class SocketController : public Controller {
                           std::vector<Response>* out);
   Status WorkerCycle(std::vector<TensorRequest>& new_requests,
                      std::vector<Response>* out);
+
+  // -- leader-tree control plane (protocol v9) ------------------------------
+  // Two-level tree over the agreed host keys: the first rank of each host
+  // (first-appearance order over rank order — the same election
+  // MaybeSetupHier uses) is that host's leader.  Children exchange CYCLE /
+  // RESPONSES frames with their leader; leaders merge child announcements
+  // into ONE aggregate frame per host toward the coordinator and fan the
+  // coordinator's responses (and abort broadcasts) back down verbatim.
+  // Rank 0 is always both the coordinator and its own host's leader, so its
+  // host's children keep their direct rendezvous ctrl sockets.  The
+  // engagement decision is COORDINATOR-AUTHORITATIVE: it rides the v9
+  // rendezvous book, so divergent HOROVOD_CONTROL_TREE envs cannot split
+  // the ring.
+  struct CtrlTree {
+    bool on = false;
+    std::vector<int> leaders;      // per-host leader ranks (ascending)
+    int my_leader = -1;            // leader of this rank's host
+    std::vector<int> my_children;  // leader only: this host's other ranks
+  };
+  // Engagement rule, pure function of the mode string + agreed host keys
+  // (mirrored by runtime.compute_ctrl_tree for the Python-side unit tests):
+  // "on" engages with >=2 hosts, "auto" additionally requires size >= 8,
+  // single-host jobs always demote to the flat plane.
+  static bool DecideCtrlTree(const std::string& mode,
+                             const std::vector<std::string>& host_keys);
+  // Build tree_ from host_keys_ (after the book agreed) per the decision.
+  void ComputeCtrlTree(bool on);
+  // Establish the child->leader ctrl links: children of non-coordinator
+  // hosts dial their leader's data listener with a kCtrlTreePsid HELLO
+  // (the mesh pending-stash absorbs arrival skew, like channel HELLOs).
+  Status SetupCtrlTreeLinks();
+  bool IsTreeLeader() const {
+    return tree_.on && tree_.my_leader == cfg_.rank;
+  }
+  // The ctrl socket toward this rank's negotiation parent: the leader link
+  // for a non-host-0 child, the coordinator link otherwise.
+  Socket& UpLink();
+  // Leader's link to child `rank` (the coordinator's local children live
+  // in ctrl_socks_); null when unknown/closed.
+  Socket* TreeChildSock(int rank);
+  // One leader negotiation cycle: gather every live child's frame (fault
+  // site: leader-recv), merge cached announcements across the host, forward
+  // one aggregate frame, fan the response back down, parse own copy.
+  Status LeaderCycle(std::vector<TensorRequest>& new_requests,
+                     std::vector<Response>* out);
+  // The worker CYCLE frame body: cached pairs + full requests + v7 metrics
+  // trailer (shared by WorkerCycle and the leader's own sub-frame).
+  std::string BuildCycleFrame(const std::vector<TensorRequest>& new_requests);
+  // Shared RESPONSES-frame tail parse (n already read, >= 0).
+  void ParseResponsesTail(Reader* rd, int32_t n, std::vector<Response>* out);
+  // Forward a responses-position frame verbatim to every live child;
+  // returns false and names the child when a send fails (cycle path aborts
+  // on that; abort/farewell fan-outs are best-effort and ignore it).
+  bool FanDownToChildren(const std::string& frame, int* failed_child);
+  // Leader failure path: send a FIN upward naming `culprit` (or forward a
+  // child's own FIN frame verbatim) and await the coordinator's ABORT.
+  Status LeaderFinUp(int culprit, const std::string& why,
+                     const std::string* forward_frame);
+  // Coordinator parse helpers, shared by the flat per-rank loop and the
+  // per-subframe body of a leader aggregate.
+  void ParseCachedPairs(int rank, int32_t n_cached, Reader* rd,
+                        std::vector<Response>* errors);
+  void ParseFullAndMetrics(int rank, int32_t n_full, Reader* rd,
+                           std::vector<Response>* errors);
+  // Parse a leader's [-3] aggregate frame; false = malformed (caller aborts
+  // blaming the leader).
+  bool ParseAggregate(int leader, Reader* rd, std::vector<Response>* errors);
+  // Leader lost its coordinator link: synthesize the ABORT the coordinator
+  // can no longer deliver and fan it down so the subtree fails bounded.
+  Status LeaderLostCoordinator(const std::string& what);
+  // Ctrl-plane accounting: one frame of `bytes` moved on a negotiation
+  // link (controller counters + the global metrics registry when enabled).
+  void CountCtrlSend(int64_t bytes);
+  void CountCtrlRecv(int64_t bytes);
+
+  CtrlTree tree_;
+  // Leader (non-coordinator): accepted child ctrl links, by child rank.
+  std::map<int, Socket> tree_child_socks_;
+  // Children that sent a clean BYE (leader-side mirror of departed_ranks_).
+  std::set<int> tree_departed_children_;
+  // Non-host-0 child: the ctrl link to this rank's leader.
+  Socket tree_parent_;
+  // HOROVOD_CONTROL_TREE (auto|on|off) and HOROVOD_RENDEZVOUS_ACCEPTORS
+  // (ctor reads the env; the coordinator's mode decides for everyone).
+  std::string control_tree_mode_ = "auto";
+  int rendezvous_acceptors_ = 4;
 
   // -- fast-abort propagation (protocol v8) ---------------------------------
   // Coordinator: broadcast ABORT(reason, culprit rank/host) on every live

@@ -660,7 +660,14 @@ bool Listener::Listen(const std::string& addr, int port) {
   socklen_t slen = sizeof(sa);
   ::getsockname(fd_, reinterpret_cast<sockaddr*>(&sa), &slen);
   port_ = ntohs(sa.sin_port);
-  return ::listen(fd_, 64) == 0;
+  // Non-blocking listener: Accept's poll() provides the wait, and a losing
+  // racer among concurrent acceptor threads (the sharded rendezvous) gets
+  // EAGAIN back instead of blocking inside ::accept with no connection
+  // left.  Backlog 512: an np=512 rendezvous herd SYNs all at once; the
+  // worker-side exponential backoff absorbs whatever still overflows.
+  int flags = ::fcntl(fd_, F_GETFL, 0);
+  if (flags >= 0) ::fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+  return ::listen(fd_, 512) == 0;
 }
 
 Socket Listener::Accept(double timeout_s) {

@@ -186,6 +186,11 @@ class Listener {
  public:
   // Binds to addr:port; if port==0 an ephemeral port is chosen and stored.
   bool Listen(const std::string& addr, int port);
+  // Poll + accept, bounded by timeout_s; returns an invalid Socket on
+  // timeout or when another thread won the connection.  Safe to call from
+  // multiple threads at once (the fd is non-blocking, so losing racers get
+  // EAGAIN, not a stuck ::accept) — the sharded rendezvous
+  // (HOROVOD_RENDEZVOUS_ACCEPTORS) relies on this.
   Socket Accept(double timeout_s);
   int port() const { return port_; }
   void Close();

@@ -79,6 +79,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="codec for fp32 allreduce payloads on cross-host "
                         "ring hops; accumulation stays fp32 "
                         "(HOROVOD_WIRE_COMPRESSION)")
+    p.add_argument("--control-tree", default=None,
+                   choices=["auto", "on", "off"],
+                   help="leader-tree control plane (protocol v9): host "
+                        "leaders aggregate worker cycle frames so the "
+                        "coordinator handles O(hosts) messages instead of "
+                        "O(ranks); auto engages on multi-host jobs with "
+                        "np >= 8 (HOROVOD_CONTROL_TREE)")
     p.add_argument("--fault-inject", default=None, metavar="SPEC",
                    help="deterministic fault injection for chaos testing: "
                         "comma-separated site:cycle:rank:action[:arg] rules "
@@ -130,6 +137,7 @@ def _apply_config_file(args: argparse.Namespace,
         "slots_per_host": cfg.get("slots-per-host"),
         "log_level": cfg.get("log-level"),
         "wire_compression": cfg.get("wire-compression"),
+        "control_tree": cfg.get("control-tree"),
     }
     tl = cfg.get("timeline") or {}
     flat["timeline_filename"] = tl.get("filename")
@@ -184,6 +192,8 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.wire_compression:
         env["HOROVOD_WIRE_COMPRESSION"] = args.wire_compression
+    if args.control_tree:
+        env["HOROVOD_CONTROL_TREE"] = args.control_tree
     if args.fault_inject:
         env["HOROVOD_FAULT_INJECT"] = args.fault_inject
     if args.stall_check_disable:

@@ -43,7 +43,54 @@ log = get_logger()
 # Mirror of kProtocolVersion in cpp/socket_controller.cc — the two MUST move
 # together (tools/hvd_lint.py enforces it).  Exposed so launcher diagnostics
 # and rendezvous error messages can name the wire generation they speak.
-PROTOCOL_VERSION = 8
+PROTOCOL_VERSION = 9
+
+
+def compute_ctrl_tree(host_keys, mode: str = "auto") -> dict:
+    """Pure-Python mirror of the C++ leader-tree topology (protocol v9).
+
+    Mirrors ``SocketController::DecideCtrlTree`` + ``ComputeCtrlTree``:
+    ranks are grouped by host key in first-appearance order over rank
+    order, the first rank of each host is its leader, and rank 0 (when
+    present) is always both the coordinator and its own host's leader.
+
+    ``host_keys`` is either a list (index = rank) or a dict
+    ``{rank: key}`` — the dict form models re-election over survivors
+    after ranks die (recompute with the dead ranks removed).
+
+    Returns ``{"on": bool, "leaders": [rank...], "leader_of": {rank:
+    leader}, "children_of": {leader: [rank...]}}``.  When the engagement
+    rule demotes to flat (single host; or "auto" with fewer than 8
+    ranks), ``on`` is False and the topology fields are empty.
+    """
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"mode must be auto|on|off, got {mode!r}")
+    if isinstance(host_keys, dict):
+        items = sorted((int(r), str(k)) for r, k in host_keys.items())
+    else:
+        items = list(enumerate(str(k) for k in host_keys))
+    n = len(items)
+    off = {"on": False, "leaders": [], "leader_of": {}, "children_of": {}}
+    if mode == "off" or n == 0:
+        return off
+    distinct = {k for _, k in items}
+    if len(distinct) < 2:
+        return off  # single host: the tree is pure overhead
+    if mode == "auto" and n < 8:
+        return off
+    groups: List[List[int]] = []
+    group_of: Dict[str, int] = {}
+    for r, k in items:
+        if k in group_of:
+            groups[group_of[k]].append(r)
+        else:
+            group_of[k] = len(groups)
+            groups.append([r])
+    leaders = [g[0] for g in groups]
+    leader_of = {r: g[0] for g in groups for r in g}
+    children_of = {g[0]: g[1:] for g in groups}
+    return {"on": True, "leaders": leaders, "leader_of": leader_of,
+            "children_of": children_of}
 
 
 @dataclasses.dataclass
@@ -197,6 +244,14 @@ class CoreBackend:
         """Cumulative negotiation ctrl-channel payload bytes (zero for
         backends without a socket control plane)."""
         return {"ctrl_sent": 0, "ctrl_recv": 0}
+
+    def ctrl_plane_stats(self) -> dict:
+        """Cumulative negotiation ctrl-plane frame + byte counters (zero
+        for backends without a socket control plane).  On the coordinator,
+        ctrl_msgs_recv per cycle measures the leader tree's fan-in
+        reduction (protocol v9)."""
+        return {"ctrl_msgs_sent": 0, "ctrl_msgs_recv": 0,
+                "ctrl_bytes_sent": 0, "ctrl_bytes_recv": 0}
 
     def data_plane_stats(self) -> dict:
         """Cumulative host-data-plane bytes sent, split by locality, plus

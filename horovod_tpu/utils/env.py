@@ -39,6 +39,15 @@ IGNORED_VARS = (
 #                                     giving up on the coordinator
 #   HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS  base delay of the exponential
 #                                     rendezvous retry backoff
+#   HOROVOD_CONTROL_TREE              leader-tree control plane (protocol
+#                                     v9): auto (default; engages on multi-
+#                                     host jobs with size >= 8) | on | off.
+#                                     Only the coordinator's value matters —
+#                                     its verdict rides the rendezvous book.
+#   HOROVOD_RENDEZVOUS_ACCEPTORS      coordinator-side rendezvous acceptor
+#                                     threads (default 4, clamped to 1..64)
+#                                     draining the worker HELLO herd in
+#                                     parallel
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, same default as reference
 DEFAULT_CYCLE_TIME_MS = 1.0

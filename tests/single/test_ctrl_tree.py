@@ -1,0 +1,128 @@
+"""Leader-tree topology unit tests (protocol v9 control plane).
+
+`horovod_tpu.runtime.compute_ctrl_tree` is the pure-Python mirror of the
+C++ `SocketController::DecideCtrlTree` + `ComputeCtrlTree` pair, so these
+tests pin the topology contract both layers must agree on: grouping by
+host key in first-appearance order, first-rank-per-host leaders, the
+engagement rule ("auto" needs a multi-host job with np >= 8), and the
+dict form that models re-election over survivors after a leader dies
+(the PR 5 culprit sweep removes the dead rank; recomputing over the rest
+must promote the next rank on that host).
+"""
+
+import pytest
+
+from horovod_tpu.runtime import compute_ctrl_tree
+
+
+def fake_hosts(np_, hosts):
+    """Mirror of the C++ HOROVOD_HIER_FAKE_HOSTS partition: rank r lands
+    on host r * hosts // np_ (consecutive ranks share a host)."""
+    return [f"fakehost-{r * hosts // np_}" for r in range(np_)]
+
+
+FLAT = {"on": False, "leaders": [], "leader_of": {}, "children_of": {}}
+
+
+def test_fan_out_16_ranks_4_hosts():
+    t = compute_ctrl_tree(fake_hosts(16, 4))
+    assert t["on"] is True
+    assert t["leaders"] == [0, 4, 8, 12]
+    assert t["children_of"][0] == [1, 2, 3]
+    assert t["children_of"][12] == [13, 14, 15]
+    # Every rank maps to the leader of its own block.
+    for r in range(16):
+        assert t["leader_of"][r] == (r // 4) * 4
+
+
+def test_coordinator_is_its_hosts_leader():
+    t = compute_ctrl_tree(["a", "a", "b", "b", "b", "c", "c", "c"])
+    assert t["leaders"][0] == 0
+    assert t["leader_of"][0] == 0
+    assert t["children_of"][0] == [1]
+
+
+def test_single_host_demotes_to_flat():
+    # Even with mode forced "on": one host means the tree is pure
+    # overhead, and the C++ side refuses it identically.
+    assert compute_ctrl_tree(["h"] * 64, mode="on") == FLAT
+    assert compute_ctrl_tree(["h"] * 64, mode="auto") == FLAT
+
+
+def test_mode_off_always_flat():
+    assert compute_ctrl_tree(fake_hosts(256, 16), mode="off") == FLAT
+
+
+def test_auto_needs_np_8():
+    hosts = ["a", "a", "b", "b"]
+    assert compute_ctrl_tree(hosts, mode="auto") == FLAT
+    # ...but an explicit "on" engages on any multi-host job.
+    assert compute_ctrl_tree(hosts, mode="on")["on"] is True
+    # And at exactly 8 ranks "auto" engages.
+    assert compute_ctrl_tree(fake_hosts(8, 2), mode="auto")["on"] is True
+
+
+def test_ragged_hosts_1_plus_7():
+    # One lone rank on its own host plus seven on another: both hosts get
+    # a leader; the lone rank leads an empty subtree.
+    keys = ["solo"] + ["big"] * 7
+    t = compute_ctrl_tree(keys)
+    assert t["on"] is True
+    assert t["leaders"] == [0, 1]
+    assert t["children_of"][0] == []
+    assert t["children_of"][1] == [2, 3, 4, 5, 6, 7]
+
+
+def test_first_appearance_order_not_sorted_keys():
+    # Grouping follows rank order, not lexicographic key order.
+    keys = ["zz", "zz", "zz", "zz", "aa", "aa", "aa", "aa"]
+    t = compute_ctrl_tree(keys, mode="on")
+    assert t["leaders"] == [0, 4]
+
+
+def test_dict_form_matches_list_form():
+    keys = fake_hosts(16, 4)
+    as_list = compute_ctrl_tree(keys)
+    as_dict = compute_ctrl_tree({r: k for r, k in enumerate(keys)})
+    assert as_list == as_dict
+
+
+def test_leader_death_reelection():
+    # np=16 / 4 hosts; leader 4 dies.  The PR 5 culprit sweep severs it;
+    # recomputing over the survivors must promote rank 5 (the next rank
+    # on host 1) and leave every other subtree untouched.
+    keys = {r: k for r, k in enumerate(fake_hosts(16, 4))}
+    before = compute_ctrl_tree(keys)
+    assert before["leaders"] == [0, 4, 8, 12]
+    del keys[4]
+    after = compute_ctrl_tree(keys)
+    assert after["on"] is True
+    assert after["leaders"] == [0, 5, 8, 12]
+    assert after["children_of"][5] == [6, 7]
+    assert after["children_of"][8] == before["children_of"][8]
+
+
+def test_whole_host_death_drops_the_subtree():
+    keys = {r: k for r, k in enumerate(fake_hosts(16, 4))}
+    for r in (4, 5, 6, 7):  # host 1 gone entirely
+        del keys[r]
+    t = compute_ctrl_tree(keys)
+    assert t["leaders"] == [0, 8, 12]
+    assert 4 not in t["leader_of"] and 5 not in t["leader_of"]
+
+
+def test_death_down_to_one_host_demotes():
+    keys = {0: "a", 1: "a", 2: "b"}
+    assert compute_ctrl_tree(keys, mode="on")["on"] is True
+    del keys[2]
+    assert compute_ctrl_tree(keys, mode="on") == FLAT
+
+
+def test_bad_mode_raises():
+    with pytest.raises(ValueError):
+        compute_ctrl_tree(["a", "b"], mode="sideways")
+
+
+def test_empty_is_flat():
+    assert compute_ctrl_tree([]) == FLAT
+    assert compute_ctrl_tree({}) == FLAT

@@ -10,6 +10,7 @@ Two layers of coverage:
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -286,9 +287,11 @@ def test_cli_exits_nonzero_on_seeded_mismatch(tmp_path):
     shutil.copy(os.path.join(REPO, "README.md"), tmp_path / "README.md")
     sc = tmp_path / "horovod_tpu" / "cpp" / "socket_controller.cc"
     text = sc.read_text()
-    assert "kProtocolVersion = 8" in text
-    sc.write_text(text.replace("kProtocolVersion = 8",
-                               "kProtocolVersion = 9"))
+    m = re.search(r"kProtocolVersion = (\d+)", text)
+    assert m, "kProtocolVersion definition not found"
+    cur = int(m.group(1))
+    sc.write_text(text.replace(f"kProtocolVersion = {cur}",
+                               f"kProtocolVersion = {cur + 1}"))
     run = subprocess.run(
         [sys.executable, str(tmp_path / "tools" / "hvd_lint.py"),
          "--repo", str(tmp_path),

@@ -88,9 +88,37 @@ def test_chaos_selftest_under_ubsan():
     assert "runtime error" not in out, out
 
 
+def test_ctrl_soak_selftest():
+    """np=256 over 16 fake hosts, ctrl_only controllers: coordinator
+    inbound control messages per cycle must drop O(n) -> O(hosts)
+    (255 flat vs 30 tree = 8.5x; the binary asserts the >= 8x bar and the
+    exact tree topology count), with rendezvous over 8 sharded
+    acceptors."""
+    _build_and_run("ctrl_soak_selftest")
+
+
+def test_ctrl_soak_under_tsan():
+    """256 rank threads through leader aggregation, fan-down, and the
+    ctrl counters concurrently; TSan proves the tree cycle race-free at
+    scale."""
+    out = _build_and_run("tsan_ctrl_soak_selftest")
+    assert "ThreadSanitizer" not in out, out
+
+
+def test_ctrl_soak_under_asan():
+    out = _build_and_run("asan_ctrl_soak_selftest")
+    assert "AddressSanitizer" not in out, out
+
+
+def test_ctrl_soak_under_ubsan():
+    out = _build_and_run("ubsan_ctrl_soak_selftest")
+    assert "runtime error" not in out, out
+
+
 def test_make_selftest_target():
-    """`make selftest` builds and runs every non-TSAN selftest binary —
-    including the ASan/UBSan variants — in one shot: the entry point
+    """`make selftest` builds and runs every selftest binary except the
+    slow 3-rank TSan variants — the ASan/UBSan variants and the fast
+    TSan ctrl-soak ARE included — in one shot: the entry point
     developers (and CI without pytest) use."""
     out = subprocess.run(["make", "selftest"], cwd=CPP_DIR,
                          capture_output=True, text=True, timeout=600)

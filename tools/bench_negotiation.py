@@ -23,6 +23,13 @@ with HOROVOD_METRICS=1 and reports the registry's negotiation-throughput
 overhead against the metrics-off baseline (disabled is the baseline
 itself: every instrumentation site is behind one relaxed bool load, so
 disabled overhead is zero by construction).
+
+With --np-sweep N,N,... the tool instead sweeps job sizes over fake
+multi-host topologies (4 ranks per fake host) and prints the O(n)-vs-
+O(hosts) table behind the v9 leader tree: coordinator inbound control
+messages and bytes per negotiation cycle, flat vs tree, from the
+ctrl_msgs_/ctrl_bytes_ counters normalised by cycle_count.  Results are
+recorded in docs/benchmarks.md.
 """
 
 import argparse
@@ -157,6 +164,68 @@ def run_wire_config(codec: str, np_: int, steps: int, elems: int):
     return agg
 
 
+def _sweep_worker(steps: int, tensors: int):
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu import mpi_ops
+
+    hvd.init(build_mesh=False)
+    grads = [np.full(64, float(i), np.float32) for i in range(tensors)]
+
+    def step():
+        hs = [mpi_ops.allreduce_async(g, name=f"sw.{i}", op=hvd.Sum)
+              for i, g in enumerate(grads)]
+        for h in hs:
+            mpi_ops.synchronize(h)
+
+    for _ in range(5):  # steady state: response cache populated
+        step()
+    hvd.barrier()
+    c0 = hvd.metrics()["counters"]
+    for _ in range(steps):
+        step()
+    hvd.barrier()
+    c1 = hvd.metrics()["counters"]
+    rank = hvd.rank()
+    hvd.shutdown()
+    return {"rank": rank,
+            "cycles": c1["cycle_count"] - c0["cycle_count"],
+            "msgs_recv": c1["ctrl_msgs_recv"] - c0["ctrl_msgs_recv"],
+            "msgs_sent": c1["ctrl_msgs_sent"] - c0["ctrl_msgs_sent"],
+            "bytes_recv": c1["ctrl_bytes_recv"] - c0["ctrl_bytes_recv"],
+            "bytes_sent": c1["ctrl_bytes_sent"] - c0["ctrl_bytes_sent"]}
+
+
+def run_np_sweep(np_list, steps: int, tensors: int):
+    """Coordinator control messages + bytes per cycle, flat vs tree, at
+    each job size over fake hosts (4 consecutive ranks per host).  The
+    lockstep makes messages/cycle a topology constant — (np-1) flat,
+    (local-1)+(hosts-1) tree — so the per-cycle numbers are exact while
+    bytes/cycle reflect the measured aggregate framing overhead."""
+    from horovod_tpu.runner import run
+
+    for np_ in np_list:
+        hosts = max(2, np_ // 4)
+        row = {"metric": "ctrl_plane_np_sweep", "np": np_, "hosts": hosts}
+        for mode, tree in (("flat", "off"), ("tree", "on")):
+            env = {"JAX_PLATFORMS": "cpu", "HOROVOD_METRICS": "1",
+                   "HOROVOD_SHM_DISABLE": "1",
+                   "HOROVOD_HIER_FAKE_HOSTS": str(hosts),
+                   "HOROVOD_CONTROL_TREE": tree}
+            results = run(_sweep_worker, args=(steps, tensors), np=np_,
+                          env=env, stream_prefix=False)
+            coord = next(r for r in results if r["rank"] == 0)
+            cycles = max(coord["cycles"], 1)
+            row[f"{mode}_msgs_per_cycle"] = round(
+                coord["msgs_recv"] / cycles, 2)
+            row[f"{mode}_bytes_per_cycle"] = round(
+                coord["bytes_recv"] / cycles, 1)
+        row["msgs_ratio"] = round(
+            row["flat_msgs_per_cycle"]
+            / max(row["tree_msgs_per_cycle"], 1e-9), 2)
+        print(json.dumps(row), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--np", type=int, default=4)
@@ -174,8 +243,19 @@ def main():
                     help="also measure the metrics registry's negotiation "
                          "overhead: cache_on rerun with HOROVOD_METRICS=1, "
                          "steps/s ratio vs the metrics-off baseline")
+    ap.add_argument("--np-sweep", default=None, metavar="N,N,...",
+                    help="run ONLY the control-plane scaling sweep: "
+                         "coordinator ctrl messages + bytes per cycle, "
+                         "flat vs v9 leader tree, at each np over fake "
+                         "hosts (4 ranks/host)")
+    ap.add_argument("--sweep-steps", type=int, default=30)
     args = ap.parse_args()
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    if args.np_sweep:
+        run_np_sweep([int(n) for n in args.np_sweep.split(",")],
+                     args.sweep_steps, args.tensors)
+        return
 
     cache_on = run_config("cache_on", {}, args.np, args.steps, args.tensors)
     cache_off = run_config("cache_off", {"HOROVOD_CACHE_CAPACITY": "0"},

@@ -48,7 +48,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # predates them (declared inside try/except, callers hasattr-guard):
 # the checker allows conditional declaration but still verifies types.
 OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
-                    "hvd_fault_spec_check"}
+                    "hvd_fault_spec_check", "hvd_ctrl_plane_stats"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
@@ -68,6 +68,8 @@ NATIVE_READ_VARS = {
     "HOROVOD_ABORT_PROPAGATION_TIMEOUT",
     "HOROVOD_RENDEZVOUS_RETRIES",
     "HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS",
+    "HOROVOD_CONTROL_TREE",
+    "HOROVOD_RENDEZVOUS_ACCEPTORS",
 }
 
 # Public knobs read in Python outside utils/env.py (module-scope or

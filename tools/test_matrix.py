@@ -23,13 +23,19 @@ combination of:
 - metrics: off / on (HOROVOD_METRICS=1) — native-core combos appended to
            the full set; the workload asserts the registry populated
            (cycle occupancy, negotiation-wait histogram) when enabled
+- ctrl_tree: auto (default) / on (HOROVOD_CONTROL_TREE, the v9 leader
+           tree) — "on" combos run over fake hosts since auto stays flat
+           below np=8; one on-combo in the quick set, the rest (plus a
+           single-host demotion row) full only
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
 contract, both sets), and — full set only — the ASan/UBSan selftest
-builds, the `chaos` fault-injection/fast-abort selftest, and the np=4
+builds, the `chaos` fault-injection/fast-abort selftest, the np=4
 fault-injection pytest (`fault-np4`: abort bound, corrupt-tag fail-fast,
-elastic recovery under --fault-inject).
+elastic recovery under --fault-inject), the np=256 control-plane soak
+(`ctrl-soak`: flat vs tree coordinator message counts), and the np=8
+tree-vs-flat parity pytest (`ctrl-np8`).
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -223,6 +229,8 @@ def combos(quick: bool):
         yield ("jax", "native", 3, "on", "off", "tcp0", "none", "off")
         yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off")
         yield ("jax", "native", 3, "on", "off", "hier", "int8", "off")
+        # ctrl_tree axis: the one quick on-combo (2 fake hosts via hier).
+        yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "on")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -249,6 +257,15 @@ def combos(quick: bool):
     yield ("jax", "native", 3, "on", "on", "shm", "none", "on")
     yield ("jax", "native", 3, "off", "off", "tcp", "none", "on")
     yield ("jax", "native", 3, "on", "on", "hier", "bf16", "on")
+    # Control-tree axis: v9 leader tree forced on over fake hosts ("auto"
+    # stays flat below np=8), with caching/fusion/metrics variation, plus
+    # a single-host demotion row (tree=on without multiple hosts must
+    # quietly stay flat and change nothing).
+    yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "on")
+    yield ("jax", "native", 3, "off", "off", "hier", "none", "on", "on")
+    yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off", "on")
+    yield ("jax", "native", 3, "on", "on", "tcp", "none", "off", "on")
+    yield ("torch", "native", 3, "on", "on", "hier", "none", "off", "on")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -299,6 +316,19 @@ def checks(quick: bool):
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_fault_injection.py")]],
            REPO, 600.0)
+    # np=256 in-process control-plane soak: flat vs v9 tree coordinator
+    # message counts (>= 8x cut at 256 ranks / 16 fake hosts) plus the
+    # sharded rendezvous acceptors under the full HELLO herd.
+    yield ("ctrl-soak",
+           [["make", "ctrl_soak_selftest"],
+            [os.path.join(CPP_DIR, "ctrl_soak_selftest")]],
+           CPP_DIR, 600.0)
+    # np=8 fake-host end-to-end: tree-vs-flat collective/attribution
+    # parity and leader-death abort bounds.
+    yield ("ctrl-np8",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_ctrl_tree_np8.py")]],
+           REPO, 600.0)
 
 
 def run_check(cmds, cwd: str, timeout: float) -> tuple:
@@ -316,7 +346,7 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
-              plane: str, wire: str, metrics: str, script: str,
+              plane: str, wire: str, metrics: str, tree: str, script: str,
               timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -337,6 +367,8 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # An ambient fault-injection spec would sabotage every workload combo
     # (that's its job); faults belong to the dedicated check rows only.
     env.pop("HOROVOD_FAULT_INJECT", None)
+    # The ctrl_tree axis owns the control-plane topology knob.
+    env.pop("HOROVOD_CONTROL_TREE", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -358,6 +390,8 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_WIRE_COMPRESSION"] = wire
     if metrics == "on":
         env["HOROVOD_METRICS"] = "1"
+    if tree != "auto":
+        env["HOROVOD_CONTROL_TREE"] = tree
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -399,12 +433,15 @@ def main() -> int:
             with open(scripts[binding], "w") as f:
                 f.write(text)
         for combo in combos(args.quick):
-            binding, core, np_, fusion, cache, plane, wire, metrics = combo
+            if len(combo) == 8:  # rows predating the ctrl_tree axis
+                combo = combo + ("auto",)
+            (binding, core, np_, fusion, cache, plane, wire, metrics,
+             tree) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
-                     f"wire={wire:<4} metrics={metrics}")
+                     f"wire={wire:<4} metrics={metrics:<3} tree={tree}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
-                                       wire, metrics,
+                                       wire, metrics, tree,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

@@ -68,6 +68,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_int, c.c_char_p, c.c_double,           # metrics metrics_file interval
         c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
+        c.c_int, c.c_int, c.c_char_p,              # flight_on flight_slots postmortem_dir
     ]
     lib.hvd_shutdown.restype = c.c_int
     lib.hvd_is_initialized.restype = c.c_int
@@ -140,6 +141,13 @@ def _declare(lib: ctypes.CDLL) -> None:
         pass
     lib.hvd_last_error.restype = c.c_char_p
     try:
+        # Old-ABI tolerance: a stale .so predating the flight recorder
+        # degrades flight_record() to {} instead of raising.
+        lib.hvd_flight_record.restype = c.c_int
+        lib.hvd_flight_record.argtypes = [c.c_char_p, c.c_int]
+    except AttributeError:
+        pass
+    try:
         # Old-ABI tolerance: a stale .so predating the fault-injection
         # plane simply loses `horovodrun --fault-inject` pre-validation.
         lib.hvd_fault_spec_check.restype = c.c_char_p
@@ -208,6 +216,9 @@ class NativeCore(CoreBackend):
             cfg.stall_warning_s if cfg.stall_check_enabled else 0.0,
             cfg.stall_shutdown_s,
             _LOG_LEVELS.get(cfg.log_level, 3),
+            1 if cfg.flight_recorder_enabled else 0,
+            cfg.flight_recorder_slots,
+            (cfg.postmortem_dir or "").encode(),
         )
         if rc != 0:
             raise NativeCoreError(
@@ -479,6 +490,32 @@ class NativeCore(CoreBackend):
             cap *= 4
             buf = ctypes.create_string_buffer(cap)
             n = self._lib.hvd_metrics_dump(buf, cap)
+        if n <= 0:
+            return {}
+        return json.loads(buf.raw[:n].decode())
+
+    _warned_no_flight = False
+
+    def flight_record(self) -> dict:
+        """Snapshot of this rank's flight-recorder ring (the always-on event
+        black box): {"rank", "host", "slots", "dropped", "types", "events"}
+        where events are [ts_us, seq, type, tid, a, b] rows, oldest first.
+        {} when the recorder is off (HOROVOD_FLIGHT_RECORDER=off) or the .so
+        predates it."""
+        if not hasattr(self._lib, "hvd_flight_record"):
+            if not NativeCore._warned_no_flight:
+                NativeCore._warned_no_flight = True
+                log.warning("native core predates the flight recorder "
+                            "(hvd_flight_record missing); flight_record() "
+                            "returns {}")
+            return {}
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_flight_record(buf, cap)
+        while n == -2:  # buffer too small: grow and retry
+            cap *= 4
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.hvd_flight_record(buf, cap)
         if n <= 0:
             return {}
         return json.loads(buf.raw[:n].decode())

@@ -210,6 +210,19 @@ def metrics_prometheus() -> str:
     return render_prometheus(metrics())
 
 
+def flight_record() -> dict:
+    """Snapshot of this rank's flight-recorder ring — the always-on event
+    black box (rendezvous, cycle sends/recvs, verdicts, ring hops, shm
+    fences, aggregate frames, fault trips, aborts).  Keys: ``rank``,
+    ``host``, ``slots``, ``dropped``, ``types`` (event-type legend) and
+    ``events`` as ``[ts_us, seq, type, tid, a, b]`` rows, oldest first.
+    Empty when HOROVOD_FLIGHT_RECORDER=off or the backend has no native
+    recorder.  On abort the same payload is written per rank under
+    HOROVOD_POSTMORTEM_DIR and merged by the coordinator into
+    ``postmortem.json`` (render with ``tools/postmortem.py``)."""
+    return HorovodContext.instance().core.flight_record()
+
+
 # -- timeline ---------------------------------------------------------------
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

@@ -26,6 +26,7 @@
 #include "common.h"
 #include "controller.h"
 #include "fault_injection.h"
+#include "flight_recorder.h"
 #include "logging.h"
 #include "metrics.h"
 #include "parameter_manager.h"
@@ -150,8 +151,25 @@ std::string ControllerMetricsJson() {
   return sc ? sc->ClusterMetricsJson() : std::string();
 }
 
+// The registry's ctrl_* counters only accumulate while MetricsOn(), but the
+// controller's own counters always run — a dump taken after metrics were
+// toggled (or requested with metrics off) would render stale zeros.  Store
+// the authoritative controller totals into the registry before rendering.
+void SyncCtrlCountersToRegistry() {
+  auto* sc = dynamic_cast<SocketController*>(g->controller.get());
+  if (sc == nullptr) return;
+  int64_t ms = 0, mr = 0, bs = 0, br = 0;
+  sc->CtrlPlaneStats(&ms, &mr, &bs, &br);
+  auto& m = GlobalMetrics();
+  m.ctrl_msgs_sent.store(ms, std::memory_order_relaxed);
+  m.ctrl_msgs_recv.store(mr, std::memory_order_relaxed);
+  m.ctrl_bytes_sent.store(bs, std::memory_order_relaxed);
+  m.ctrl_bytes_recv.store(br, std::memory_order_relaxed);
+}
+
 // Atomic (write-then-rename) so a reader never sees a torn snapshot.
 void WriteMetricsFile() {
+  SyncCtrlCountersToRegistry();
   std::string json =
       GlobalMetrics().DumpJson(g->cfg.rank, ControllerMetricsJson());
   std::string tmp = g->metrics_path + ".tmp";
@@ -218,8 +236,14 @@ void BackgroundLoop() {
       } else {
         HVD_LOG(ERROR) << "negotiation failed: " << s.reason;
         // Mark the abort on the trace so a merged multi-rank timeline shows
-        // when each survivor learned of the failure.
-        g->timeline.Instant("ABORT");
+        // when each survivor learned of the failure; the args carry the
+        // culprit attribution for merge_timeline.py / postmortem.py.
+        g->timeline.Instant("ABORT",
+                            "{\"reason\":\"" + JsonEscape(s.reason) + "\"}");
+        // Belt and braces: every socket abort path already dumped, but
+        // aborts that never touched the abort machinery (cache divergence,
+        // local controller) still leave their black box here.
+        if (FlightOn()) FlightDumpToFile();
       }
       FailAllOutstanding("Horovod negotiation failed: " + s.reason);
       continue;
@@ -354,6 +378,9 @@ void BackgroundLoop() {
             "matching tensor); shutting down";
         SetLastError(msg);
         HVD_LOG(ERROR) << msg;
+        g->timeline.Instant("ABORT",
+                            "{\"reason\":\"" + JsonEscape(msg) + "\"}");
+        if (FlightOn()) FlightDumpToFile();
         FailAllOutstanding("Horovod stall shutdown: " + msg);
       }
     }
@@ -389,7 +416,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int metrics_enabled, const char* metrics_file,
              double metrics_interval_s, const char* timeline_path,
              int timeline_mark_cycles, double stall_warn_s,
-             double stall_shutdown_s, int log_level) {
+             double stall_shutdown_s, int log_level, int flight_enabled,
+             int flight_slots, const char* postmortem_dir) {
   if (g != nullptr) return -1;
   SetInitError("");  // a fresh attempt must not inherit a stale reason
   g = new GlobalState();
@@ -451,6 +479,12 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   }
   g->timeline.SetRank(cfg.rank);
 
+  // Flight recorder arms BEFORE the controller exists: the rendezvous is
+  // the first event worth keeping, and an init failure below still leaves
+  // a black box behind.
+  InitFlightRecorder(flight_enabled != 0, flight_slots,
+                     postmortem_dir ? postmortem_dir : "", cfg.rank);
+
   if (cfg.size > 1 || cfg.controller == "socket") {
     g->controller = std::make_unique<SocketController>(cfg);
   } else {
@@ -460,6 +494,9 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   if (!s.ok()) {
     SetInitError(s.reason);
     HVD_LOG(ERROR) << "init failed: " << s.reason;
+    // A fatal init error is a postmortem moment too (the rank may have
+    // recorded a partial rendezvous before dying).
+    if (FlightOn()) FlightDumpToFile();
     GlobalMetrics().enabled.store(false, std::memory_order_relaxed);
     delete g;
     g = nullptr;
@@ -790,8 +827,24 @@ void hvd_data_plane_stats2(long long* local, long long* xhost,
 // hvd_pop_response).
 int hvd_metrics_dump(char* buf, int cap) {
   if (g == nullptr) return -1;
+  SyncCtrlCountersToRegistry();
   std::string json =
       GlobalMetrics().DumpJson(g->cfg.rank, ControllerMetricsJson());
+  if (static_cast<int>(json.size()) + 1 > cap) return -2;
+  std::memcpy(buf, json.data(), json.size());
+  buf[json.size()] = '\0';
+  return static_cast<int>(json.size());
+}
+
+// This rank's full flight-recorder buffer as one JSON object (the same
+// schema as the crash dumps under HOROVOD_POSTMORTEM_DIR).  Returns:
+// >0 = JSON length written, 0 = recorder disabled, -1 = not initialized,
+// -2 = buffer too small (caller grows and retries, same convention as
+// hvd_metrics_dump).
+int hvd_flight_record(char* buf, int cap) {
+  if (g == nullptr) return -1;
+  if (!FlightOn()) return 0;
+  std::string json = FlightDumpJson();
   if (static_cast<int>(json.size()) + 1 > cap) return -2;
   std::memcpy(buf, json.data(), json.size());
   buf[json.size()] = '\0';

@@ -8,6 +8,7 @@
 #include <thread>
 #include <vector>
 
+#include "flight_recorder.h"
 #include "logging.h"
 #include "metrics.h"
 
@@ -215,11 +216,18 @@ FaultAction FaultCheck(FaultSite site, int rank, long long* arg) {
     HVD_LOG(WARNING) << "fault injection: " << ActionName(rule.action)
                      << " at " << FaultSiteName(site) << " rank " << rank
                      << " hit " << hit;
+    if (FlightOn()) {
+      FlightRecord(kFlightFaultTrip, static_cast<int32_t>(site),
+                   static_cast<int64_t>(rule.action));
+    }
     switch (rule.action) {
       case FaultAction::kDelay:
         std::this_thread::sleep_for(std::chrono::milliseconds(rule.arg));
         return FaultAction::kDelay;
       case FaultAction::kDie:
+        // The injected death is the postmortem's whole subject: leave the
+        // black box behind before vanishing.
+        if (FlightOn()) FlightDumpToFile();
         _exit(137);
       default:
         if (arg) *arg = rule.arg;

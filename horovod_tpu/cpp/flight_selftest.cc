@@ -1,0 +1,247 @@
+// Self-test for the flight recorder (flight_recorder.{h,cc}): ring
+// wraparound accounting, multi-thread interleave with a concurrent dumper
+// (the TSan target of the sanitizer matrix), atomic dump-to-file, and the
+// dump-on-fatal-signal path via a forked child.  Build/run via `make
+// flight_selftest` (plus tsan_/asan_/ubsan_ variants); wired into `make
+// selftest`.
+
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "flight_recorder.h"
+
+using namespace hvdtpu;
+
+#define CHECK_TRUE(cond, what)                                      \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      std::fprintf(stderr, "  CHECK failed: %s (%s:%d)\n", what,    \
+                   __FILE__, __LINE__);                             \
+      return false;                                                 \
+    }                                                               \
+  } while (0)
+
+namespace {
+
+std::string TempDir() {
+  char tmpl[] = "/tmp/hvd_flight_XXXXXX";
+  char* d = ::mkdtemp(tmpl);
+  return d ? std::string(d) : std::string("/tmp");
+}
+
+bool TestBasicRecordAndTail() {
+  ResetFlightRecorderForTest();
+  InitFlightRecorder(true, 256, "", 3);
+  CHECK_TRUE(FlightOn(), "recorder armed");
+  for (int i = 0; i < 10; ++i) {
+    FlightRecord(kFlightCtrlSend, i, 100 + i);
+  }
+  std::vector<FlightEvent> tail;
+  FlightTail(4, &tail);
+  CHECK_TRUE(tail.size() == 4, "tail length");
+  for (size_t i = 1; i < tail.size(); ++i) {
+    CHECK_TRUE(tail[i].seq > tail[i - 1].seq, "tail seq ascending");
+  }
+  CHECK_TRUE(tail.back().a == 9 && tail.back().b == 109, "last event payload");
+  CHECK_TRUE(tail.back().type == kFlightCtrlSend, "event type");
+  CHECK_TRUE(FlightDropped() == 0, "nothing dropped");
+  CHECK_TRUE(FlightDumpPath().empty(), "no dump path without dir");
+  return true;
+}
+
+bool TestWraparound() {
+  ResetFlightRecorderForTest();
+  InitFlightRecorder(true, 64, "", 0);  // kMinSlots floor
+  const int kEvents = 200;
+  for (int i = 0; i < kEvents; ++i) {
+    FlightRecord(kFlightRingHop, i, 2 * i);
+  }
+  CHECK_TRUE(FlightDropped() == kEvents - 64, "dropped = overflow");
+  std::vector<FlightEvent> tail;
+  FlightTail(1 << 20, &tail);
+  CHECK_TRUE(tail.size() == 64, "ring holds exactly slots events");
+  // The survivors are the newest 64, contiguous and in order.
+  for (size_t i = 0; i < tail.size(); ++i) {
+    CHECK_TRUE(tail[i].a == kEvents - 64 + static_cast<int>(i),
+               "survivor is newest window");
+  }
+  return true;
+}
+
+bool TestSlotRounding() {
+  ResetFlightRecorderForTest();
+  InitFlightRecorder(true, 100, "", 0);  // rounds up to 128
+  for (int i = 0; i < 300; ++i) FlightRecord(kFlightShmFence, i, 0);
+  CHECK_TRUE(FlightDropped() == 300 - 128, "slots rounded to power of two");
+  return true;
+}
+
+bool TestMultiThreadInterleave() {
+  ResetFlightRecorderForTest();
+  InitFlightRecorder(true, 4096, "", 0);
+  const int kThreads = 8;
+  const int kPerThread = 500;
+  std::atomic<bool> stop{false};
+  // A concurrent dumper makes this the TSan workout: dump reads race
+  // record writes on live rings and must stay data-race-free.
+  std::thread dumper([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      std::vector<FlightEvent> t;
+      FlightTail(64, &t);
+      std::string j = FlightDumpJson();
+      if (j.empty()) break;
+    }
+  });
+  std::vector<std::thread> workers;
+  for (int t = 0; t < kThreads; ++t) {
+    workers.emplace_back([t] {
+      for (int i = 0; i < kPerThread; ++i) {
+        FlightRecord(kFlightVerdict, t, i);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  stop.store(true, std::memory_order_relaxed);
+  dumper.join();
+  std::vector<FlightEvent> all;
+  FlightTail(1 << 20, &all);
+  // The dumper thread registers a slot but records nothing; the main
+  // thread may have stale events from a prior test? No — reset cleared.
+  std::set<uint64_t> seqs;
+  int per_thread_seen[64] = {0};
+  for (const auto& ev : all) {
+    CHECK_TRUE(seqs.insert(ev.seq).second, "global seq unique");
+    if (ev.type == kFlightVerdict) per_thread_seen[ev.a % 64]++;
+  }
+  CHECK_TRUE(static_cast<int>(all.size()) == kThreads * kPerThread,
+             "no events lost below capacity");
+  for (int t = 0; t < kThreads; ++t) {
+    CHECK_TRUE(per_thread_seen[t] == kPerThread, "per-thread count");
+  }
+  CHECK_TRUE(FlightDropped() == 0, "no wrap at this volume");
+  return true;
+}
+
+bool TestDumpToFile() {
+  ResetFlightRecorderForTest();
+  std::string dir = TempDir();
+  InitFlightRecorder(true, 128, dir + "/{rank}", 7);
+  for (int i = 0; i < 20; ++i) FlightRecord(kFlightCtrlRecv, i, 3 * i);
+  FlightDumpToFile();
+  std::string path = FlightDumpPath();
+  CHECK_TRUE(path == dir + "/7/flight.7.json", "rank-templated path");
+  std::ifstream f(path);
+  CHECK_TRUE(f.good(), "dump file exists");
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string text = ss.str();
+  CHECK_TRUE(text.find("\"rank\":7") != std::string::npos, "rank field");
+  CHECK_TRUE(text.find("\"types\":{") != std::string::npos, "types legend");
+  CHECK_TRUE(text.find("\"events\":[[") != std::string::npos, "events body");
+  CHECK_TRUE(text.back() == '}', "complete object");
+  // Balanced-bracket sanity (the dump is machine-written, no strings
+  // beyond host/legend literals).
+  int depth = 0;
+  for (char c : text) {
+    if (c == '{' || c == '[') depth++;
+    if (c == '}' || c == ']') depth--;
+  }
+  CHECK_TRUE(depth == 0, "balanced JSON");
+  // In-memory dump agrees on the header fields.
+  std::string mem = FlightDumpJson();
+  CHECK_TRUE(mem.find("\"rank\":7") != std::string::npos, "mem dump rank");
+  CHECK_TRUE(mem.find("\"dropped\":0") != std::string::npos, "mem dropped");
+  return true;
+}
+
+bool TestDumpOnFatalSignal() {
+  ResetFlightRecorderForTest();
+  std::string dir = TempDir();
+  pid_t pid = ::fork();
+  CHECK_TRUE(pid >= 0, "fork");
+  if (pid == 0) {
+    // Child: arm with a postmortem dir (installs the fatal handlers),
+    // record a little history, then die abruptly.  SIGABRT rather than
+    // SIGSEGV: sanitizer runtimes own SIGSEGV and the recorder refuses
+    // to trample non-default dispositions.
+    InitFlightRecorder(true, 128, dir, 2);
+    for (int i = 0; i < 5; ++i) FlightRecord(kFlightFaultTrip, i, 137);
+    ::raise(SIGABRT);
+    ::_exit(0);  // unreachable
+  }
+  int status = 0;
+  CHECK_TRUE(::waitpid(pid, &status, 0) == pid, "waitpid");
+  CHECK_TRUE(WIFSIGNALED(status) && WTERMSIG(status) == SIGABRT,
+             "child died of SIGABRT");
+  std::ifstream f(dir + "/flight.2.json");
+  CHECK_TRUE(f.good(), "signal handler dumped the ring");
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string text = ss.str();
+  CHECK_TRUE(text.find("\"rank\":2") != std::string::npos, "child rank");
+  CHECK_TRUE(text.find(",10,") != std::string::npos, "fault_trip events");
+  CHECK_TRUE(text.back() == '}', "atomic rename: never a partial file");
+  return true;
+}
+
+bool TestReset() {
+  ResetFlightRecorderForTest();
+  InitFlightRecorder(true, 128, "", 0);
+  FlightRecord(kFlightAbort, 1, 0);
+  ResetFlightRecorderForTest();
+  CHECK_TRUE(!FlightOn(), "disarmed after reset");
+  std::vector<FlightEvent> tail;
+  FlightTail(16, &tail);
+  CHECK_TRUE(tail.empty(), "rings forgotten");
+  // Threads re-register cleanly in the new epoch.
+  InitFlightRecorder(true, 128, "", 0);
+  FlightRecord(kFlightAbort, 2, 1);
+  FlightTail(16, &tail);
+  CHECK_TRUE(tail.size() == 1 && tail[0].a == 2, "fresh epoch records");
+  return true;
+}
+
+}  // namespace
+
+int main() {
+  struct Case {
+    const char* name;
+    bool (*fn)();
+  } cases[] = {
+      {"basic_record_and_tail", TestBasicRecordAndTail},
+      {"wraparound", TestWraparound},
+      {"slot_rounding", TestSlotRounding},
+      {"multi_thread_interleave", TestMultiThreadInterleave},
+      {"dump_to_file", TestDumpToFile},
+      {"dump_on_fatal_signal", TestDumpOnFatalSignal},
+      {"reset", TestReset},
+  };
+  int failures = 0;
+  for (const auto& c : cases) {
+    std::fprintf(stderr, "[flight_selftest] %s...\n", c.name);
+    if (!c.fn()) {
+      std::fprintf(stderr, "[flight_selftest] %s FAILED\n", c.name);
+      failures++;
+    }
+  }
+  if (failures == 0) {
+    std::printf("PASS\n");
+    return 0;
+  }
+  std::printf("FAIL(%d)\n", failures);
+  return 1;
+}

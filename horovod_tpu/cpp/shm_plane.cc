@@ -7,6 +7,7 @@
 
 #include <algorithm>
 
+#include "flight_recorder.h"
 #include "logging.h"
 
 namespace hvdtpu {
@@ -64,6 +65,7 @@ Status ShmRegion::Open(const std::string& name, bool creator) {
                          "mmap(" + name + ") failed");
   }
   cap_ = initial;
+  if (FlightOn()) FlightRecord(kFlightShmMap, 0, cap_);
   return Status::OK();
 }
 
@@ -90,6 +92,7 @@ Status ShmRegion::EnsureCapacity(int64_t data_bytes, bool creator,
                          "shm grow mmap(" + name_ + ") failed");
   }
   cap_ = new_cap;
+  if (FlightOn()) FlightRecord(kFlightShmMap, 1, cap_);
   return Status::OK();
 }
 

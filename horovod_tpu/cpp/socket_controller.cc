@@ -4,6 +4,7 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstdio>
 
 #include <algorithm>
 #include <chrono>
@@ -80,6 +81,14 @@ constexpr int32_t kTagCompAllgather = 0x13000;
 // responses position as [-2][kTagAbort][reason][culprit_rank][culprit_host]
 // [f64 send wallclock]; the tag double-checks the sentinel parse.
 constexpr int32_t kTagAbort = 0x13800;
+// Flight-recorder digest (abort-time forensics): rides the ctrl channel in
+// the cycle position as [-4][kTagFlightDigest][rank][n]
+// [n x (i64 ts_us, i64 seq, i32 type, i32 tid, i32 a, i64 b)].  Best-effort
+// and bounded by the abort budget — a dropped digest never delays the abort.
+constexpr int32_t kTagFlightDigest = 0x14000;
+// Last-N window a digest carries: enough causal context around the collapse
+// without bloating the abort exchange (48 bytes/event -> ~6 KiB per rank).
+constexpr int kFlightDigestEvents = 128;
 
 // Broadcasts at least this large take the pipelined chain instead of the
 // binomial tree.  A protocol constant: the algorithm choice must agree on
@@ -445,6 +454,9 @@ Status SocketController::Initialize() {
   if (!ts.ok()) return ts;
   hierarchical_.store(cfg_.hierarchical, std::memory_order_relaxed);
   wire_compression_.store(cfg_.wire_compression, std::memory_order_relaxed);
+  if (FlightOn()) {
+    FlightRecord(kFlightRendezvous, cfg_.size, kProtocolVersion);
+  }
   initialized_ = true;
   return Status::OK();
 }
@@ -837,9 +849,16 @@ Status SocketController::ComputeResponses(
     return is_coordinator() ? CoordinatorAbortSweep()
                             : WorkerAbortHandshake();
   }
-  if (is_coordinator()) return CoordinatorCycle(new_requests, out);
-  if (IsTreeLeader()) return LeaderCycle(new_requests, out);
-  return WorkerCycle(new_requests, out);
+  const Status st = is_coordinator() ? CoordinatorCycle(new_requests, out)
+                    : IsTreeLeader() ? LeaderCycle(new_requests, out)
+                                     : WorkerCycle(new_requests, out);
+  if (FlightOn() && st.ok() && !out->empty()) {
+    // Negotiation verdict: how many responses this cycle fused, and the
+    // data-op seq the plane advanced to (every rank records the same pair).
+    FlightRecord(kFlightVerdict, static_cast<int32_t>(out->size()),
+                 seq_counter_);
+  }
+  return st;
 }
 
 // ---------------------------------------------------------------------------
@@ -909,6 +928,17 @@ Status SocketController::BroadcastAbortAndFail(int culprit_rank,
     HVD_LOG(ERROR) << "broadcast ABORT to " << notified
                    << " survivors: " << msg;
     SetAbortReason(msg);
+    if (FlightOn()) {
+      FlightRecord(kFlightAbort, culprit_rank, 1);  // b=1: we broadcast it
+      // Forensics strictly AFTER the broadcast: survivors are already
+      // unblocked, so digest collection spends the abort budget on the
+      // coordinator alone and never widens the propagation bound.
+      if (!FlightPostmortemDir().empty()) {
+        CollectFlightDigests(MonotonicSeconds() + abort_timeout_s_);
+        WritePostmortem(culprit_rank, culprit_host, msg);
+      }
+      FlightDumpToFile();
+    }
   }
   return Status::Error(StatusCode::ABORTED, msg);
 }
@@ -939,6 +969,16 @@ Status SocketController::HandleAbortFrame(Reader* rd) {
            (host.empty() ? "?" : host) + ")";
   }
   SetAbortReason(msg);
+  if (FlightOn()) {
+    FlightRecord(kFlightAbort, culprit, 0);  // b=0: observed, not broadcast
+    // Answer the coordinator's forensics solicitation: last-N digest up
+    // the tree (leaders go direct), then relay any child digests, then
+    // drop this rank's own black box.  All best-effort — the ABORTED
+    // status below is already decided.
+    SendFlightDigest(tree_parent_.valid() ? tree_parent_ : coord_ctrl_);
+    ForwardChildDigests();
+    FlightDumpToFile();
+  }
   return Status::Error(StatusCode::ABORTED, msg);
 }
 
@@ -963,6 +1003,13 @@ Status SocketController::WorkerAbortHandshake() {
     // the thing that died, the direct path still attributes the failure.
     if (tree_parent_.valid()) tree_parent_.SendFrame(w.data());
     coord_ctrl_.SendFrame(w.data());  // best effort
+    if (FlightOn()) {
+      // The digest rides right behind the FIN on the same link: the
+      // coordinator's post-broadcast collection drains it from the
+      // already-open socket, so the culprit's own last events (the most
+      // valuable ones) make the postmortem too.
+      SendFlightDigest(tree_parent_.valid() ? tree_parent_ : coord_ctrl_);
+    }
   }
   // Drain the ctrl channels toward the coordinator's ABORT, bounded by the
   // propagation timeout.  Stale RESPONSES frames from the cycle in flight
@@ -1022,6 +1069,9 @@ Status SocketController::WorkerAbortHandshake() {
       " (no coordinator ABORT within " + std::to_string(abort_timeout_s_) +
       "s)";
   SetAbortReason(msg);
+  // No ABORT ever arrived — the coordinator may be the thing that died.
+  // Leave this rank's black box behind anyway.
+  if (FlightOn()) FlightDumpToFile();
   return Status::Error(StatusCode::ABORTED, msg);
 }
 
@@ -1081,12 +1131,281 @@ Status SocketController::CoordinatorAbortSweep() {
         break;
       }
       if (n_cached == -1) departed_ranks_.insert(rank);
+      // A digest racing the FIN (another rank noticed an ABORT first, or a
+      // leader forwarded a child's): stash it now, before the broadcast.
+      if (n_cached == -4) StashFlightDigest(&rd);
       // n_cached == -3 (a leader's aggregate from the cycle in flight) and
       // plain CYCLE frames are equally stale here: discard and keep polling.
     }
   }
   if (culprit < 0) why = "coordinator observed a local failure";
   return BroadcastAbortAndFail(culprit, why);
+}
+
+// ---------------------------------------------------------------------------
+// Abort-time forensics (flight recorder; flight_recorder.h)
+// ---------------------------------------------------------------------------
+
+void SocketController::SendFlightDigest(Socket& sock) {
+  if (digest_sent_ || !FlightOn() || !sock.valid()) return;
+  digest_sent_ = true;
+  std::vector<FlightEvent> tail;
+  FlightTail(kFlightDigestEvents, &tail);
+  Writer w;
+  w.PutI32(-4);  // digest sentinel in the cycle-frame position
+  w.PutI32(kTagFlightDigest);
+  w.PutI32(cfg_.rank);
+  w.PutI32(static_cast<int32_t>(tail.size()));
+  for (const auto& ev : tail) {
+    w.PutI64(ev.ts_us);
+    w.PutI64(static_cast<int64_t>(ev.seq));
+    w.PutI32(ev.type);
+    w.PutI32(ev.tid);
+    w.PutI32(ev.a);
+    w.PutI64(ev.b);
+  }
+  sock.SendFrame(w.data());  // best effort: forensics never block the abort
+}
+
+bool SocketController::StashFlightDigest(Reader* rd) {
+  const int32_t tag = rd->GetI32();
+  const int32_t rank = rd->GetI32();
+  const int32_t n = rd->GetI32();
+  if (!rd->ok() || tag != kTagFlightDigest || rank < 0 ||
+      rank >= cfg_.size || n < 0 || n > kFlightDigestEvents) {
+    return false;
+  }
+  std::vector<FlightEvent> evs;
+  evs.reserve(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i) {
+    FlightEvent ev;
+    ev.ts_us = rd->GetI64();
+    ev.seq = static_cast<uint64_t>(rd->GetI64());
+    ev.type = rd->GetI32();
+    ev.tid = rd->GetI32();
+    ev.a = rd->GetI32();
+    ev.b = rd->GetI64();
+    evs.push_back(ev);
+  }
+  if (!rd->ok()) return false;
+  if (FlightOn()) {
+    FlightRecord(kFlightDigest, rank, static_cast<int64_t>(evs.size()));
+  }
+  flight_digests_[rank] = std::move(evs);
+  return true;
+}
+
+void SocketController::CollectFlightDigests(double deadline) {
+  // Poll until the deadline or every reachable rank has reported.  A
+  // rank's digest may arrive on its LEADER's socket (forwarded verbatim
+  // by ForwardChildDigests), so completion counts ranks reported — never
+  // sockets drained — and a leader's socket stays in the poll set while
+  // any rank of its host is still outstanding, even after the leader's
+  // own digest landed.
+  auto leader_of = [&](int rank) -> int {
+    if (!tree_.on || rank >= static_cast<int>(host_keys_.size())) return -1;
+    for (int l : tree_.leaders) {
+      if (l < static_cast<int>(host_keys_.size()) &&
+          host_keys_[l] == host_keys_[rank]) {
+        return l;
+      }
+    }
+    return -1;
+  };
+  while (MonotonicSeconds() < deadline) {
+    std::set<int> poll_ranks;  // socket owners worth polling this round
+    int outstanding = 0;
+    for (int rank = 1; rank < cfg_.size; ++rank) {
+      if (departed_ranks_.count(rank) || flight_digests_.count(rank)) {
+        continue;
+      }
+      bool reachable = false;
+      if (ctrl_socks_[rank].valid()) {
+        poll_ranks.insert(rank);
+        reachable = true;
+      }
+      // Host-0 children (leader 0 = the coordinator itself) only have
+      // their direct sockets; remote children may report via their leader.
+      const int l = leader_of(rank);
+      if (l > 0 && l != rank && ctrl_socks_[l].valid()) {
+        poll_ranks.insert(l);
+        reachable = true;
+      }
+      if (reachable) ++outstanding;  // unreachable: don't charge budget
+    }
+    if (outstanding == 0 || poll_ranks.empty()) return;
+    std::vector<pollfd> pfds;
+    std::vector<int> ranks;
+    for (int rank : poll_ranks) {
+      pfds.push_back(pollfd{ctrl_socks_[rank].fd(), POLLIN, 0});
+      ranks.push_back(rank);
+    }
+    const double left = deadline - MonotonicSeconds();
+    const int wait_ms =
+        std::max(10, std::min(200, static_cast<int>(left * 1000)));
+    const int rc =
+        ::poll(pfds.data(), static_cast<nfds_t>(pfds.size()), wait_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return;
+    }
+    if (rc == 0) continue;
+    for (size_t i = 0; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      const int rank = ranks[i];
+      std::string frame;
+      if (!ctrl_socks_[rank].RecvFrame(&frame)) {
+        // The culprit (or another casualty) died before answering: close
+        // so the next poll round stops charging the budget to it.
+        ctrl_socks_[rank].Close();
+        continue;
+      }
+      Reader rd(frame);
+      const int32_t n = rd.GetI32();
+      if (n == -4) {
+        StashFlightDigest(&rd);
+      } else if (n == -1) {
+        departed_ranks_.insert(rank);
+      }
+      // Anything else (stale CYCLE/aggregate/FIN frames from the dying
+      // cycle) is discarded: the broadcast already went out.
+    }
+  }
+}
+
+void SocketController::ForwardChildDigests() {
+  if (tree_child_socks_.empty() || !coord_ctrl_.valid()) return;
+  // Children received the fanned-down ABORT moments ago and answer within
+  // milliseconds; cap the relay window well inside the abort budget so a
+  // mute child never delays this leader's own teardown.
+  const double deadline =
+      MonotonicSeconds() + std::min(0.5, abort_timeout_s_ * 0.25);
+  std::set<int> done;
+  while (MonotonicSeconds() < deadline) {
+    std::vector<pollfd> pfds;
+    std::vector<int> ranks;
+    for (auto& [rank, sock] : tree_child_socks_) {
+      if (done.count(rank) || tree_departed_children_.count(rank)) continue;
+      if (!sock.valid()) continue;
+      pfds.push_back(pollfd{sock.fd(), POLLIN, 0});
+      ranks.push_back(rank);
+    }
+    if (pfds.empty()) return;
+    const int rc = ::poll(pfds.data(), static_cast<nfds_t>(pfds.size()), 50);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return;
+    }
+    if (rc == 0) continue;
+    for (size_t i = 0; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      const int rank = ranks[i];
+      Socket* cs = TreeChildSock(rank);
+      std::string frame;
+      if (cs == nullptr || !cs->RecvFrame(&frame)) {
+        done.insert(rank);
+        continue;
+      }
+      Reader rd(frame);
+      if (rd.GetI32() == -4) {
+        coord_ctrl_.SendFrame(frame);  // verbatim relay, best effort
+        done.insert(rank);
+      }
+      // Stale frames (the child's in-flight CYCLE, an already-handled FIN)
+      // are discarded; keep waiting for its digest until the window ends.
+    }
+  }
+}
+
+void SocketController::WritePostmortem(int culprit_rank,
+                                       const std::string& culprit_host,
+                                       const std::string& why) {
+  const std::string dir = FlightPostmortemDir();
+  if (dir.empty()) return;
+  // The coordinator's own tail joins the collected digests so rank 0
+  // appears in the merged view like everyone else.
+  std::vector<FlightEvent> own;
+  FlightTail(kFlightDigestEvents, &own);
+  std::string out;
+  out.reserve(1 << 16);
+  out += "{\"schema\":\"hvd-postmortem-v1\"";
+  out += ",\"protocol_version\":" + std::to_string(kProtocolVersion);
+  out += ",\"world_size\":" + std::to_string(cfg_.size);
+  out += ",\"abort_wall_time\":" + std::to_string(WallSeconds());
+  out += ",\"culprit_rank\":" + std::to_string(culprit_rank);
+  out += ",\"culprit_host\":\"" + JsonEscape(culprit_host) + "\"";
+  out += ",\"reason\":\"" + JsonEscape(why) + "\"";
+  out += ",\"types\":";
+  out += FlightTypesLegend();
+  // Per-rank last-seen negotiation state from the v7 metrics snapshots —
+  // which cycle each rank had reached when it last reported.
+  {
+    std::lock_guard<std::mutex> l(metrics_mu_);
+    if (!cluster_.empty()) {
+      out += ",\"last_seen_cycles\":{";
+      bool first = true;
+      for (size_t r = 0; r < cluster_.size(); ++r) {
+        if (cluster_[r].updated_at == 0) continue;
+        if (!first) out += ",";
+        first = false;
+        out += "\"" + std::to_string(r) +
+               "\":" + std::to_string(cluster_[r].cycle_count);
+      }
+      out += "}";
+    }
+  }
+  out += ",\"ranks\":{";
+  auto emit_rank = [&](int rank, const char* source,
+                       const std::vector<FlightEvent>& evs, bool first) {
+    if (!first) out += ",";
+    std::string host =
+        rank < static_cast<int>(host_keys_.size()) ? host_keys_[rank] : "";
+    out += "\"" + std::to_string(rank) + "\":{\"source\":\"" + source +
+           "\",\"host\":\"" + JsonEscape(host) + "\"";
+    if (!evs.empty()) {
+      out += ",\"last_ts_us\":" + std::to_string(evs.back().ts_us);
+      out += ",\"last_seq\":" + std::to_string(evs.back().seq);
+    }
+    out += ",\"events\":[";
+    bool fe = true;
+    for (const auto& ev : evs) {
+      if (!fe) out += ",";
+      fe = false;
+      out += "[" + std::to_string(ev.ts_us) + "," + std::to_string(ev.seq) +
+             "," + std::to_string(ev.type) + "," + std::to_string(ev.tid) +
+             "," + std::to_string(ev.a) + "," + std::to_string(ev.b) + "]";
+    }
+    out += "]}";
+  };
+  emit_rank(cfg_.rank, "local", own, true);
+  std::vector<int> missing;
+  for (int rank = 1; rank < cfg_.size; ++rank) {
+    auto it = flight_digests_.find(rank);
+    if (it != flight_digests_.end()) {
+      emit_rank(rank, "digest", it->second, false);
+    } else if (!departed_ranks_.count(rank)) {
+      missing.push_back(rank);
+    }
+  }
+  out += "}";
+  out += ",\"missing_ranks\":[";
+  for (size_t i = 0; i < missing.size(); ++i) {
+    if (i) out += ",";
+    out += std::to_string(missing[i]);
+  }
+  out += "]}";
+  // tmp + rename: tooling polling the directory never reads a partial
+  // bundle (same contract as the per-rank flight dumps).
+  const std::string path = dir + "/postmortem.json";
+  const std::string tmp = path + ".tmp";
+  std::FILE* f = std::fopen(tmp.c_str(), "w");
+  if (f == nullptr) return;
+  std::fwrite(out.data(), 1, out.size(), f);
+  std::fclose(f);
+  std::rename(tmp.c_str(), path.c_str());
+  HVD_LOG(ERROR) << "postmortem bundle written: " << path << " ("
+                 << flight_digests_.size() << " digests, "
+                 << missing.size() << " missing)";
 }
 
 void SocketController::Announce(int rank, TensorRequest req,
@@ -1880,6 +2199,14 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
     w.PutI32(rank);
     w.PutString(rest);
   }
+  if (FlightOn()) {
+    // One aggregate frame per host per cycle: how many child frames this
+    // leader merged (its own included) and the bytes pushed upward.
+    FlightRecord(kFlightTreeAgg,
+                 static_cast<int32_t>(tree_.my_children.size() -
+                                      tree_departed_children_.size() + 1),
+                 static_cast<int64_t>(w.data().size()));
+  }
   CountCtrlSend(w.data().size());
   if (!coord_ctrl_.SendFrame(w.data())) {
     aborted_ = true;
@@ -1942,6 +2269,7 @@ void SocketController::CountCtrlSend(int64_t bytes) {
     m.ctrl_msgs_sent.fetch_add(1, std::memory_order_relaxed);
     m.ctrl_bytes_sent.fetch_add(bytes, std::memory_order_relaxed);
   }
+  if (FlightOn()) FlightRecord(kFlightCtrlSend, 0, bytes);
 }
 
 void SocketController::CountCtrlRecv(int64_t bytes) {
@@ -1952,6 +2280,7 @@ void SocketController::CountCtrlRecv(int64_t bytes) {
     m.ctrl_msgs_recv.fetch_add(1, std::memory_order_relaxed);
     m.ctrl_bytes_recv.fetch_add(bytes, std::memory_order_relaxed);
   }
+  if (FlightOn()) FlightRecord(kFlightCtrlRecv, 0, bytes);
 }
 
 void SocketController::UpdateCachesAndSeq(std::vector<Response>* responses) {
@@ -2155,6 +2484,7 @@ Status SocketController::ChunkedStep(
   }
   CountSend(send_to, send_len + hdr,
             (raw_len < 0 ? send_len : raw_len) + hdr);
+  if (FlightOn()) FlightRecord(kFlightRingHop, tag, send_len + hdr);
   const double hop_t0 = MetricsOn() ? MonotonicSeconds() : 0.0;
   ChunkExchangeError err;
   if (!ChunkedDuplexExchange(socks[send_to], send_base, send_len,
@@ -2346,6 +2676,10 @@ Status SocketController::CompressedRingAllreduce(
   if (codec == WireCodec::kNone) {
     return RingAllreduce(socks, buf, count, DataType::FLOAT32, op, members,
                          idx);
+  }
+  if (FlightOn()) {
+    FlightRecord(kFlightWireCodec, static_cast<int32_t>(codec),
+                 count * static_cast<int64_t>(sizeof(float)));
   }
   float* base = static_cast<float*>(buf);
   const int64_t chunk = count / m, rem = count % m;
@@ -2899,6 +3233,9 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
   // not plane bookkeeping.
   const double fence_t0 =
       tag_base >= kTagShmSize && MetricsOn() ? MonotonicSeconds() : 0.0;
+  if (FlightOn() && tag_base >= kTagShmSize) {
+    FlightRecord(kFlightShmFence, tag_base, 0);
+  }
   if (FaultInjectionOn()) {
     // shm-fence faults target the FENCE (not a specific peer socket):
     // drop/truncate close the next-neighbor link the first round uses, so

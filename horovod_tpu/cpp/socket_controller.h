@@ -38,6 +38,7 @@
 
 #include "common.h"
 #include "controller.h"
+#include "flight_recorder.h"
 #include "metrics.h"
 #include "response_cache.h"
 #include "shm_plane.h"
@@ -346,6 +347,30 @@ class SocketController : public Controller {
   // Parse the body of a [-2][kTagAbort]... frame (worker side): latches
   // the reason, observes propagation latency, returns the ABORTED status.
   Status HandleAbortFrame(Reader* rd);
+  // -- abort-time forensics (flight recorder; flight_recorder.h) ------------
+  // Worker: one [-4][kTagFlightDigest] frame carrying this rank's last-N
+  // flight events up `sock` (the coordinator link, or the tree parent for
+  // non-host-0 children — leaders forward child digests verbatim).  Sent
+  // at most once (digest_sent_), right after a FIN or on ABORT receipt, so
+  // forensics rides the existing abort exchange and never delays it.
+  void SendFlightDigest(Socket& sock);
+  // Coordinator: parse a digest frame body (tag already consumed) into
+  // flight_digests_; false = malformed (frame is dropped, never fatal).
+  bool StashFlightDigest(Reader* rd);
+  // Coordinator: after broadcasting ABORT, poll live ctrl sockets for
+  // digest frames until `deadline` (monotonic seconds) or every live rank
+  // reported — bounded by the abort-propagation budget.
+  void CollectFlightDigests(double deadline);
+  // Leader: briefly poll child ctrl links and forward any [-4] digest
+  // frames verbatim up the coordinator link (children of non-host-0
+  // leaders have no direct path for their digests).  Best-effort and
+  // bounded well inside the abort budget.
+  void ForwardChildDigests();
+  // Coordinator: merge own buffer + collected digests into
+  // <postmortem_dir>/postmortem.json naming the culprit and the causal
+  // event sequence.  No-op when HOROVOD_POSTMORTEM_DIR is unset.
+  void WritePostmortem(int culprit_rank, const std::string& culprit_host,
+                       const std::string& why);
   void Announce(int rank, TensorRequest req, std::vector<Response>* errors);
   void UpdateCachesAndSeq(std::vector<Response>* responses);
 
@@ -566,6 +591,10 @@ class SocketController : public Controller {
   bool fin_sent_ = false;           // worker failure FIN sent (send once)
   bool got_abort_ = false;          // coordinator's ABORT already received
   bool abort_broadcast_done_ = false;  // coordinator broadcast once
+  bool digest_sent_ = false;        // flight digest sent upward (send once)
+  // Coordinator: per-rank flight digests collected during the abort
+  // exchange (background thread only, like the other abort bools).
+  std::map<int, std::vector<FlightEvent>> flight_digests_;
   // HOROVOD_ABORT_PROPAGATION_TIMEOUT / HOROVOD_RENDEZVOUS_RETRIES /
   // HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS (ctor reads the env).
   double abort_timeout_s_ = 2.0;

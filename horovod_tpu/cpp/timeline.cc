@@ -108,6 +108,20 @@ void Timeline::Instant(const std::string& name) {
        ",\"pid\":0,\"tid\":0,\"s\":\"p\"}");
 }
 
+void Timeline::Instant(const std::string& name,
+                       const std::string& args_json) {
+  if (!enabled_) return;
+  if (args_json.empty()) {
+    Instant(name);
+    return;
+  }
+  // `args_json` is a complete JSON object literal the caller formed (the
+  // ABORT instant carries culprit rank/host for merge_timeline.py).
+  Emit("{\"name\":\"" + JsonEscape(name) +
+       "\",\"ph\":\"i\",\"ts\":" + std::to_string(NowUs()) +
+       ",\"pid\":0,\"tid\":0,\"s\":\"p\",\"args\":" + args_json + "}");
+}
+
 void Timeline::MarkCycle() {
   if (mark_cycles_) Instant("CYCLE");
 }

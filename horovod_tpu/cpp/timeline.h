@@ -27,6 +27,9 @@ class Timeline {
   void Begin(const std::string& tensor, const std::string& phase);
   void End(const std::string& tensor, const std::string& phase);
   void Instant(const std::string& name);
+  // Instant with a caller-formed JSON object as Chrome-trace "args" (the
+  // ABORT marker carries culprit metadata this way).
+  void Instant(const std::string& name, const std::string& args_json);
   void MarkCycle();
 
  private:

@@ -86,6 +86,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "coordinator handles O(hosts) messages instead of "
                         "O(ranks); auto engages on multi-host jobs with "
                         "np >= 8 (HOROVOD_CONTROL_TREE)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="crash-bundle directory: every rank dumps its "
+                        "flight-recorder ring there on abort or fatal "
+                        "signal, and the coordinator writes a merged "
+                        "postmortem.json naming the culprit; a literal "
+                        "{rank} in the path is substituted "
+                        "(HOROVOD_POSTMORTEM_DIR; render with "
+                        "tools/postmortem.py)")
+    p.add_argument("--no-flight-recorder", action="store_true",
+                   help="disable the always-on flight recorder "
+                        "(HOROVOD_FLIGHT_RECORDER=off)")
     p.add_argument("--fault-inject", default=None, metavar="SPEC",
                    help="deterministic fault injection for chaos testing: "
                         "comma-separated site:cycle:rank:action[:arg] rules "
@@ -144,6 +155,8 @@ def _apply_config_file(args: argparse.Namespace,
     flat["timeline_mark_cycles"] = tl.get("mark-cycles")
     mt = cfg.get("metrics") or {}
     flat["metrics_file"] = mt.get("file")
+    pm = cfg.get("postmortem") or {}
+    flat["postmortem_dir"] = pm.get("dir")
     at = cfg.get("autotune") or {}
     flat["autotune"] = at.get("enabled")
     flat["autotune_log_file"] = at.get("log-file")
@@ -194,6 +207,10 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_WIRE_COMPRESSION"] = args.wire_compression
     if args.control_tree:
         env["HOROVOD_CONTROL_TREE"] = args.control_tree
+    if args.postmortem_dir:
+        env["HOROVOD_POSTMORTEM_DIR"] = args.postmortem_dir
+    if args.no_flight_recorder:
+        env["HOROVOD_FLIGHT_RECORDER"] = "off"
     if args.fault_inject:
         env["HOROVOD_FAULT_INJECT"] = args.fault_inject
     if args.stall_check_disable:

@@ -265,6 +265,11 @@ class CoreBackend:
         for backends without the native registry."""
         return {}
 
+    def flight_record(self) -> dict:
+        """Snapshot of the flight-recorder event ring (always-on black
+        box); empty for backends without the native recorder."""
+        return {}
+
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError
 

@@ -157,6 +157,19 @@ class Config:
     metrics_enabled: bool = False
     metrics_file: Optional[str] = None
     metrics_interval_s: float = 10.0
+    # HOROVOD_FLIGHT_RECORDER: always-on lock-free event black box (ring
+    # buffer of compact binary events at the sites the metrics plane
+    # instruments).  On by default — the record cost is a few relaxed
+    # stores.  HOROVOD_FLIGHT_RECORDER_SLOTS sizes the per-thread ring
+    # (rounded up to a power of two).
+    flight_recorder_enabled: bool = True
+    flight_recorder_slots: int = 4096
+    # HOROVOD_POSTMORTEM_DIR: where each rank dumps its flight buffer on
+    # abort / fatal init error / fatal signal, and where the coordinator
+    # writes the merged postmortem.json.  "{rank}" is substituted like
+    # HOROVOD_METRICS_FILE.  Unset = crash dumps disabled (the in-memory
+    # recorder still runs for hvd.flight_record()).
+    postmortem_dir: Optional[str] = None
     log_level: str = "warning"
 
     # Stall inspector.
@@ -216,6 +229,10 @@ class Config:
             ),
             metrics_file=env.get("HOROVOD_METRICS_FILE"),
             metrics_interval_s=get_float("HOROVOD_METRICS_INTERVAL", 10.0),
+            flight_recorder_enabled=get_bool("HOROVOD_FLIGHT_RECORDER", True),
+            flight_recorder_slots=get_int("HOROVOD_FLIGHT_RECORDER_SLOTS",
+                                          4096),
+            postmortem_dir=env.get("HOROVOD_POSTMORTEM_DIR"),
             log_level=env.get("HOROVOD_LOG_LEVEL", "warning").lower(),
             stall_check_enabled=not get_bool("HOROVOD_STALL_CHECK_DISABLE", False),
             stall_warning_s=get_float(

@@ -1,0 +1,159 @@
+"""Cluster post-mortem end-to-end at np=4 over two fake hosts: an injected
+rank death must leave a complete crash bundle under HOROVOD_POSTMORTEM_DIR
+— the culprit's own flight-recorder dump (written before _exit) plus the
+coordinator's merged postmortem.json naming the culprit, with a pre-abort
+event digest from every surviving rank collected over the control plane —
+without stretching the v8 abort bound survivors already guarantee.  The
+flat (direct-to-coordinator) digest path and the v9 leader-tree relay path
+are both exercised, and tools/postmortem.py must render the bundle into a
+report plus a Perfetto trace.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import run
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ABORT_TIMEOUT_S = 2.0   # the documented default, pinned explicitly below
+BOUND_SLACK_S = 13.0    # failure detection + scheduling on a loaded box
+
+BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HOROVOD_HIER_FAKE_HOSTS": "2",
+    # TCP ring so ring-send sits on the hot path (fault site of the kill).
+    "HOROVOD_SHM_DISABLE": "1",
+    "HOROVOD_ABORT_PROPAGATION_TIMEOUT": str(ABORT_TIMEOUT_S),
+}
+
+
+def _collapse_worker(tmpdir: str):
+    """Allreduce until the injected fault collapses the job, then persist
+    what this rank observed (files, not return values: survivors must
+    outlive the launcher's SIGTERM to record their exception)."""
+    import signal
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    r = int(os.environ.get("HOROVOD_RANK", "-1"))
+    out = {"rank": r, "error": "", "elapsed": -1.0}
+    t0 = time.monotonic()
+    try:
+        hvd.init(build_mesh=False)
+        # The black box is queryable while healthy, too.
+        assert hvd.flight_record().get("rank") == r
+        for i in range(2000):
+            t0 = time.monotonic()
+            hvd.allreduce(np.full(1024, float(r), np.float32), op=hvd.Sum,
+                          name=f"pm.{i % 8}")
+    except HorovodInternalError as exc:
+        out["error"] = str(exc)
+        out["elapsed"] = time.monotonic() - t0
+    with open(os.path.join(tmpdir, f"rank{r}.json"), "w") as f:
+        json.dump(out, f)
+    try:
+        hvd.shutdown()
+    except Exception:
+        pass
+    return out
+
+
+def _collapse_and_collect(tmp_path, extra_env):
+    tmpdir = str(tmp_path)
+    pmdir = os.path.join(tmpdir, "bundle")
+    latch = os.path.join(tmpdir, "die.latch")
+    env = dict(BASE_ENV,
+               HOROVOD_FAULT_INJECT=f"ring-send:200:1:die:{latch}",
+               HOROVOD_POSTMORTEM_DIR=pmdir, **extra_env)
+    with pytest.raises(RuntimeError, match="rank 1"):
+        run(_collapse_worker, args=(tmpdir,), np=4, env=env)
+    assert os.path.exists(latch), "die action never fired"
+    # Forensics must not stretch the abort bound survivors already get.
+    # Workers are unblocked by the broadcast BEFORE digest collection; the
+    # coordinator's own raise may lag by at most one more timeout window.
+    for r in (0, 2, 3):
+        with open(os.path.join(tmpdir, f"rank{r}.json")) as f:
+            out = json.load(f)
+        assert out["error"] and "culprit rank 1" in out["error"], out
+        slack = BOUND_SLACK_S + (ABORT_TIMEOUT_S if r == 0 else 0)
+        assert 0 <= out["elapsed"] < ABORT_TIMEOUT_S + slack, out
+    pm_path = os.path.join(pmdir, "postmortem.json")
+    assert os.path.exists(pm_path), os.listdir(pmdir)
+    with open(pm_path) as f:
+        pm = json.load(f)
+    return pmdir, pm
+
+
+def _assert_complete(pm):
+    assert pm["schema"] == "hvd-postmortem-v1"
+    assert pm["world_size"] == 4
+    assert pm["culprit_rank"] == 1
+    assert pm["culprit_host"], pm  # attribution includes the host
+    assert "rank 1" in pm["reason"], pm
+    types = pm["types"]
+    # At least one pre-abort event from every surviving rank: something
+    # recorded in normal operation, not just the abort observation itself.
+    for r in (0, 2, 3):
+        rec = pm["ranks"][str(r)]
+        assert rec["events"], (r, pm)
+        names = {types.get(str(row[2])) for row in rec["events"]}
+        assert names - {"abort", "digest"}, (r, names)
+    assert pm["ranks"]["0"]["source"] == "local"
+    for r in (2, 3):
+        assert pm["ranks"][str(r)]["source"] == "digest"
+    # The dead culprit could not report a digest; it is accounted for, not
+    # silently absent.
+    assert pm["missing_ranks"] == [1], pm
+
+
+def test_injected_death_leaves_complete_postmortem(tmp_path):
+    """Flat control plane (auto stays flat at np=4): every survivor's
+    digest travels straight to the coordinator."""
+    pmdir, pm = _collapse_and_collect(tmp_path, {})
+    _assert_complete(pm)
+
+    # The culprit's full local dump — written before _exit(137) — is the
+    # one record of the death itself: its last events include the fault
+    # trip at the injected site.
+    flight1 = os.path.join(pmdir, "flight.1.json")
+    assert os.path.exists(flight1), os.listdir(pmdir)
+    with open(flight1) as f:
+        dump = json.load(f)
+    assert dump["rank"] == 1
+    names = {dump["types"].get(str(row[2])) for row in dump["events"]}
+    assert "fault_trip" in names, names
+
+    # The forensics tool renders the bundle and a Perfetto trace.
+    trace = os.path.join(str(tmp_path), "merged.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+         pmdir, "--trace", trace],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "rank 1" in proc.stdout and "culprit" in proc.stdout, proc.stdout
+    assert "fault_trip" in proc.stdout, proc.stdout
+    with open(trace) as f:
+        merged = json.load(f)
+    # All four ranks appear on the merged axis, the culprit included.
+    assert {e["pid"] for e in merged if e.get("ph") == "i"} == {0, 1, 2, 3}
+
+
+def test_postmortem_over_leader_tree(tmp_path):
+    """v9 leader tree forced on (auto stays flat below np=8): rank 3's
+    digest must be relayed through its host leader (rank 2) to the
+    coordinator — the tree is the collection path, not just the cycle
+    path."""
+    _, pm = _collapse_and_collect(tmp_path, {"HOROVOD_CONTROL_TREE": "on"})
+    _assert_complete(pm)

@@ -1,7 +1,10 @@
 """tools/merge_timeline.py on synthetic per-rank traces with a known
 clock offset: rank identity from CLOCK_SYNC, RENDEZVOUS-based alignment
 (with CLOCK_SYNC unix_us as the fallback), pid rewriting + Perfetto
-process metadata, and repair of a truncated (crashed-rank) trace.
+process metadata, repair of a truncated (crashed-rank) trace,
+flight-recorder dump ingestion as an additional rank track, and the
+ABORT instant's promotion to a cross-track (global-scope) marker with
+its culprit args intact.
 """
 
 import importlib.util
@@ -100,3 +103,69 @@ def test_metadata_sorting_and_truncated_trace_repair(tmp_path):
     # The whole merged list round-trips as plain JSON (Perfetto's loader
     # accepts a bare event array).
     json.loads(json.dumps(merged))
+
+
+def _flight_dump(rank, rows):
+    """A flight-recorder dump as FlightDumpToFile writes it."""
+    return {"rank": rank, "host": f"host-{rank}", "slots": 4096,
+            "dropped": 0,
+            "types": {"1": "ctrl_send", "2": "ctrl_recv", "5": "ring_hop",
+                      "11": "abort"},
+            "events": rows}
+
+
+def test_abort_instant_global_scope_with_culprit_args(tmp_path):
+    ev = _trace(0, 1000, 0, [(2000, 100)])
+    ev.append({"name": "ABORT", "ph": "i", "ts": 5000, "pid": 0, "tid": 0,
+               "s": "p", "args": {"reason": "rank 1 on host-b died"}})
+    p0 = _write(tmp_path, "t0.json", ev)
+    merged = mt.merge([p0])
+    abort = next(e for e in merged if e.get("name") == "ABORT")
+    assert abort["s"] == "g"  # drawn across every track
+    assert abort["args"]["reason"] == "rank 1 on host-b died"
+    assert abort["pid"] == 0
+
+
+def test_flight_dump_ingested_as_rank_track(tmp_path):
+    # A crash bundle (flight dump, wall-clock us rows) merged against a
+    # surviving rank's timeline: the dump's rows become named instants on
+    # its own rank track, aligned through the synthesized CLOCK_SYNC.
+    base_us = 9_000_000
+    p0 = _write(tmp_path, "t0.json",
+                _trace(0, 0, base_us, [(100, 50)],
+                       include_rendezvous=False))
+    rows = [[base_us + 4000, 17, 1, 0, 0, 256],
+            [base_us + 4500, 18, 5, 2, 3, 8192],
+            [base_us + 5000, 19, 11, 0, 1, 0]]
+    p1 = str(tmp_path / "flight.1.json")
+    with open(p1, "w") as f:
+        json.dump(_flight_dump(1, rows), f)
+    merged = mt.merge([p0, p1])
+    names = {e["pid"]: e["args"]["name"] for e in merged
+             if e.get("name") == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    flight = [e for e in merged if e["pid"] == 1 and e.get("ph") == "i"
+              and e.get("name") != "CLOCK_SYNC"]
+    assert [e["name"] for e in flight] == ["ctrl_send", "ring_hop", "abort"]
+    # Wall-clock alignment: rank 1's t0 (first event) is 4000us after rank
+    # 0's, so its first instant lands at ts 4000 on rank 0's axis.
+    assert [e["ts"] for e in flight] == [4000, 4500, 5000]
+    # Payload metadata rides through: seq and the a/b operands.
+    assert flight[1]["args"] == {"seq": 18, "a": 3, "b": 8192}
+    assert flight[1]["tid"] == 2
+
+
+def test_flight_dump_unknown_type_and_empty(tmp_path):
+    # Unknown event types render as flight:<n> instead of crashing, and an
+    # empty dump contributes nothing (no stray CLOCK_SYNC track).
+    rows = [[1000, 1, 99, 0, 0, 0]]
+    p = str(tmp_path / "flight.0.json")
+    with open(p, "w") as f:
+        json.dump(_flight_dump(0, rows), f)
+    merged = mt.merge([p])
+    assert any(e.get("name") == "flight:99" for e in merged)
+    pe = str(tmp_path / "flight.2.json")
+    with open(pe, "w") as f:
+        json.dump(_flight_dump(2, []), f)
+    merged = mt.merge([p, pe])
+    assert {e["pid"] for e in merged if e.get("ph") == "i"} == {0}

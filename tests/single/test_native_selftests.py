@@ -88,6 +88,32 @@ def test_chaos_selftest_under_ubsan():
     assert "runtime error" not in out, out
 
 
+def test_flight_selftest():
+    """Flight-recorder unit matrix: ring wraparound (oldest events evicted,
+    dropped counter), slot rounding to powers of two, multi-thread
+    interleave (global seq ordering across per-thread rings), JSON dump
+    shape, dump-on-fatal-signal (forked child SIGABRTs and leaves a
+    complete crash bundle), and test-reset isolation."""
+    _build_and_run("flight_selftest")
+
+
+def test_flight_selftest_under_tsan():
+    """Record from many threads while a dumper snapshots the rings; TSan
+    proves the relaxed-atomic slot protocol is data-race-free."""
+    out = _build_and_run("tsan_flight_selftest")
+    assert "ThreadSanitizer" not in out, out
+
+
+def test_flight_selftest_under_asan():
+    out = _build_and_run("asan_flight_selftest")
+    assert "AddressSanitizer" not in out, out
+
+
+def test_flight_selftest_under_ubsan():
+    out = _build_and_run("ubsan_flight_selftest")
+    assert "runtime error" not in out, out
+
+
 def test_ctrl_soak_selftest():
     """np=256 over 16 fake hosts, ctrl_only controllers: coordinator
     inbound control messages per cycle must drop O(n) -> O(hosts)

@@ -24,6 +24,13 @@ overhead against the metrics-off baseline (disabled is the baseline
 itself: every instrumentation site is behind one relaxed bool load, so
 disabled overhead is zero by construction).
 
+With --flight-recorder an additional section runs the cache_on
+configuration with HOROVOD_FLIGHT_RECORDER=off vs on — interleaved,
+best-of-3 per config, because loopback wall clock is noisier than the
+effect — and reports the always-on event black box's
+negotiation-throughput overhead (the bar is <= 1%: a record is a handful
+of relaxed atomic stores into a per-thread ring).
+
 With --np-sweep N,N,... the tool instead sweeps job sizes over fake
 multi-host topologies (4 ranks per fake host) and prints the O(n)-vs-
 O(hosts) table behind the v9 leader tree: coordinator inbound control
@@ -243,6 +250,10 @@ def main():
                     help="also measure the metrics registry's negotiation "
                          "overhead: cache_on rerun with HOROVOD_METRICS=1, "
                          "steps/s ratio vs the metrics-off baseline")
+    ap.add_argument("--flight-recorder", action="store_true",
+                    help="also measure the flight recorder's negotiation "
+                         "overhead: cache_on with the recorder off vs on, "
+                         "steps/s ratio (<= 1%% is the acceptance bar)")
     ap.add_argument("--np-sweep", default=None, metavar="N,N,...",
                     help="run ONLY the control-plane scaling sweep: "
                          "coordinator ctrl messages + bytes per cycle, "
@@ -287,6 +298,30 @@ def main():
         ratio = metrics_on["steps_per_s"] / max(cache_on["steps_per_s"], 1e-9)
         print(json.dumps({
             "metric": "metrics_overhead",
+            "steps_ratio_on_vs_off": round(ratio, 3),
+            "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
+        }), flush=True)
+
+    if args.flight_recorder:
+        # Loopback wall clock is scheduler-noise-dominated: one config's
+        # steps/s varies far more run-to-run than the <= 1% bar being
+        # measured.  Interleave the pair and keep the best of three — the
+        # fastest (least-perturbed) run per config bounds its true cost.
+        best_off = best_on = 0.0
+        for i in range(3):
+            flight_off = run_config(
+                f"cache_on_flight_off_r{i}",
+                {"HOROVOD_FLIGHT_RECORDER": "off"},
+                args.np, args.steps, args.tensors)
+            flight_on = run_config(
+                f"cache_on_flight_on_r{i}", {"HOROVOD_FLIGHT_RECORDER": "1"},
+                args.np, args.steps, args.tensors)
+            best_off = max(best_off, flight_off["steps_per_s"])
+            best_on = max(best_on, flight_on["steps_per_s"])
+        ratio = best_on / max(best_off, 1e-9)
+        print(json.dumps({
+            "metric": "flight_recorder_overhead",
+            "best_of": 3,
             "steps_ratio_on_vs_off": round(ratio, 3),
             "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
         }), flush=True)

@@ -48,7 +48,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # predates them (declared inside try/except, callers hasattr-guard):
 # the checker allows conditional declaration but still verifies types.
 OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
-                    "hvd_fault_spec_check", "hvd_ctrl_plane_stats"}
+                    "hvd_fault_spec_check", "hvd_ctrl_plane_stats",
+                    "hvd_flight_record"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where

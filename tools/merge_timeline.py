@@ -20,8 +20,16 @@ This tool puts all ranks on one time axis and one trace:
   metadata events make Perfetto label and order the tracks "rank N".
 - robustness: a trace cut off mid-write (rank crashed before Stop closed
   the array) is repaired by trimming to the last complete event.
+- flight-recorder dumps: an input that is a flight-recorder JSON object
+  (flight.<rank>.json crash bundles, or hvd.flight_record() saved to disk)
+  rather than a Chrome-trace array is converted into instants on its own
+  rank track, with the event-type legend resolved to names and a CLOCK_SYNC
+  anchor synthesized from the first event's wall-clock timestamp — so a
+  crash bundle merges onto the same axis as surviving ranks' timelines.
+- the ABORT instant (emitted with culprit metadata in args) is promoted to
+  a global-scope instant so Perfetto draws it across every track.
 
-Usage:  python tools/merge_timeline.py rank*.json -o merged.json
+Usage:  python tools/merge_timeline.py rank*.json flight.*.json -o merged.json
 """
 
 from __future__ import annotations
@@ -32,8 +40,36 @@ import sys
 from typing import List, Optional, Tuple
 
 
+def flight_to_events(dump: dict) -> List[dict]:
+    """Convert a flight-recorder dump into Chrome-trace instants.
+
+    Rows are [ts_us, seq, type, tid, a, b] with ts_us in wall-clock
+    microseconds; re-basing on the first event's ts and carrying it as a
+    CLOCK_SYNC anchor reuses the existing wall-clock alignment path, so the
+    dump lands on the merged axis without a RENDEZVOUS instant.
+    """
+    rank = dump.get("rank", -1)
+    types = dump.get("types") or {}
+    rows = [r for r in dump.get("events") or []
+            if isinstance(r, list) and len(r) >= 6]
+    if not rows:
+        return []
+    t0 = rows[0][0]
+    out = [{"name": "CLOCK_SYNC", "ph": "i", "ts": 0, "pid": 0, "tid": 0,
+            "s": "p", "args": {"rank": rank, "unix_us": t0, "flight": True}}]
+    for ts_us, seq, typ, tid, a, b in rows:
+        out.append({"name": types.get(str(typ), f"flight:{typ}"),
+                    "ph": "i", "ts": ts_us - t0, "pid": 0, "tid": tid,
+                    "s": "t", "args": {"seq": seq, "a": a, "b": b}})
+    return out
+
+
 def load_trace(path: str) -> List[dict]:
-    """Load one per-rank trace, repairing a truncated (crashed-rank) file."""
+    """Load one per-rank trace, repairing a truncated (crashed-rank) file.
+
+    A flight-recorder dump (JSON object with an "events" array of compact
+    rows) is accepted too and converted into instants on its rank's track.
+    """
     with open(path) as f:
         text = f.read()
     try:
@@ -46,6 +82,8 @@ def load_trace(path: str) -> List[dict]:
             body = body[1:]
         cut = body.rfind("}")
         events = json.loads("[" + body[: cut + 1] + "]") if cut >= 0 else []
+    if isinstance(events, dict) and "events" in events:
+        return flight_to_events(events)
     if not isinstance(events, list):
         raise ValueError(f"{path}: not a Chrome-trace JSON array")
     return [e for e in events if isinstance(e, dict)]
@@ -99,6 +137,9 @@ def merge(paths: List[str]) -> List[dict]:
             out["pid"] = rank
             if isinstance(out.get("ts"), (int, float)):
                 out["ts"] = out["ts"] + shift
+            if out.get("name") == "ABORT":
+                # Draw the abort (with its culprit args) across all tracks.
+                out["s"] = "g"
             merged.append(out)
     # Stable sort keeps each rank's B-before-E ordering at equal ts.
     merged.sort(key=lambda e: (e.get("ph") != "M",

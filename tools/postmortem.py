@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Render a Horovod-TPU crash bundle into a human-readable forensics report.
+
+Input is the directory named by HOROVOD_POSTMORTEM_DIR (or a path straight
+to its postmortem.json).  The bundle holds:
+
+  postmortem.json   the coordinator's merged view, written at abort time:
+                    culprit rank/host, abort reason, per-rank last-N-event
+                    digests collected over the control tree, last-seen
+                    negotiation cycles, and which ranks never reported
+  flight.<rank>.json  each rank's full flight-recorder ring, dumped locally
+                    on abort / fatal signal / injected death — including
+                    the culprit's, whose digest could not be collected
+                    (it was already dead)
+
+The report names the culprit, shows each rank's last-seen state, and prints
+the merged causal event sequence leading into the abort.  --trace also
+emits a Perfetto-loadable trace via tools/merge_timeline.py so the bundle
+can be read on one time axis next to any surviving ranks' timelines.
+
+Usage:
+    python tools/postmortem.py /path/to/postmortem-dir
+    python tools/postmortem.py bundle/postmortem.json --events 80
+    python tools/postmortem.py bundle/ --trace merged.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+
+def _load_merge_timeline():
+    spec = importlib.util.spec_from_file_location(
+        "merge_timeline",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "merge_timeline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def find_bundle(path: str) -> Dict[str, object]:
+    """Locate postmortem.json and any flight.<rank>.json dumps.
+
+    Returns {"postmortem": path-or-None, "flights": {rank: path}}.
+    """
+    if os.path.isdir(path):
+        directory = path
+        pm = os.path.join(path, "postmortem.json")
+    else:
+        directory = os.path.dirname(path) or "."
+        pm = path
+    flights: Dict[int, str] = {}
+    for f in sorted(glob.glob(os.path.join(directory, "flight.*.json"))):
+        m = re.match(r"flight\.(\d+)\.json$", os.path.basename(f))
+        if m:
+            flights[int(m.group(1))] = f
+    return {"postmortem": pm if os.path.exists(pm) else None,
+            "flights": flights}
+
+
+def _fmt_event(row: List[int], types: Dict[str, str],
+               abort_us: Optional[int]) -> str:
+    ts_us, seq, typ, tid, a, b = row[:6]
+    name = types.get(str(typ), f"type{typ}")
+    rel = "" if abort_us is None else f"{(ts_us - abort_us) / 1e3:+10.1f}ms "
+    return f"{rel}seq={seq:<8} {name:<14} tid={tid} a={a} b={b}"
+
+
+def report(bundle: Dict[str, object], n_events: int,
+           out=sys.stdout) -> int:
+    pm_path = bundle["postmortem"]
+    flights: Dict[int, str] = bundle["flights"]  # type: ignore[assignment]
+    if pm_path is None and not flights:
+        print("error: no postmortem.json or flight.*.json found",
+              file=sys.stderr)
+        return 1
+
+    pm = {}
+    if pm_path is not None:
+        with open(pm_path) as f:
+            pm = json.load(f)
+
+    types: Dict[str, str] = pm.get("types") or {}
+    ranks: Dict[str, dict] = dict(pm.get("ranks") or {})
+    culprit = pm.get("culprit_rank", -1)
+
+    # Fold in full local dumps: they supersede a 128-event digest and are
+    # the only record of the culprit (dead before digest collection).
+    for rank, path in flights.items():
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        types = types or dump.get("types") or {}
+        rec = ranks.setdefault(str(rank), {})
+        rec["source"] = (rec.get("source", "") + "+dump").lstrip("+")
+        rec.setdefault("host", dump.get("host", ""))
+        rec["events"] = dump.get("events") or rec.get("events") or []
+        rec["dropped"] = dump.get("dropped", 0)
+
+    print("=" * 72, file=out)
+    print("Horovod-TPU post-mortem", file=out)
+    print("=" * 72, file=out)
+    if pm:
+        print(f"schema          : {pm.get('schema', '?')} "
+              f"(protocol v{pm.get('protocol_version', '?')})", file=out)
+        print(f"world size      : {pm.get('world_size', '?')}", file=out)
+        print(f"culprit         : rank {culprit} "
+              f"on {pm.get('culprit_host') or '?'}", file=out)
+        print(f"reason          : {pm.get('reason', '?')}", file=out)
+    missing = set(pm.get("missing_ranks") or [])
+    cycles = pm.get("last_seen_cycles") or {}
+
+    print("\nPer-rank state", file=out)
+    print("-" * 72, file=out)
+    all_ranks = sorted({int(r) for r in ranks} | missing | {
+        int(r) for r in cycles})
+    for rank in all_ranks:
+        rec = ranks.get(str(rank), {})
+        mark = " <- culprit" if rank == culprit else ""
+        if not rec and rank in missing:
+            print(f"  rank {rank:<3} MISSING (no digest, no dump; last "
+                  f"cycle {cycles.get(str(rank), '?')}){mark}", file=out)
+            continue
+        evs = rec.get("events") or []
+        last = (_fmt_event(evs[-1], types, None).strip() if evs
+                else "no events")
+        print(f"  rank {rank:<3} source={rec.get('source', '?'):<12} "
+              f"host={rec.get('host') or '?':<12} "
+              f"cycle={cycles.get(str(rank), '?'):<6} "
+              f"events={len(evs):<4} last: {last}{mark}", file=out)
+
+    # Causal sequence: everything merged on the wall clock, tail-first cut.
+    merged = []
+    for rank_str, rec in ranks.items():
+        for row in rec.get("events") or []:
+            if isinstance(row, list) and len(row) >= 6:
+                merged.append((row[0], int(rank_str), row))
+    merged.sort(key=lambda t: (t[0], t[2][1]))
+    abort_us = None
+    for ts_us, _, row in merged:
+        if types.get(str(row[2])) == "abort":
+            abort_us = ts_us
+            break
+    tail = merged[-n_events:]
+    print(f"\nCausal event sequence (last {len(tail)} of {len(merged)}, "
+          "relative to first abort observation)", file=out)
+    print("-" * 72, file=out)
+    for ts_us, rank, row in tail:
+        print(f"  rank {rank:<3} {_fmt_event(row, types, abort_us)}",
+              file=out)
+    if pm:
+        print(f"\nmissing ranks   : {sorted(missing) or 'none'}", file=out)
+    return 0
+
+
+def write_trace(bundle: Dict[str, object], out_path: str) -> None:
+    """Emit a Perfetto trace through merge_timeline's flight ingestion.
+
+    Each rank record is re-shaped into a flight-dump object (the format
+    merge_timeline.load_trace detects) so digests and full dumps ride the
+    same alignment path as timeline files.
+    """
+    import tempfile
+
+    mt = _load_merge_timeline()
+    pm_path = bundle["postmortem"]
+    flights: Dict[int, str] = bundle["flights"]  # type: ignore[assignment]
+    paths: List[str] = []
+    tmpdir = tempfile.mkdtemp(prefix="hvd_postmortem_")
+    if pm_path is not None:
+        with open(pm_path) as f:
+            pm = json.load(f)
+        for rank_str, rec in (pm.get("ranks") or {}).items():
+            if int(rank_str) in flights:
+                continue  # the full dump supersedes the digest
+            dump = {"rank": int(rank_str), "host": rec.get("host", ""),
+                    "types": pm.get("types") or {},
+                    "events": rec.get("events") or []}
+            p = os.path.join(tmpdir, f"digest.{rank_str}.json")
+            with open(p, "w") as f:
+                json.dump(dump, f)
+            paths.append(p)
+    paths.extend(flights[r] for r in sorted(flights))
+    if not paths:
+        print("no events to trace", file=sys.stderr)
+        return
+    merged = mt.merge(paths)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    print(f"wrote {out_path}: {len(merged)} events", file=sys.stderr)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("bundle", help="postmortem directory or postmortem.json")
+    p.add_argument("--events", type=int, default=40,
+                   help="causal-sequence tail length (default 40)")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="also write a Perfetto-loadable merged trace")
+    args = p.parse_args(argv)
+    bundle = find_bundle(args.bundle)
+    rc = report(bundle, args.events)
+    if rc == 0 and args.trace:
+        write_trace(bundle, args.trace)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,13 +27,19 @@ combination of:
            tree) — "on" combos run over fake hosts since auto stays flat
            below np=8; one on-combo in the quick set, the rest (plus a
            single-host demotion row) full only
+- flight:  def (ambient default) / on / off (HOROVOD_FLIGHT_RECORDER) —
+           "on" combos assert the black box recorded the workload
+           (hvd.flight_record() non-empty, right rank), "off" combos that
+           it reports {}; one on-combo in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
 contract, both sets), and — full set only — the ASan/UBSan selftest
 builds, the `chaos` fault-injection/fast-abort selftest, the np=4
 fault-injection pytest (`fault-np4`: abort bound, corrupt-tag fail-fast,
-elastic recovery under --fault-inject), the np=256 control-plane soak
+elastic recovery under --fault-inject), the np=4 chaos-postmortem pytest
+(`postmortem-np4`: injected death -> merged postmortem.json with the right
+culprit within the abort bound), the np=256 control-plane soak
 (`ctrl-soak`: flat vs tree coordinator message counts), and the np=8
 tree-vs-flat parity pytest (`ctrl-np8`).
 
@@ -133,6 +139,17 @@ WORKLOAD = textwrap.dedent("""
     np.testing.assert_allclose(hvd.allreduce(big, op=hvd.Sum, name="m.wire"),
                                wexp, **wtol)
 
+    # flight axis: the always-on black box must have recorded the work
+    # (ctrl frames exist at np>1 only; np=1 has no socket control plane).
+    fl = os.environ.get("HOROVOD_FLIGHT_RECORDER", "")
+    if fl == "1" and s > 1:
+        fr = hvd.flight_record()
+        assert fr.get("events"), fr
+        assert fr.get("rank") == r, fr
+        assert fr.get("types"), fr
+    elif fl == "off":
+        assert hvd.flight_record() == {}, "recorder off but ring non-empty"
+
     # metrics axis: the registry must have seen the work done above.
     if os.environ.get("HOROVOD_METRICS") == "1":
         m = hvd.metrics()
@@ -231,6 +248,9 @@ def combos(quick: bool):
         yield ("jax", "native", 3, "on", "off", "hier", "int8", "off")
         # ctrl_tree axis: the one quick on-combo (2 fake hosts via hier).
         yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "on")
+        # flight axis: the one quick recorder-on combo.
+        yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+               "on")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -266,6 +286,16 @@ def combos(quick: bool):
     yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off", "on")
     yield ("jax", "native", 3, "on", "on", "tcp", "none", "off", "on")
     yield ("torch", "native", 3, "on", "on", "hier", "none", "off", "on")
+    # Flight-recorder axis: explicit on (black box populated) across plane
+    # shapes including the v9 tree, and explicit off (flight_record == {}).
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+           "on")
+    yield ("jax", "native", 3, "off", "off", "tcp", "none", "on", "auto",
+           "on")
+    yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "on",
+           "on")
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+           "off")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -316,6 +346,13 @@ def checks(quick: bool):
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_fault_injection.py")]],
            REPO, 600.0)
+    # Chaos-postmortem: an injected rank death must leave a complete
+    # merged postmortem.json (right culprit, a pre-abort digest from every
+    # survivor) without stretching the abort bound.
+    yield ("postmortem-np4",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_postmortem.py")]],
+           REPO, 600.0)
     # np=256 in-process control-plane soak: flat vs v9 tree coordinator
     # message counts (>= 8x cut at 256 ranks / 16 fake hosts) plus the
     # sharded rendezvous acceptors under the full HELLO herd.
@@ -346,8 +383,8 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
-              plane: str, wire: str, metrics: str, tree: str, script: str,
-              timeout: float) -> tuple:
+              plane: str, wire: str, metrics: str, tree: str, flight: str,
+              script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -369,6 +406,11 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     env.pop("HOROVOD_FAULT_INJECT", None)
     # The ctrl_tree axis owns the control-plane topology knob.
     env.pop("HOROVOD_CONTROL_TREE", None)
+    # The flight axis owns the recorder knobs; an ambient postmortem dir
+    # would scatter crash bundles on every combo failure.
+    env.pop("HOROVOD_FLIGHT_RECORDER", None)
+    env.pop("HOROVOD_FLIGHT_RECORDER_SLOTS", None)
+    env.pop("HOROVOD_POSTMORTEM_DIR", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -392,6 +434,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_METRICS"] = "1"
     if tree != "auto":
         env["HOROVOD_CONTROL_TREE"] = tree
+    if flight == "on":
+        env["HOROVOD_FLIGHT_RECORDER"] = "1"
+    elif flight == "off":
+        env["HOROVOD_FLIGHT_RECORDER"] = "off"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -435,13 +481,16 @@ def main() -> int:
         for combo in combos(args.quick):
             if len(combo) == 8:  # rows predating the ctrl_tree axis
                 combo = combo + ("auto",)
+            if len(combo) == 9:  # rows predating the flight axis
+                combo = combo + ("def",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree) = combo
+             tree, flight) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
-                     f"wire={wire:<4} metrics={metrics:<3} tree={tree}")
+                     f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
+                     f"flight={flight}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
-                                       wire, metrics, tree,
+                                       wire, metrics, tree, flight,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

@@ -598,6 +598,9 @@ def main() -> None:
                         "note", f"truncated: child exited rc={rc} during an "
                                 "appendix phase; headline is complete")
                 _save_last_good(run.result)
+                # Provenance bit mirrored on the cached-serve path ("live":
+                # false there): these numbers WERE measured this invocation.
+                run.result.setdefault("live", True)
                 _finish(run.result, errf)
                 return
 
@@ -643,6 +646,10 @@ def main() -> None:
             try:
                 res = dict(cached["result"])
                 res["source"] = "cached"
+                # Machine-checkable honesty bit: downstream BENCH_*.json
+                # consumers must not have to string-match "source" to learn
+                # these numbers were NOT measured by this invocation.
+                res["live"] = False
                 res["cached_at"] = cached.get("recorded_at")
                 rec_unix = cached.get("recorded_at_unix")
                 if isinstance(rec_unix, (int, float)) and rec_unix > 0:
@@ -661,6 +668,13 @@ def main() -> None:
             except Exception as exc:
                 _log(f"cache serve failed: {exc}")
             else:
+                # Loud, not silent: the one place a reader of the console
+                # (rather than the JSON) learns the tunnel was down.
+                print("bench.py: WARNING: TPU tunnel down this invocation; "
+                      "serving the last successful on-chip measurement "
+                      f"(recorded {res.get('cached_at', 'unknown')}, "
+                      "\"live\": false in the result JSON)",
+                      file=sys.stderr, flush=True)
                 _finish(res, errf)
                 return
 
@@ -669,6 +683,7 @@ def main() -> None:
             "value": 0.0,
             "unit": "images/sec/chip",
             "vs_baseline": 0.0,
+            "live": False,
             "error": last_err[-800:],
             "note": "TPU backend unreachable this run; PERF.md records the "
                     "last successful on-chip measurements and methodology",

@@ -69,6 +69,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
         c.c_int, c.c_int, c.c_char_p,              # flight_on flight_slots postmortem_dir
+        c.c_int,                                   # autopilot_port (0 = off)
     ]
     lib.hvd_shutdown.restype = c.c_int
     lib.hvd_is_initialized.restype = c.c_int
@@ -110,6 +111,15 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_free.argtypes = [c.c_void_p]
     lib.hvd_add_process_set.restype = c.c_int
     lib.hvd_add_process_set.argtypes = [c.POINTER(c.c_int), c.c_int]
+    try:
+        # Old-ABI tolerance: a stale .so predating QoS process-set weights
+        # loses the weighted registration path; add_process_set(weight=...)
+        # then falls back to the unweighted symbol (weight 1.0).
+        lib.hvd_add_process_set2.restype = c.c_int
+        lib.hvd_add_process_set2.argtypes = [
+            c.POINTER(c.c_int), c.c_int, c.c_double]
+    except AttributeError:
+        pass
     lib.hvd_remove_process_set.restype = c.c_int
     lib.hvd_remove_process_set.argtypes = [c.c_int]
     lib.hvd_process_set_ranks.restype = c.c_int
@@ -219,6 +229,7 @@ class NativeCore(CoreBackend):
             1 if cfg.flight_recorder_enabled else 0,
             cfg.flight_recorder_slots,
             (cfg.postmortem_dir or "").encode(),
+            cfg.autopilot_port,
         )
         if rc != 0:
             raise NativeCoreError(
@@ -295,9 +306,14 @@ class NativeCore(CoreBackend):
         return getattr(self._seq_tls, "seq", -1)
 
     # -- process sets -------------------------------------------------------
-    def add_process_set(self, ranks: Sequence[int]) -> int:
+    def add_process_set(self, ranks: Sequence[int],
+                        weight: float = 1.0) -> int:
         arr = (ctypes.c_int * len(ranks))(*[int(r) for r in ranks])
-        psid = self._lib.hvd_add_process_set(arr, len(ranks))
+        if weight != 1.0 and hasattr(self._lib, "hvd_add_process_set2"):
+            psid = self._lib.hvd_add_process_set2(arr, len(ranks),
+                                                  float(weight))
+        else:
+            psid = self._lib.hvd_add_process_set(arr, len(ranks))
         if psid < 0:
             raise NativeCoreError("add_process_set failed")
         return psid

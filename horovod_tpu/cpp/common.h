@@ -186,6 +186,11 @@ struct CoreConfig {
   double stall_warn_s = 60.0;
   double stall_shutdown_s = 0.0;
   int log_level = 2;  // 0=trace .. 5=fatal
+  // HOROVOD_AUTOPILOT_PORT (driver-internal): when > 0 the coordinator
+  // opens a driver-facing policy listener on this port serving the live
+  // cluster view (straggler windows, counters) and accepting autopilot
+  // decision records.  0 disables — the default, costing nothing.
+  int autopilot_port = 0;
   // C++-selftest-only (never ABI-exposed): skip the O(n^2) data-plane mesh,
   // shm, and hierarchical setup so in-process control-plane soaks can run
   // hundreds of ranks within fd/time budgets.  Data-plane ops are invalid

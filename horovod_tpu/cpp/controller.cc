@@ -20,12 +20,20 @@ void ProcessSetTable::InitGlobal(int world_size) {
 }
 
 int ProcessSetTable::Add(const std::vector<int>& ranks) {
+  return AddWeighted(ranks, 1.0);
+}
+
+int ProcessSetTable::AddWeighted(const std::vector<int>& ranks,
+                                 double weight) {
   std::lock_guard<std::mutex> l(mu_);
   std::vector<int> sorted = ranks;
   std::sort(sorted.begin(), sorted.end());
   sorted.erase(std::unique(sorted.begin(), sorted.end()), sorted.end());
   int id = next_id_++;
   sets_[id] = sorted;
+  // Clamp: a zero/negative weight would let the scheduler starve the set
+  // outright, which is a deadlock (its members still block on the ring).
+  weights_[id] = weight > 0.0 ? weight : 1.0;
   return id;
 }
 
@@ -33,6 +41,7 @@ void ProcessSetTable::Remove(int id) {
   if (id == 0) return;
   std::lock_guard<std::mutex> l(mu_);
   sets_.erase(id);
+  weights_.erase(id);
 }
 
 bool ProcessSetTable::Ranks(int id, std::vector<int>* out) const {
@@ -48,6 +57,13 @@ bool ProcessSetTable::Contains(int id, int rank) const {
   auto it = sets_.find(id);
   if (it == sets_.end()) return false;
   return std::binary_search(it->second.begin(), it->second.end(), rank);
+}
+
+double ProcessSetTable::Weight(int id) const {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = weights_.find(id);
+  // Set 0 (the global set) and any pre-weight registration stay at 1.0.
+  return it == weights_.end() ? 1.0 : it->second;
 }
 
 // ---- Fusion ---------------------------------------------------------------

@@ -79,13 +79,19 @@ class ProcessSetTable {
  public:
   void InitGlobal(int world_size);
   int Add(const std::vector<int>& ranks);
+  // QoS variant: `weight` orders fused-response scheduling on the
+  // coordinator (higher first; the global set is pinned at 1.0).  The
+  // plain Add defaults every set to weight 1.0.
+  int AddWeighted(const std::vector<int>& ranks, double weight);
   void Remove(int id);
   bool Ranks(int id, std::vector<int>* out) const;
   bool Contains(int id, int rank) const;
+  double Weight(int id) const;
 
  private:
   mutable std::mutex mu_;
   std::map<int, std::vector<int>> sets_;
+  std::map<int, double> weights_;
   int next_id_ = 1;
 };
 

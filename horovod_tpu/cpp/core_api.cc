@@ -272,8 +272,12 @@ void BackgroundLoop() {
         if (MetricsOn()) {
           // Same span the timeline's NEGOTIATE B/E pair measures, so the
           // registry total and the trace agree.
-          GlobalMetrics().negotiation_wait_us.ObserveSeconds(
-              MonotonicSeconds() - it->second.enqueued_at);
+          const int64_t wait_us = static_cast<int64_t>(
+              (MonotonicSeconds() - it->second.enqueued_at) * 1e6);
+          GlobalMetrics().negotiation_wait_us.ObserveUs(wait_us);
+          // Per-tenant latency: the same wait attributed to the response's
+          // process set, the QoS scheduling signal hvd.metrics() exposes.
+          GlobalMetrics().RecordTenantWaitUs(r.process_set_id, wait_us);
         }
         g->outstanding.erase(it);
         g->timeline.End(name, "NEGOTIATE");
@@ -312,6 +316,10 @@ void BackgroundLoop() {
           mreg.tensors_fused_total.fetch_add(
               static_cast<int64_t>(r.metas.size()), std::memory_order_relaxed);
           mreg.bytes_fused_total.fetch_add(rbytes, std::memory_order_relaxed);
+          // The same counters, attributed to the response's process set —
+          // the per-tenant baseline the QoS accounting reports against.
+          mreg.RecordTenant(r.process_set_id,
+                            static_cast<int64_t>(r.metas.size()), rbytes);
         }
         DeliverResponse(r);
       }
@@ -417,7 +425,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              double metrics_interval_s, const char* timeline_path,
              int timeline_mark_cycles, double stall_warn_s,
              double stall_shutdown_s, int log_level, int flight_enabled,
-             int flight_slots, const char* postmortem_dir) {
+             int flight_slots, const char* postmortem_dir,
+             int autopilot_port) {
   if (g != nullptr) return -1;
   SetInitError("");  // a fresh attempt must not inherit a stale reason
   g = new GlobalState();
@@ -444,6 +453,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.timeline_mark_cycles = timeline_mark_cycles != 0;
   cfg.stall_warn_s = stall_warn_s;
   cfg.stall_shutdown_s = stall_shutdown_s;
+  cfg.autopilot_port = autopilot_port > 0 ? autopilot_port : 0;
   SetLogLevel(log_level);
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
   g->fusion_threshold.store(fusion);
@@ -487,6 +497,19 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
 
   if (cfg.size > 1 || cfg.controller == "socket") {
     g->controller = std::make_unique<SocketController>(cfg);
+    // Autopilot decisions accepted on the policy channel land on the
+    // timeline as instants (the flight/metrics records happen inside the
+    // controller).  Installed before Initialize starts the serve thread.
+    static_cast<SocketController*>(g->controller.get())
+        ->SetAutopilotDecisionHook(
+            [](int action, int rank, const std::string& detail) {
+              if (g == nullptr) return;
+              g->timeline.Instant(
+                  "AUTOPILOT", "{\"action\":" + std::to_string(action) +
+                                   ",\"rank\":" + std::to_string(rank) +
+                                   ",\"detail\":\"" + JsonEscape(detail) +
+                                   "\"}");
+            });
   } else {
     g->controller = std::make_unique<LocalController>(cfg);
   }
@@ -736,6 +759,23 @@ int hvd_add_process_set(const int* ranks, int n) {
   if (!s.ok()) {
     // EstablishChannel can fail after the channel sockets were inserted
     // (the shm handshake runs last): close them too.
+    g->controller->RemoveChannel(id);
+    g->controller->process_sets().Remove(id);
+    SetLastError("process set channel establishment failed: " + s.reason);
+    return -4;
+  }
+  return id;
+}
+
+// QoS variant: `weight` orders the coordinator's fused-response schedule
+// (higher weight first; the global set is pinned at 1.0).  The unweighted
+// export above keeps its ABI for older callers.
+int hvd_add_process_set2(const int* ranks, int n, double weight) {
+  if (g == nullptr) return -1;
+  std::vector<int> v(ranks, ranks + n);
+  int id = g->controller->process_sets().AddWeighted(v, weight);
+  Status s = g->controller->EstablishChannel(id);
+  if (!s.ok()) {
     g->controller->RemoveChannel(id);
     g->controller->process_sets().Remove(id);
     SetLastError("process set channel establishment failed: " + s.reason);

@@ -38,6 +38,7 @@ enum FlightType : int32_t {
   kFlightFaultTrip = 10,  // a = fault site,   b = action
   kFlightAbort = 11,      // a = culprit rank, b = 0 observed / 1 broadcast
   kFlightDigest = 12,     // a = source rank,  b = events carried
+  kFlightAutopilot = 13,  // a = action code,  b = target rank
 };
 
 struct FlightEvent {

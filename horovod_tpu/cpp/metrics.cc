@@ -33,6 +33,19 @@ std::string Histogram::Json() const {
   return os.str();
 }
 
+void MetricsRegistry::RecordTenant(int psid, int64_t tensors, int64_t bytes) {
+  std::lock_guard<std::mutex> l(tenants_mu_);
+  TenantStats& t = tenants_[psid];
+  t.responses += 1;
+  t.tensors += tensors;
+  t.bytes += bytes;
+}
+
+void MetricsRegistry::RecordTenantWaitUs(int psid, int64_t wait_us) {
+  std::lock_guard<std::mutex> l(tenants_mu_);
+  tenants_[psid].negotiation_wait_us.ObserveUs(wait_us);
+}
+
 void MetricsRegistry::Reset() {
   cycle_count.store(0, std::memory_order_relaxed);
   cycle_busy_us.store(0, std::memory_order_relaxed);
@@ -44,6 +57,7 @@ void MetricsRegistry::Reset() {
   straggler_reports_total.store(0, std::memory_order_relaxed);
   aborts_total.store(0, std::memory_order_relaxed);
   faults_injected_total.store(0, std::memory_order_relaxed);
+  autopilot_decisions_total.store(0, std::memory_order_relaxed);
   ctrl_msgs_sent.store(0, std::memory_order_relaxed);
   ctrl_msgs_recv.store(0, std::memory_order_relaxed);
   ctrl_bytes_sent.store(0, std::memory_order_relaxed);
@@ -52,6 +66,10 @@ void MetricsRegistry::Reset() {
   ring_hop_us.Reset();
   shm_fence_us.Reset();
   abort_propagation_us.Reset();
+  {
+    std::lock_guard<std::mutex> l(tenants_mu_);
+    tenants_.clear();
+  }
 }
 
 std::string MetricsRegistry::DumpJson(int rank,
@@ -76,6 +94,8 @@ std::string MetricsRegistry::DumpJson(int rank,
      << ",\"aborts_total\":" << aborts_total.load(std::memory_order_relaxed)
      << ",\"faults_injected_total\":"
      << faults_injected_total.load(std::memory_order_relaxed)
+     << ",\"autopilot_decisions_total\":"
+     << autopilot_decisions_total.load(std::memory_order_relaxed)
      << ",\"ctrl_msgs_sent\":"
      << ctrl_msgs_sent.load(std::memory_order_relaxed)
      << ",\"ctrl_msgs_recv\":"
@@ -89,6 +109,23 @@ std::string MetricsRegistry::DumpJson(int rank,
      << ",\"ring_hop_us\":" << ring_hop_us.Json()
      << ",\"shm_fence_us\":" << shm_fence_us.Json()
      << ",\"abort_propagation_us\":" << abort_propagation_us.Json() << "}";
+  {
+    // Per-tenant (process-set) accounting, keyed by psid.  Rendered even
+    // when empty so consumers need no presence check.
+    std::lock_guard<std::mutex> l(tenants_mu_);
+    os << ",\"tenants\":{";
+    bool first = true;
+    for (const auto& kv : tenants_) {
+      if (!first) os << ',';
+      first = false;
+      os << "\"" << kv.first << "\":{\"responses\":" << kv.second.responses
+         << ",\"tensors\":" << kv.second.tensors
+         << ",\"bytes\":" << kv.second.bytes
+         << ",\"negotiation_wait_us\":" << kv.second.negotiation_wait_us.Json()
+         << "}";
+    }
+    os << "}";
+  }
   if (!extra_json.empty()) os << ',' << extra_json;
   os << "}";
   return os.str();

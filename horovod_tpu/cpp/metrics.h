@@ -8,6 +8,8 @@
 
 #include <atomic>
 #include <cstdint>
+#include <map>
+#include <mutex>
 #include <string>
 
 namespace hvdtpu {
@@ -77,6 +79,10 @@ struct MetricsRegistry {
   std::atomic<int64_t> aborts_total{0};
   std::atomic<int64_t> faults_injected_total{0};
 
+  // Fleet-autopilot decisions recorded on the coordinator's policy
+  // channel (evict / scale / readmit), regardless of driver outcome.
+  std::atomic<int64_t> autopilot_decisions_total{0};
+
   // Control-plane traffic (protocol v9): negotiation frames and payload
   // bytes moved on this rank's ctrl links.  On the coordinator,
   // ctrl_msgs_recv per cycle is the leader-tree acceptance metric —
@@ -92,6 +98,20 @@ struct MetricsRegistry {
   Histogram shm_fence_us;         // shm/hier dissemination-barrier fences
   Histogram abort_propagation_us;  // coordinator ABORT send -> worker observe
 
+  // Per-tenant (process-set) fused-response accounting.  Tenants are a
+  // cold, small map (one entry per registered process set), so a plain
+  // mutex is fine: the record site runs once per delivered response, not
+  // per ring hop, and only when MetricsOn().
+  struct TenantStats {
+    int64_t responses = 0;
+    int64_t tensors = 0;
+    int64_t bytes = 0;
+    Histogram negotiation_wait_us;
+  };
+
+  void RecordTenant(int psid, int64_t tensors, int64_t bytes);
+  void RecordTenantWaitUs(int psid, int64_t wait_us);
+
   void Reset();
 
   // Full registry as one JSON object.  extra_json, when non-empty, is a
@@ -99,6 +119,10 @@ struct MetricsRegistry {
   // into the object as additional top-level members; it must start with
   // a comma-free `"key":...` sequence.
   std::string DumpJson(int rank, const std::string& extra_json) const;
+
+ private:
+  mutable std::mutex tenants_mu_;
+  std::map<int, TenantStats> tenants_;
 };
 
 MetricsRegistry& GlobalMetrics();

@@ -1,6 +1,7 @@
 #include "socket_controller.h"
 
 #include <poll.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -89,6 +90,18 @@ constexpr int32_t kTagFlightDigest = 0x14000;
 // Last-N window a digest carries: enough causal context around the collapse
 // without bloating the abort exchange (48 bytes/event -> ~6 KiB per rank).
 constexpr int kFlightDigestEvents = 128;
+
+// Fleet-autopilot decision action codes, carried in kFlightAutopilot
+// events (a = action, b = rank) and on the policy channel's DECISION
+// command.  Mirrored by horovod_tpu/runner/autopilot.py and decoded by
+// tools/postmortem.py — keep the three in sync.
+constexpr int kAutopilotActEvict = 1;
+constexpr int kAutopilotActScaleUp = 2;
+constexpr int kAutopilotActReadmit = 3;
+
+// Bound on buffered, un-newline-terminated policy-channel input: the
+// driver sends short single-line commands, so anything larger is garbage.
+constexpr size_t kPolicyMaxLine = 65536;
 
 // Broadcasts at least this large take the pipelined chain instead of the
 // binomial tree.  A protocol constant: the algorithm choice must agree on
@@ -457,6 +470,20 @@ Status SocketController::Initialize() {
   if (FlightOn()) {
     FlightRecord(kFlightRendezvous, cfg_.size, kProtocolVersion);
   }
+  if (is_coordinator() && cfg_.autopilot_port > 0) {
+    // Fleet-autopilot policy channel: loopback-only — the driver runs on
+    // the coordinator's host, and the channel accepts decision records.
+    if (!policy_listener_.Listen("127.0.0.1", cfg_.autopilot_port)) {
+      HVD_LOG(WARNING) << "autopilot: failed to open policy listener on "
+                          "port "
+                       << cfg_.autopilot_port << "; policy channel disabled";
+    } else {
+      policy_stop_.store(false, std::memory_order_relaxed);
+      policy_thread_ = std::thread([this] { PolicyServeLoop(); });
+      HVD_LOG(INFO) << "autopilot: policy channel listening on port "
+                    << policy_listener_.port();
+    }
+  }
   initialized_ = true;
   return Status::OK();
 }
@@ -788,6 +815,11 @@ void SocketController::Farewell() {
 }
 
 void SocketController::Shutdown() {
+  // The policy thread may exist even when Initialize failed later on, so
+  // stop it before the initialized_ gate below.
+  policy_stop_.store(true, std::memory_order_relaxed);
+  if (policy_thread_.joinable()) policy_thread_.join();
+  policy_listener_.Close();
   if (!initialized_) return;
   initialized_ = false;
   aborted_ = true;
@@ -1753,6 +1785,18 @@ Status SocketController::CoordinatorCycle(
       last_joined_ = -1;
     }
   }
+  // QoS tenant scheduling: order this cycle's fused responses by
+  // descending process-set weight (stable, so equal-weight traffic —
+  // including everything before the first add_process_set(weight=) —
+  // keeps its deterministic arrival order).  Running BEFORE seq
+  // assignment and the broadcast means every rank executes the same
+  // weight-ordered schedule, so a heavy background tenant cannot push a
+  // high-weight training set's collectives to the back of the cycle.
+  std::stable_sort(out->begin(), out->end(),
+                   [this](const Response& a, const Response& b) {
+                     return process_sets_.Weight(a.process_set_id) >
+                            process_sets_.Weight(b.process_set_id);
+                   });
   out->insert(out->begin(), errors.begin(), errors.end());
   UpdateCachesAndSeq(out);
 
@@ -1826,10 +1870,12 @@ void SocketController::MaybeStragglerReport(double now) {
   double threshold = std::max(straggler_skew_ * median, straggler_min_us_);
   std::ostringstream os;
   bool found = false;
+  std::vector<int> flagged;
   for (int r = 0; r < cfg_.size; ++r) {
     if (window_count[r] == 0 || mean_us[r] <= threshold) continue;
     if (found) os << "; ";
     found = true;
+    flagged.push_back(r);
     const std::string host =
         r < static_cast<int>(host_keys_.size()) ? host_keys_[r] : "?";
     os << "rank " << r << " (host " << host << "): negotiation lag mean="
@@ -1839,13 +1885,21 @@ void SocketController::MaybeStragglerReport(double now) {
        << "ms vs fleet median " << static_cast<int64_t>(median / 1000)
        << "ms";
   }
-  if (!found) return;
-  std::string report = "straggler report: " + os.str();
-  GlobalMetrics().straggler_reports_total.fetch_add(1,
-                                                    std::memory_order_relaxed);
-  HVD_LOG(WARNING) << report;
+  std::string report;
+  if (found) {
+    report = "straggler report: " + os.str();
+    GlobalMetrics().straggler_reports_total.fetch_add(
+        1, std::memory_order_relaxed);
+    HVD_LOG(WARNING) << report;
+  }
+  // Every evaluated window (flagged or clean) advances the autopilot view:
+  // the policy engine diffs `straggler_windows_` between polls, and a
+  // clean window resetting straggler_ranks_ is what breaks an eviction
+  // streak for a rank that recovered.
   std::lock_guard<std::mutex> l(metrics_mu_);
-  straggler_report_ = std::move(report);
+  ++straggler_windows_;
+  straggler_ranks_ = std::move(flagged);
+  if (!report.empty()) straggler_report_ = std::move(report);
 }
 
 std::string SocketController::ClusterMetricsJson() {
@@ -1867,6 +1921,116 @@ std::string SocketController::ClusterMetricsJson() {
   }
   os << "},\"straggler_report\":\"" << JsonEscape(straggler_report_) << "\"";
   return os.str();
+}
+
+// ---------------------------------------------------------------------------
+// Fleet-autopilot policy channel (coordinator only)
+// ---------------------------------------------------------------------------
+
+std::string SocketController::PolicyStatusJson() {
+  std::ostringstream os;
+  std::lock_guard<std::mutex> l(metrics_mu_);
+  os << "{\"v\":1,\"windows\":" << straggler_windows_ << ",\"culprits\":[";
+  for (size_t i = 0; i < straggler_ranks_.size(); ++i) {
+    if (i) os << ',';
+    os << straggler_ranks_[i];
+  }
+  os << "],\"hosts\":[";
+  // The coordinator's agreed host key per flagged rank: attribution the
+  // driver feeds straight into the elastic blacklist (its own hostfile
+  // names may differ from the rendezvous-agreed keys).
+  for (size_t i = 0; i < straggler_ranks_.size(); ++i) {
+    if (i) os << ',';
+    const int r = straggler_ranks_[i];
+    const std::string host =
+        r >= 0 && r < static_cast<int>(host_keys_.size()) ? host_keys_[r]
+                                                          : "";
+    os << "\"" << JsonEscape(host) << "\"";
+  }
+  os << "],\"report\":\"" << JsonEscape(straggler_report_)
+     << "\",\"size\":" << cfg_.size << "}";
+  return os.str();
+}
+
+void SocketController::RecordAutopilotDecision(int action, int rank,
+                                               const std::string& detail) {
+  const char* name = action == kAutopilotActEvict      ? "evict"
+                     : action == kAutopilotActScaleUp  ? "scale_up"
+                     : action == kAutopilotActReadmit  ? "readmit"
+                                                       : "unknown";
+  GlobalMetrics().autopilot_decisions_total.fetch_add(
+      1, std::memory_order_relaxed);
+  if (FlightOn()) {
+    FlightRecord(kFlightAutopilot, action, rank);
+    // An eviction decision is usually followed by elastic teardown of this
+    // very process: dump now so the record survives into the postmortem
+    // bundle regardless of how the generation ends.
+    FlightDumpToFile();
+  }
+  if (autopilot_hook_) autopilot_hook_(action, rank, detail);
+  HVD_LOG(WARNING) << "autopilot decision: " << name << " rank=" << rank
+                   << (detail.empty() ? "" : " (" + detail + ")");
+}
+
+void SocketController::PolicyServeLoop() {
+  // One driver connection at a time (the autopilot keeps a single
+  // persistent connection; a reconnect simply replaces it).  Commands are
+  // newline-terminated text, replies one JSON line each:
+  //   POLL                         -> PolicyStatusJson()
+  //   DECISION <action> <rank> <detail...> -> {"ok":true}
+  Socket client;
+  std::string acc;
+  while (!policy_stop_.load(std::memory_order_relaxed)) {
+    if (!client.valid()) {
+      client = policy_listener_.Accept(0.2);
+      if (!client.valid()) continue;
+      acc.clear();
+    }
+    struct pollfd p;
+    p.fd = client.fd();
+    p.events = POLLIN;
+    p.revents = 0;
+    const int rv = ::poll(&p, 1, 200);
+    if (rv < 0 && errno != EINTR) {
+      client.Close();
+      continue;
+    }
+    if (rv <= 0) continue;
+    char buf[4096];
+    const ssize_t n = ::recv(client.fd(), buf, sizeof(buf), 0);
+    if (n <= 0) {
+      client.Close();
+      continue;
+    }
+    acc.append(buf, static_cast<size_t>(n));
+    size_t nl;
+    while (client.valid() && (nl = acc.find('\n')) != std::string::npos) {
+      std::string line = acc.substr(0, nl);
+      acc.erase(0, nl + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::string reply;
+      if (line == "POLL") {
+        reply = PolicyStatusJson();
+      } else if (line.rfind("DECISION ", 0) == 0) {
+        int action = 0, rank = -1, consumed = 0;
+        if (std::sscanf(line.c_str() + 9, "%d %d%n", &action, &rank,
+                        &consumed) >= 2 &&
+            action >= kAutopilotActEvict && action <= kAutopilotActReadmit) {
+          std::string detail = line.substr(9 + consumed);
+          if (!detail.empty() && detail.front() == ' ') detail.erase(0, 1);
+          RecordAutopilotDecision(action, rank, detail);
+          reply = "{\"ok\":true}";
+        } else {
+          reply = "{\"ok\":false,\"error\":\"malformed DECISION\"}";
+        }
+      } else {
+        reply = "{\"ok\":false,\"error\":\"unknown command\"}";
+      }
+      reply.push_back('\n');
+      if (!client.SendAll(reply.data(), reply.size())) client.Close();
+    }
+    if (acc.size() > kPolicyMaxLine) client.Close();  // runaway garbage
+  }
 }
 
 std::string SocketController::BuildCycleFrame(

@@ -29,10 +29,12 @@
 
 #include <atomic>
 #include <condition_variable>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
 #include <set>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -169,6 +171,18 @@ class SocketController : public Controller {
   // report.  Workers return "".
   std::string ClusterMetricsJson();
 
+  // Fleet-autopilot policy channel (coordinator only, armed by
+  // cfg_.autopilot_port > 0): a driver-facing JSON-lines endpoint serving
+  // the live straggler view ({"cmd":"poll"}) and accepting decision
+  // records ({"cmd":"decision",...}) that land in the flight recorder,
+  // the metrics registry, and — via the hook — the timeline.  The hook
+  // is installed once at init (core_api), before the serve thread exists.
+  void SetAutopilotDecisionHook(
+      std::function<void(int action, int rank, const std::string& detail)>
+          hook) {
+    autopilot_hook_ = std::move(hook);
+  }
+
  private:
   // Compact per-rank metrics snapshot, piggybacked worker->coordinator on
   // every CYCLE frame (protocol v7) and refreshed for rank 0 locally.
@@ -195,6 +209,21 @@ class SocketController : public Controller {
   void MaybeStragglerReport(double now);
   void FillSelfSnapshot(double now);
 
+  // -- fleet-autopilot policy channel (coordinator only) --------------------
+  // Accept loop + per-connection JSON-lines service on policy_listener_;
+  // runs on its own thread (started by Initialize when armed) so policy
+  // polls never touch the negotiation cycle.
+  void PolicyServeLoop();
+  // {"v":1,"windows":N,"culprits":[...],"report":"...","size":S} under
+  // metrics_mu_ — the driver-side engine diffs `windows` to count
+  // consecutive flagged report windows per rank.
+  std::string PolicyStatusJson();
+  // Record one driver decision: flight event (kFlightAutopilot), metrics
+  // counter, timeline instant via the hook, and an immediate flight dump
+  // so the record survives the eviction teardown that usually follows.
+  void RecordAutopilotDecision(int action, int rank,
+                               const std::string& detail);
+
   std::mutex metrics_mu_;  // guards cluster_ + straggler_report_ (the
                            // background thread writes, hvd_metrics_dump
                            // reads from the Python thread)
@@ -203,6 +232,13 @@ class SocketController : public Controller {
   // Cumulative (count, sum_us) per rank at the last report, for deltas.
   std::vector<std::pair<int64_t, int64_t>> announce_prev_;
   std::string straggler_report_;
+  // Autopilot view of the straggler check (guarded by metrics_mu_ like
+  // straggler_report_): total report windows evaluated so far and the
+  // ranks flagged in the LAST window.  The driver-side policy engine
+  // diffs `straggler_windows_` between polls to count consecutive flagged
+  // windows without double-counting a window it already saw.
+  int64_t straggler_windows_ = 0;
+  std::vector<int> straggler_ranks_;
   double last_metrics_report_ = 0;
   // HOROVOD_METRICS_REPORT_SECONDS / HOROVOD_STRAGGLER_SKEW /
   // HOROVOD_STRAGGLER_MIN_MS (ctor reads the env, like ring_chunk_bytes_).
@@ -518,6 +554,13 @@ class SocketController : public Controller {
 
   Listener listener_;       // coordinator: rendezvous/ctrl accept
   Listener data_listener_;  // every rank: mesh peer accept (ephemeral port)
+  // Fleet autopilot (coordinator, cfg_.autopilot_port > 0): the driver-
+  // facing policy listener and its serve thread.  policy_stop_ is the
+  // thread's shutdown latch; the hook forwards decisions to the timeline.
+  Listener policy_listener_;
+  std::thread policy_thread_;
+  std::atomic<bool> policy_stop_{false};
+  std::function<void(int, int, const std::string&)> autopilot_hook_;
   // coordinator: per-worker ctrl sockets (index = rank, [0] unused)
   std::vector<Socket> ctrl_socks_;
   // worker: ctrl connection to the coordinator

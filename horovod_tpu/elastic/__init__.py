@@ -87,4 +87,10 @@ def _reset(state: State) -> None:
     client.mark_ready()
     client.wait_assignment()
     hvd.init()
+    # Replay user process-set registrations against the new world: a shrink
+    # drops departed ranks from each set's live membership, a re-grow
+    # re-admits them (ProcessSet.desired_ranks keeps the original request).
+    from ..process_sets import reregister_all
+
+    reregister_all()
     state.on_reset()

@@ -153,6 +153,14 @@ class ElasticCoordinatorClient:
         if (a.get("jax_coordinator")
                 and os.environ.get("HOROVOD_JAX_DISTRIBUTED") == "1"):
             os.environ["HOROVOD_JAX_COORDINATOR"] = a["jax_coordinator"]
+        # Fleet autopilot (driver-side policy loop): rank 0 opens the
+        # coordinator's loopback policy listener on this port.  Only present
+        # in autopilot mode and only meaningful on rank 0; clear any stale
+        # value so a demoted ex-rank-0 never reopens the listener.
+        if a.get("policy_port") and int(a["rank"]) == 0:
+            os.environ["HOROVOD_AUTOPILOT_PORT"] = str(a["policy_port"])
+        else:
+            os.environ.pop("HOROVOD_AUTOPILOT_PORT", None)
         return a
 
     def mark_ready(self) -> None:
@@ -160,16 +168,16 @@ class ElasticCoordinatorClient:
         the next generation's assignment.
 
         Includes freshly-probed free ports on THIS host: if this worker is
-        elected rank 0, the rendezvous server and the per-generation
-        jax.distributed coordinator bind here, and only a local probe
-        proves a port is actually free (the driver may be a different
-        machine)."""
+        elected rank 0, the rendezvous server, the per-generation
+        jax.distributed coordinator and (in autopilot mode) the policy
+        listener bind here, and only a local probe proves a port is
+        actually free (the driver may be a different machine)."""
         socks = []
         try:
-            for _ in range(2):
+            for _ in range(3):
                 s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 s.bind(("0.0.0.0", 0))
-                socks.append(s)   # hold open so the two ports are distinct
+                socks.append(s)   # hold open so the probed ports are distinct
             ports = [s.getsockname()[1] for s in socks]
         except OSError:
             ports = []

@@ -24,9 +24,18 @@ class ProcessSet:
 
     process_set_id: Optional[int] = None
 
-    def __init__(self, ranks_or_range: Union[Sequence[int], range, Iterable[int]]):
+    def __init__(self, ranks_or_range: Union[Sequence[int], range, Iterable[int]],
+                 weight: float = 1.0):
         self.ranks: List[int] = sorted(set(int(r) for r in ranks_or_range))
         self.process_set_id = None
+        # QoS weight: orders the coordinator's fused-response schedule
+        # (higher first; 1.0 = same priority as the global set).
+        self.weight: float = float(weight)
+        # Requested membership, preserved across elastic resets: after a
+        # shrink `ranks` is the intersection with the surviving world, but
+        # `desired_ranks` keeps the full request so a later re-grow
+        # re-admits the returning ranks (see reregister_all()).
+        self.desired_ranks: List[int] = list(self.ranks)
 
     def _check_registered(self) -> None:
         if self.process_set_id is None:
@@ -87,8 +96,15 @@ class _GlobalProcessSet(ProcessSet):
 
 global_process_set = _GlobalProcessSet()
 
+# Registration-order list of live user process sets — the source of truth
+# reregister_all() replays after an elastic reset (the native table is torn
+# down with the old core instance).  The global set (id 0) is implicit and
+# never listed here.
+_registered: List[ProcessSet] = []
 
-def add_process_set(process_set: Union[ProcessSet, Sequence[int]]) -> ProcessSet:
+
+def add_process_set(process_set: Union[ProcessSet, Sequence[int]],
+                    weight: Optional[float] = None) -> ProcessSet:
     """Register a process set; must be called identically on every rank.
 
     Ids are assigned deterministically from registration order, which keeps
@@ -96,15 +112,28 @@ def add_process_set(process_set: Union[ProcessSet, Sequence[int]]) -> ProcessSet
     synchronises dynamically under HOROVOD_DYNAMIC_PROCESS_SETS; here
     symmetric registration is the contract, validated by the controller
     during negotiation).
+
+    ``weight`` (QoS): orders the coordinator's fused-response schedule —
+    higher-weight sets' fused responses are broadcast (hence executed)
+    first within a cycle.  Defaults to 1.0, the global set's priority.
     """
     if not isinstance(process_set, ProcessSet):
         process_set = ProcessSet(process_set)
+    if weight is not None:
+        process_set.weight = float(weight)
+    if process_set.weight <= 0.0:
+        # Mirrors the native scheduler's clamp: a zero/negative weight would
+        # starve the set's member ranks out of negotiation entirely.
+        process_set.weight = 1.0
     ctx = HorovodContext.instance()
     world = ctx.core.process_set_ranks(0)
     for r in process_set.ranks:
         if r not in world:
             raise ValueError(f"rank {r} is not part of the global process set")
-    process_set.process_set_id = ctx.core.add_process_set(process_set.ranks)
+    process_set.process_set_id = ctx.core.add_process_set(
+        process_set.ranks, weight=process_set.weight)
+    if process_set not in _registered:
+        _registered.append(process_set)
     return process_set
 
 
@@ -113,7 +142,38 @@ def remove_process_set(process_set: ProcessSet) -> bool:
         return False
     HorovodContext.instance().remove_process_set(process_set.process_set_id)
     process_set.process_set_id = None
+    try:
+        _registered.remove(process_set)
+    except ValueError:
+        pass
     return True
+
+
+def reregister_all() -> None:
+    """Replay user process-set registrations after an elastic reset.
+
+    Called by the elastic ``_reset`` hook right after the new core instance
+    comes up (so it runs identically — same order — on every surviving
+    rank).  Each set's *desired* membership is intersected with the new
+    world: a shrink drops the departed ranks from ``ranks`` (the set stays
+    usable for the survivors), a re-grow re-admits returning ranks.  Sets
+    left with fewer than one member stay registered but inactive
+    (``process_set_id=None``) until the world grows back.
+    """
+    ctx = HorovodContext.instance()
+    world = set(ctx.core.process_set_ranks(0))
+    for ps in _registered:
+        ps.ranks = sorted(r for r in ps.desired_ranks if r in world)
+        if ps.ranks:
+            ps.process_set_id = ctx.core.add_process_set(
+                ps.ranks, weight=ps.weight)
+        else:
+            ps.process_set_id = None
+
+
+def _clear_registry() -> None:
+    """Test hook: forget all replayable registrations."""
+    _registered.clear()
 
 
 def _resolve_psid(process_set: Optional[ProcessSet]) -> int:

@@ -1,0 +1,248 @@
+"""Fleet autopilot: closed-loop, metrics-driven eviction and elastic policy.
+
+A driver-side policy engine that closes the loop the observability stack
+opened: the coordinator already *attributes* stragglers (per-rank announce
+lag vs. the fleet median, ``SocketController::MaybeStragglerReport``); this
+module *acts* on the verdicts with zero human input.
+
+Data path::
+
+    coordinator (rank 0)                       driver
+    ------------------------                   -----------------------------
+    announce-lag histograms  --POLL-->         FleetAutopilot.observe()
+    straggler windows/ranks  <--DECISION--     evict / scale_up / readmit
+    flight type 13 + AUTOPILOT timeline        ElasticDriver.evict_host()
+
+The policy channel is a newline-terminated text protocol over the
+coordinator's LOOPBACK listener (``HOROVOD_AUTOPILOT_PORT``, assigned per
+generation by the elastic driver): ``POLL`` returns a JSON status line
+``{"v":1,"windows":N,"culprits":[rank...],"hosts":[key...],...}``;
+``DECISION <action> <rank> <detail>`` records the decision natively (flight
+recorder type 13, ``autopilot_decisions_total`` counter, an ``AUTOPILOT``
+timeline instant) *before* the eviction tears the generation down.
+
+Decision rules (documented in docs/elastic.md):
+
+- **Evict**: a rank flagged in ``HOROVOD_AUTOPILOT_EVICT_WINDOWS``
+  consecutive straggler report windows has its host fed to the elastic
+  blacklist (expiring sentence with exponential backoff), never shrinking
+  below ``HOROVOD_AUTOPILOT_MIN_NP`` and never evicting rank 0 (the
+  coordinator is the measuring instrument).  A clean window (rank not
+  flagged) resets its streak — transient noise never evicts.
+- **Cooldown**: at most one eviction per ``HOROVOD_AUTOPILOT_COOLDOWN_SECS``
+  so the fleet re-stabilises between decisions.
+- **Scale up / readmit**: blacklist expiry and discovery growth already
+  poke the elastic driver; the autopilot records them as decisions so the
+  flight/timeline record names every fleet change.
+
+All HOROVOD_AUTOPILOT* knobs are driver-side only — worker processes and
+the native core never read them (the port rides the ctypes ABI).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+from typing import Dict, Optional
+
+from ..utils.env import get_float, get_int
+
+# Action codes — mirror of kAutopilotAct* in cpp/socket_controller.cc and
+# the rendering table in tools/postmortem.py (keep the three in sync).
+ACT_EVICT = 1
+ACT_SCALE_UP = 2
+ACT_READMIT = 3
+
+ACTION_NAMES = {ACT_EVICT: "evict", ACT_SCALE_UP: "scale_up",
+                ACT_READMIT: "readmit"}
+
+DEFAULT_EVICT_WINDOWS = 3
+DEFAULT_COOLDOWN_SECS = 60.0
+POLL_INTERVAL_S = 1.0
+
+
+class PolicyClient:
+    """One-shot client for the coordinator's loopback policy channel."""
+
+    def __init__(self, port: int, timeout: float = 2.0):
+        self.port = port
+        self.timeout = timeout
+
+    def _roundtrip(self, line: str) -> Optional[dict]:
+        try:
+            with socket.create_connection(("127.0.0.1", self.port),
+                                          timeout=self.timeout) as s:
+                s.settimeout(self.timeout)
+                s.sendall((line + "\n").encode())
+                buf = b""
+                while b"\n" not in buf:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        return None
+                    buf += chunk
+                return json.loads(buf.split(b"\n", 1)[0].decode())
+        except (OSError, ValueError):
+            return None
+
+    def poll(self) -> Optional[dict]:
+        return self._roundtrip("POLL")
+
+    def decision(self, action: int, rank: int, detail: str) -> bool:
+        detail = detail.replace("\n", " ")
+        reply = self._roundtrip(f"DECISION {action} {rank} {detail}")
+        return bool(reply and reply.get("ok"))
+
+
+class FleetAutopilot:
+    """The policy loop.  ``observe()`` is the pure decision function
+    (injectable clock, no sleeps — unit-testable); ``run()`` wires it to
+    the live driver and coordinator."""
+
+    def __init__(self, driver, clock=time.monotonic,
+                 poll_interval: float = POLL_INTERVAL_S):
+        self.driver = driver
+        self.clock = clock
+        self.poll_interval = poll_interval
+        self.evict_windows = max(
+            1, get_int("HOROVOD_AUTOPILOT_EVICT_WINDOWS",
+                       DEFAULT_EVICT_WINDOWS))
+        # Safety rail: never shrink below this.  Defaults to the job's
+        # --min-np (the driver would abort below that anyway).
+        self.min_np = max(1, get_int("HOROVOD_AUTOPILOT_MIN_NP",
+                                     getattr(driver, "min_np", 1)))
+        self.cooldown_s = get_float("HOROVOD_AUTOPILOT_COOLDOWN_SECS",
+                                    DEFAULT_COOLDOWN_SECS)
+        # rank -> consecutive flagged report windows
+        self._streaks: Dict[int, int] = {}
+        self._last_windows = 0
+        self._gen = -1
+        self._last_evict_at: Optional[float] = None
+        self._last_blacklist: Dict[str, float] = {}
+        self._last_size = 0
+        self._log_path = None
+        pm_dir = os.environ.get("HOROVOD_POSTMORTEM_DIR")
+        if pm_dir:
+            self._log_path = os.path.join(pm_dir, "autopilot.jsonl")
+
+    # -- decision core (pure; unit-tested without sleeps) --------------------
+    def observe(self, status: dict, now: float) -> Optional[dict]:
+        """Fold one POLL status into the streak state; return an eviction
+        decision dict ``{"action", "rank", "host", "reason"}`` or None.
+
+        ``status["windows"]`` counts straggler report windows since the
+        coordinator started; the delta since the previous poll is how many
+        NEW windows this poll covers (polling faster than the report
+        interval must not inflate streaks).
+        """
+        windows = int(status.get("windows", 0))
+        delta = windows - self._last_windows
+        if delta < 0:  # new coordinator generation restarted the counter
+            self._streaks.clear()
+            delta = windows
+        self._last_windows = windows
+        if delta == 0:
+            return None
+        culprits = [int(r) for r in status.get("culprits", [])]
+        hosts = [str(h) for h in status.get("hosts", [])]
+        host_of = dict(zip(culprits, hosts))
+        flagged = set(culprits)
+        for r in list(self._streaks):
+            if r not in flagged:
+                # A clean window breaks the streak: transient noise (one
+                # GC pause, one checkpoint write) never evicts.
+                del self._streaks[r]
+        for r in flagged:
+            self._streaks[r] = self._streaks.get(r, 0) + delta
+        for r, streak in sorted(self._streaks.items(),
+                                key=lambda kv: -kv[1]):
+            if streak < self.evict_windows:
+                continue
+            if r == 0:
+                # The coordinator is the measuring instrument; its own lag
+                # reads as everyone else being early.  Never self-evict.
+                continue
+            host = host_of.get(r)
+            if not host:
+                continue
+            if (self._last_evict_at is not None
+                    and now - self._last_evict_at < self.cooldown_s):
+                return None
+            slots = self.driver.live_slots_on(host)
+            if self.driver.live_size() - slots < self.min_np:
+                # Min-np rail: evicting would sink the job below the
+                # floor; keep limping with the straggler instead.
+                return None
+            return {"action": ACT_EVICT, "rank": r, "host": host,
+                    "reason": f"straggler for {streak} consecutive "
+                              f"report windows"}
+        return None
+
+    def note_generation(self, gen: int) -> None:
+        """Reset per-coordinator state when the generation turns over."""
+        if gen != self._gen:
+            self._gen = gen
+            self._streaks.clear()
+            self._last_windows = 0
+
+    # -- recording -----------------------------------------------------------
+    def _record(self, client: Optional[PolicyClient], action: int,
+                rank: int, detail: str) -> None:
+        name = ACTION_NAMES.get(action, "unknown")
+        if client is not None:
+            # Record natively FIRST: the flight dump + timeline instant must
+            # exist before an eviction tears the generation down.
+            client.decision(action, rank, detail)
+        row = {"ts": time.time(), "generation": self._gen,
+               "action": name, "rank": rank, "detail": detail}
+        if self._log_path:
+            try:
+                with open(self._log_path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(row) + "\n")
+            except OSError:
+                pass
+        print(f"autopilot: {name} rank={rank} {detail}", file=sys.stderr)
+
+    def _watch_fleet_changes(self, client: Optional[PolicyClient]) -> None:
+        """Record blacklist expiries (readmit) and formation growth
+        (scale_up) — the elastic machinery performs them; the autopilot
+        names them in the record."""
+        cur = dict(getattr(self.driver, "_blacklist", {}))
+        for host in self._last_blacklist:
+            if host not in cur:
+                self._record(client, ACT_READMIT, -1,
+                             f"blacklist expired for host {host}")
+        self._last_blacklist = cur
+        size = getattr(self.driver, "_formed_size", 0)
+        if size > self._last_size and self._last_size > 0:
+            self._record(client, ACT_SCALE_UP, -1,
+                         f"fleet grew {self._last_size} -> {size}")
+        if size:
+            self._last_size = size
+
+    # -- live loop -----------------------------------------------------------
+    def run(self) -> None:
+        while not self.driver._stop.is_set():
+            time.sleep(self.poll_interval)
+            gen, port = self.driver.policy_endpoint()
+            self.note_generation(gen)
+            client = PolicyClient(port) if port else None
+            self._watch_fleet_changes(client)
+            if client is None:
+                continue
+            status = client.poll()
+            if not status:
+                continue
+            decision = self.observe(status, self.clock())
+            if decision is None:
+                continue
+            self._last_evict_at = self.clock()
+            self._record(client, decision["action"], decision["rank"],
+                         f"host {decision['host']}: {decision['reason']}")
+            self.driver.evict_host(decision["host"], decision["reason"])
+            # The generation is about to turn over; drop streaks now so a
+            # stale rank numbering never feeds the next generation.
+            self._streaks.clear()
+            self._last_windows = 0

@@ -151,7 +151,7 @@ class ElasticDriver:
                  min_np: int, max_np: Optional[int],
                  base_env: Optional[Dict[str, str]] = None,
                  start_timeout: float = 120.0, verbose: bool = False,
-                 ssh_port: Optional[int] = None):
+                 ssh_port: Optional[int] = None, autopilot: bool = False):
         self.discovery = discovery
         self.command = command
         self.min_np = min_np
@@ -160,6 +160,12 @@ class ElasticDriver:
         self.start_timeout = start_timeout
         self.verbose = verbose
         self.ssh_port = ssh_port
+        # Fleet autopilot: a driver thread polls the coordinator's loopback
+        # policy channel for straggler verdicts and feeds persistent
+        # offenders into evict_host() (see runner/autopilot.py).
+        self.autopilot = autopilot
+        self._policy_port: Optional[int] = None
+        self._policy_gen = -1
 
         self._lock = threading.Lock()
         self._workers: Dict[str, _Worker] = {}      # worker_id -> worker
@@ -478,6 +484,15 @@ class ElasticDriver:
                                find_free_port(
                                    "0.0.0.0" if rdv_addr != "127.0.0.1"
                                    else "127.0.0.1"))
+        # Autopilot policy channel: the coordinator (rank 0) opens a
+        # LOOPBACK listener on this port, so the channel only works when
+        # the driver shares rank 0's host (the single-controller pod
+        # topology the autopilot targets).  Remote rank 0 → no port, the
+        # autopilot idles for the generation.
+        policy_port = None
+        if self.autopilot and rdv_addr == "127.0.0.1":
+            policy_port = (r0_ports.pop(0) if r0_ports
+                           else find_free_port("127.0.0.1"))
         local_sizes = collections.Counter(w.host for w in expected)
         local_seen: Dict[str, int] = {}
         hosts_order = list(dict.fromkeys(w.host for w in expected))
@@ -495,13 +510,46 @@ class ElasticDriver:
                 "rendezvous_addr": rdv_addr,
                 "rendezvous_port": rdv_port,
                 "jax_coordinator": jax_coord,
+                "policy_port": policy_port,
             })
         self._generation = gen
         self._formed_size = size
+        self._policy_port = policy_port
+        self._policy_gen = gen
         if self.verbose:
             print(f"elastic driver: generation {gen} formed with {size} "
                   f"worker(s)", file=sys.stderr)
         return True
+
+    # -- fleet autopilot hooks -----------------------------------------------
+    def evict_host(self, host: str, reason: str = "") -> float:
+        """Autopilot entry: sentence ``host`` to the elastic blacklist (the
+        same expiring, exponentially-backed-off sentence a crash loop earns)
+        and trigger a re-formation.  The shrink drops its workers; the
+        sentence expiry re-admits the host via the discovery loop's poke.
+        Returns the sentence length in seconds."""
+        with self._lock:
+            duration = self._blacklist_host(host, self._clock())
+        print(f"elastic driver: autopilot evicted host {host}"
+              f" ({reason or 'persistent straggler'}; "
+              f"re-admitted in {duration:.0f}s)", file=sys.stderr)
+        self._poke()
+        return duration
+
+    def live_slots_on(self, host: str) -> int:
+        """Live (non-dead) workers currently on ``host``."""
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if not w.dead and w.host == host)
+
+    def live_size(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._workers.values() if not w.dead)
+
+    def policy_endpoint(self):
+        """(generation, port) of the current coordinator's loopback policy
+        listener, or (gen, None) when unavailable this generation."""
+        return self._policy_gen, self._policy_port
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:
@@ -509,6 +557,12 @@ class ElasticDriver:
         discovery_thread = threading.Thread(
             target=self._discovery_loop, daemon=True)
         discovery_thread.start()
+        if self.autopilot:
+            from .autopilot import FleetAutopilot
+
+            self._autopilot = FleetAutopilot(self)
+            threading.Thread(target=self._autopilot.run,
+                             name="hvd-autopilot", daemon=True).start()
         self._reset_required.set()
         while not self._stop.is_set():
             if self._result_ready.is_set():
@@ -577,5 +631,6 @@ def run_elastic(args, command: List[str]) -> int:
     base_env.update(_tuning_env(args))
     driver = ElasticDriver(discovery, command, min_np, max_np, base_env,
                            start_timeout=args.start_timeout,
-                           verbose=args.verbose, ssh_port=args.ssh_port)
+                           verbose=args.verbose, ssh_port=args.ssh_port,
+                           autopilot=getattr(args, "autopilot", False))
     return driver.run()

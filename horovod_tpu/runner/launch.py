@@ -57,6 +57,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "instead of a discovery script")
     p.add_argument("--slots-per-host", type=int, default=1,
                    help="slots per discovered host (elastic mode)")
+    p.add_argument("--autopilot", action="store_true",
+                   help="fleet autopilot: the driver polls the "
+                        "coordinator's straggler verdicts and evicts "
+                        "persistent offenders into the expiring elastic "
+                        "blacklist, scaling back up when sentences lapse "
+                        "(implies elastic mode and HOROVOD_METRICS=1; "
+                        "decision rules and HOROVOD_AUTOPILOT_* knobs in "
+                        "docs/elastic.md)")
     # Tuning flags mirroring the reference CLI -> env contract.
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
@@ -220,6 +228,10 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
             args.stall_check_warning_time_seconds)
     if args.log_level:
         env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if getattr(args, "autopilot", False):
+        # Straggler attribution (the autopilot's input) lives behind the
+        # metrics plane; the policy loop is useless without it.
+        env["HOROVOD_METRICS"] = "1"
     return env
 
 
@@ -350,6 +362,12 @@ def _run(args: argparse.Namespace) -> int:
     if args.check_build:
         check_build()
         return 0
+    if not args.autopilot:
+        # Env-var spelling of --autopilot, for launchers driven from job
+        # templates where editing argv is awkward.
+        from ..utils.env import get_bool
+
+        args.autopilot = get_bool("HOROVOD_AUTOPILOT", False)
     if not args.command:
         print("error: no command given", file=sys.stderr)
         return 2
@@ -370,7 +388,7 @@ def _run(args: argparse.Namespace) -> int:
             print(f"error: --fault-inject: {err}", file=sys.stderr)
             return 2
     if args.host_discovery_script or args.tpu_discovery \
-            or args.min_np is not None:
+            or args.min_np is not None or args.autopilot:
         from .elastic_driver import run_elastic
 
         return run_elastic(args, command)

@@ -201,7 +201,11 @@ class CoreBackend:
         raise NotImplementedError
 
     # -- process sets -------------------------------------------------------
-    def add_process_set(self, ranks: Sequence[int]) -> int:
+    def add_process_set(self, ranks: Sequence[int],
+                        weight: float = 1.0) -> int:
+        """``weight`` orders the coordinator's fused-response schedule
+        (QoS: higher weight first; 1.0 = same priority as the global
+        set).  Backends without a coordinator accept and ignore it."""
         raise NotImplementedError
 
     def remove_process_set(self, process_set_id: int) -> None:
@@ -378,7 +382,9 @@ class PyLocalCore(CoreBackend):
                 return self._responses.pop(0)
             return None
 
-    def add_process_set(self, ranks: Sequence[int]) -> int:
+    def add_process_set(self, ranks: Sequence[int],
+                        weight: float = 1.0) -> int:
+        # Single process: there is no coordinator schedule to weight.
         return self._psets.add(ranks)
 
     def remove_process_set(self, psid: int) -> None:

@@ -181,6 +181,17 @@ class Config:
     elastic_timeout_s: float = DEFAULT_ELASTIC_TIMEOUT_S
     elastic_enabled: bool = False
 
+    # Fleet autopilot (driver-internal).  HOROVOD_AUTOPILOT_PORT is set by
+    # the elastic driver on rank 0 only: the coordinator opens a loopback
+    # policy listener on this port so the driver's autopilot thread can poll
+    # straggler verdicts and record eviction decisions.  0 = disabled (the
+    # default for every hand-launched job); workers never see it.  The
+    # operator-facing knobs (HOROVOD_AUTOPILOT, HOROVOD_AUTOPILOT_EVICT_WINDOWS,
+    # HOROVOD_AUTOPILOT_MIN_NP, HOROVOD_AUTOPILOT_COOLDOWN_SECS) are parsed
+    # by the driver in runner/autopilot.py — they never cross into worker
+    # processes or the native core.
+    autopilot_port: int = 0
+
     # Native core selection (TPU-build specific).
     force_pure_python: bool = False
 
@@ -243,5 +254,6 @@ class Config:
                 "HOROVOD_ELASTIC_TIMEOUT", DEFAULT_ELASTIC_TIMEOUT_S
             ),
             elastic_enabled=get_bool("HOROVOD_ELASTIC", False),
+            autopilot_port=get_int("HOROVOD_AUTOPILOT_PORT", 0),
             force_pure_python=get_bool("HVD_TPU_PURE_PY", False),
         )

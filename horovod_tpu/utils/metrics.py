@@ -9,6 +9,10 @@ it from an existing HTTP endpoint).  Naming scheme (docs/observability.md):
 - histograms -> ``hvd_<name>_bucket{rank="R",le="<2^i>"}`` cumulative
   series per power-of-two microsecond bucket, a ``le="+Inf"`` overflow
   series, plus ``hvd_<name>_sum`` / ``hvd_<name>_count``
+- per-tenant (process-set) QoS accounting -> the same two shapes with an
+  extra ``psid="<process_set_id>"`` label:
+  ``hvd_tenant_<responses|tensors|bytes>_total{rank="R",psid="P"}`` and
+  ``hvd_tenant_negotiation_wait_us_*{rank="R",psid="P"}``
 """
 
 from __future__ import annotations
@@ -51,4 +55,22 @@ def render_prometheus(dump: Dict) -> str:
             lines.append(f'{metric}_bucket{{rank="{rank}",le="{le}"}} {cum}')
         lines.append(f'{metric}_sum{{rank="{rank}"}} {int(h.get("sum_us", 0))}')
         lines.append(f'{metric}_count{{rank="{rank}"}} {int(h.get("count", 0))}')
+    for psid, t in sorted((dump.get("tenants") or {}).items()):
+        labels = f'rank="{rank}",psid="{psid}"'
+        for field in ("responses", "tensors", "bytes"):
+            metric = f"hvd_tenant_{field}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f'{metric}{{{labels}}} {int(t.get(field, 0))}')
+        h = t.get("negotiation_wait_us") or {}
+        if h.get("count"):
+            metric = "hvd_tenant_negotiation_wait_us"
+            lines.append(f"# TYPE {metric} histogram")
+            cum = 0
+            buckets = h.get("buckets") or []
+            for i, b in enumerate(buckets):
+                cum += int(b)
+                le = "+Inf" if i == len(buckets) - 1 else str(1 << i)
+                lines.append(f'{metric}_bucket{{{labels},le="{le}"}} {cum}')
+            lines.append(f'{metric}_sum{{{labels}}} {int(h.get("sum_us", 0))}')
+            lines.append(f'{metric}_count{{{labels}}} {int(h.get("count", 0))}')
     return "\n".join(lines) + "\n" if lines else ""

@@ -1,0 +1,223 @@
+"""Unit tests for the fleet autopilot's decision core (runner/autopilot.py).
+
+``FleetAutopilot.observe()`` is a pure function of (POLL status, clock) —
+no sockets, no sleeps — so the eviction-window logic, the cooldown, the
+rank-0 guard, and the min-np rail are all testable with a fake driver and
+a hand-advanced clock (docs/elastic.md "Fleet autopilot").
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.runner.autopilot import (ACT_EVICT, ACT_READMIT,
+                                          ACT_SCALE_UP, ACTION_NAMES,
+                                          FleetAutopilot, PolicyClient)
+
+
+class FakeDriver:
+    """The slice of ElasticDriver the autopilot reads."""
+
+    def __init__(self, size=4, slots=None, min_np=2):
+        self.min_np = min_np
+        self._size = size
+        self._slots = slots or {}
+        self._blacklist = {}
+        self._formed_size = size
+        self.evicted = []
+
+    def live_size(self):
+        return self._size
+
+    def live_slots_on(self, host):
+        return self._slots.get(host, 1)
+
+    def evict_host(self, host, reason=""):
+        self.evicted.append(host)
+        return 60.0
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _status(windows, culprits=(), hosts=()):
+    return {"v": 1, "windows": windows, "culprits": list(culprits),
+            "hosts": list(hosts), "size": 4}
+
+
+@pytest.fixture
+def ap(monkeypatch):
+    for var in ("HOROVOD_AUTOPILOT_EVICT_WINDOWS",
+                "HOROVOD_AUTOPILOT_MIN_NP",
+                "HOROVOD_AUTOPILOT_COOLDOWN_SECS",
+                "HOROVOD_POSTMORTEM_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("HOROVOD_AUTOPILOT_EVICT_WINDOWS", "3")
+    drv = FakeDriver(size=4, slots={"hostb": 1}, min_np=2)
+    pilot = FleetAutopilot(drv, clock=FakeClock())
+    return pilot
+
+
+def test_streak_accumulates_to_eviction(ap):
+    clock = ap.clock
+    # Two flagged windows: below the threshold, no decision.
+    assert ap.observe(_status(1, [3], ["hostb"]), clock()) is None
+    assert ap.observe(_status(2, [3], ["hostb"]), clock()) is None
+    # Third consecutive flagged window crosses EVICT_WINDOWS=3.
+    d = ap.observe(_status(3, [3], ["hostb"]), clock())
+    assert d is not None
+    assert d["action"] == ACT_EVICT
+    assert d["rank"] == 3
+    assert d["host"] == "hostb"
+    assert "3 consecutive" in d["reason"]
+
+
+def test_repolling_same_window_does_not_inflate_streak(ap):
+    clock = ap.clock
+    # The poll loop runs faster than the report window; a POLL that shows
+    # no NEW windows must not advance any streak.
+    assert ap.observe(_status(1, [3], ["hostb"]), clock()) is None
+    for _ in range(10):
+        assert ap.observe(_status(1, [3], ["hostb"]), clock()) is None
+    assert ap._streaks[3] == 1
+
+
+def test_clean_window_breaks_the_streak(ap):
+    clock = ap.clock
+    ap.observe(_status(1, [3], ["hostb"]), clock())
+    ap.observe(_status(2, [3], ["hostb"]), clock())
+    # Window 3 is clean (transient noise ended): streak resets.
+    assert ap.observe(_status(3), clock()) is None
+    assert 3 not in ap._streaks
+    # Two more flagged windows still are not enough.
+    assert ap.observe(_status(4, [3], ["hostb"]), clock()) is None
+    assert ap.observe(_status(5, [3], ["hostb"]), clock()) is None
+    assert ap._streaks[3] == 2
+
+
+def test_rank_zero_is_never_evicted(ap):
+    clock = ap.clock
+    for w in range(1, 10):
+        d = ap.observe(_status(w, [0], ["hosta"]), clock())
+        assert d is None, d
+
+
+def test_cooldown_blocks_back_to_back_evictions(ap):
+    clock = ap.clock
+    for w in (1, 2):
+        ap.observe(_status(w, [3], ["hostb"]), clock())
+    ap._last_evict_at = clock()  # what run() records on a decision
+    # Over the threshold, but inside the cooldown window.
+    assert ap.observe(_status(3, [3], ["hostb"]), clock()) is None
+    clock.t += ap.cooldown_s + 1.0
+    d = ap.observe(_status(4, [3], ["hostb"]), clock())
+    assert d is not None and d["action"] == ACT_EVICT
+
+
+def test_min_np_rail_blocks_eviction(monkeypatch):
+    monkeypatch.setenv("HOROVOD_AUTOPILOT_EVICT_WINDOWS", "1")
+    monkeypatch.delenv("HOROVOD_AUTOPILOT_MIN_NP", raising=False)
+    monkeypatch.delenv("HOROVOD_POSTMORTEM_DIR", raising=False)
+    # 3 live workers, 2 of them on the straggler's host: eviction would
+    # leave 1 < min_np=2.  The job limps instead.
+    drv = FakeDriver(size=3, slots={"hostb": 2}, min_np=2)
+    pilot = FleetAutopilot(drv, clock=FakeClock())
+    assert pilot.observe(_status(1, [2], ["hostb"]), pilot.clock()) is None
+    # A one-slot host is evictable: 3 - 1 = 2 >= min_np.
+    drv2 = FakeDriver(size=3, slots={"hostc": 1}, min_np=2)
+    pilot2 = FleetAutopilot(drv2, clock=FakeClock())
+    d = pilot2.observe(_status(1, [2], ["hostc"]), pilot2.clock())
+    assert d is not None and d["host"] == "hostc"
+
+
+def test_min_np_env_overrides_driver_floor(monkeypatch):
+    monkeypatch.setenv("HOROVOD_AUTOPILOT_EVICT_WINDOWS", "1")
+    monkeypatch.setenv("HOROVOD_AUTOPILOT_MIN_NP", "4")
+    monkeypatch.delenv("HOROVOD_POSTMORTEM_DIR", raising=False)
+    drv = FakeDriver(size=4, slots={"hostb": 1}, min_np=1)
+    pilot = FleetAutopilot(drv, clock=FakeClock())
+    assert pilot.min_np == 4
+    # 4 - 1 = 3 < 4: rail engaged despite the driver's looser floor.
+    assert pilot.observe(_status(1, [3], ["hostb"]), pilot.clock()) is None
+
+
+def test_generation_turnover_resets_streaks(ap):
+    clock = ap.clock
+    ap.note_generation(0)
+    ap.observe(_status(1, [3], ["hostb"]), clock())
+    ap.observe(_status(2, [3], ["hostb"]), clock())
+    ap.note_generation(1)  # re-formation: rank numbering changed
+    assert ap._streaks == {}
+    assert ap._last_windows == 0
+
+
+def test_coordinator_restart_resets_window_counter(ap):
+    clock = ap.clock
+    ap.observe(_status(5, [3], ["hostb"]), clock())
+    # A fresh coordinator restarts the counter from 0; a lower reading
+    # must clear state, not register as a huge negative delta.
+    assert ap.observe(_status(1, [3], ["hostb"]), clock()) is None
+    assert ap._streaks[3] == 1
+
+
+def test_decisions_append_to_jsonl(monkeypatch, tmp_path):
+    monkeypatch.setenv("HOROVOD_POSTMORTEM_DIR", str(tmp_path))
+    monkeypatch.delenv("HOROVOD_AUTOPILOT_EVICT_WINDOWS", raising=False)
+    drv = FakeDriver()
+    pilot = FleetAutopilot(drv, clock=FakeClock())
+    pilot._gen = 2
+    pilot._record(None, ACT_EVICT, 3, "host hostb: straggler")
+    pilot._record(None, ACT_READMIT, -1, "blacklist expired for host hostb")
+    log = tmp_path / "autopilot.jsonl"
+    rows = [json.loads(line) for line in log.read_text().splitlines()]
+    assert [r["action"] for r in rows] == ["evict", "readmit"]
+    assert rows[0]["rank"] == 3
+    assert rows[0]["generation"] == 2
+    assert rows[0]["detail"] == "host hostb: straggler"
+
+
+def test_watch_fleet_changes_records_readmit_and_scale_up(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("HOROVOD_POSTMORTEM_DIR", str(tmp_path))
+    drv = FakeDriver(size=3)
+    drv._blacklist = {"hostb": 999.0}
+    drv._formed_size = 3
+    pilot = FleetAutopilot(drv, clock=FakeClock())
+    pilot._watch_fleet_changes(None)  # baseline snapshot, no decisions
+    drv._blacklist = {}          # sentence expired
+    drv._formed_size = 4         # fleet re-formed larger
+    pilot._watch_fleet_changes(None)
+    rows = [json.loads(line) for line in
+            (tmp_path / "autopilot.jsonl").read_text().splitlines()]
+    assert [r["action"] for r in rows] == ["readmit", "scale_up"]
+    assert "hostb" in rows[0]["detail"]
+    assert "3 -> 4" in rows[1]["detail"]
+
+
+def test_policy_client_handles_dead_port():
+    # Nothing listens here: every call degrades to None/False, never raises.
+    client = PolicyClient(port=1, timeout=0.2)
+    assert client.poll() is None
+    assert client.decision(ACT_EVICT, 3, "x") is False
+
+
+def test_action_names_match_postmortem_renderer():
+    # tools/postmortem.py carries a mirror table; keep the codes in sync.
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tools", "postmortem.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._AUTOPILOT_ACTIONS == {
+        ACT_EVICT: ACTION_NAMES[ACT_EVICT],
+        ACT_SCALE_UP: ACTION_NAMES[ACT_SCALE_UP],
+        ACT_READMIT: ACTION_NAMES[ACT_READMIT],
+    }

@@ -1,0 +1,137 @@
+"""Process sets x elastic resets (process_sets.reregister_all).
+
+After an elastic re-formation the native process-set table dies with the
+old core instance; ``reregister_all()`` (called from the elastic ``_reset``
+hook) replays every live registration against the new world: a shrink
+intersects membership with the survivors, a re-grow re-admits returning
+ranks, and the QoS weight survives the round trip.  Driven here against a
+stub core so the membership algebra is tested without multi-process
+machinery (the live path is tests/parallel/test_autopilot.py).
+"""
+
+import pytest
+
+from horovod_tpu import process_sets
+from horovod_tpu.context import HorovodContext
+from horovod_tpu.process_sets import (ProcessSet, add_process_set,
+                                      remove_process_set, reregister_all)
+
+
+class StubCore:
+    """Mimics the backend surface process_sets.py touches, with a mutable
+    world so tests can shrink/grow it between reregister_all() calls."""
+
+    def __init__(self, world):
+        self.world = list(world)
+        self.next_id = 1
+        self.added = []  # (ranks, weight) in registration order
+
+    def process_set_ranks(self, psid):
+        assert psid == 0
+        return list(self.world)
+
+    def add_process_set(self, ranks, weight=1.0):
+        self.added.append((list(ranks), weight))
+        psid = self.next_id
+        self.next_id += 1
+        return psid
+
+    def rank(self):
+        return 0
+
+
+class StubContext:
+    def __init__(self, world):
+        self.core = StubCore(world)
+
+    def remove_process_set(self, psid):
+        pass
+
+
+@pytest.fixture
+def ctx(monkeypatch):
+    stub = StubContext(world=[0, 1, 2, 3])
+    monkeypatch.setattr(HorovodContext, "_instance", stub)
+    process_sets._clear_registry()
+    yield stub
+    process_sets._clear_registry()
+
+
+def test_shrink_removes_departed_member(ctx):
+    ps = add_process_set([1, 2, 3], weight=2.0)
+    assert ps.process_set_id is not None
+    assert ps.ranks == [1, 2, 3]
+
+    # Rank 3's host was evicted; the world re-forms as {0,1,2}.
+    ctx.core.world = [0, 1, 2]
+    reregister_all()
+    assert ps.ranks == [1, 2]
+    assert ps.process_set_id is not None
+    # The original request is preserved for a later re-grow.
+    assert ps.desired_ranks == [1, 2, 3]
+
+
+def test_regrow_readmits_returning_member(ctx):
+    ps = add_process_set([1, 3])
+    ctx.core.world = [0, 1, 2]
+    reregister_all()
+    assert ps.ranks == [1]
+
+    # Blacklist sentence expired; the fleet re-formed at full size.
+    ctx.core.world = [0, 1, 2, 3]
+    reregister_all()
+    assert ps.ranks == [1, 3]
+    assert ps.process_set_id is not None
+
+
+def test_fully_departed_set_parks_until_world_returns(ctx):
+    ps = add_process_set([3])
+    ctx.core.world = [0, 1, 2]
+    reregister_all()
+    assert ps.ranks == []
+    assert ps.process_set_id is None  # inactive, not forgotten
+
+    ctx.core.world = [0, 1, 2, 3]
+    reregister_all()
+    assert ps.ranks == [3]
+    assert ps.process_set_id is not None
+
+
+def test_weight_survives_reregistration(ctx):
+    add_process_set([1, 2], weight=4.0)
+    ctx.core.world = [0, 1]
+    reregister_all()
+    # The replayed native registration carried the QoS weight.
+    assert ctx.core.added[-1] == ([1], 4.0)
+
+
+def test_replay_preserves_registration_order(ctx):
+    a = add_process_set([0, 1])
+    b = add_process_set([2, 3], weight=2.0)
+    ctx.core.added.clear()
+    reregister_all()
+    # Deterministic psid assignment across ranks relies on identical
+    # replay order: a first, b second.
+    assert ctx.core.added == [([0, 1], 1.0), ([2, 3], 2.0)]
+    assert a.process_set_id < b.process_set_id
+
+
+def test_removed_set_is_not_replayed(ctx):
+    ps = add_process_set([1, 2])
+    remove_process_set(ps)
+    ctx.core.added.clear()
+    reregister_all()
+    assert ctx.core.added == []
+    assert ps.process_set_id is None
+
+
+def test_out_of_world_registration_rejected(ctx):
+    with pytest.raises(ValueError, match="rank 7"):
+        add_process_set([1, 7])
+
+
+def test_weight_kwarg_overrides_constructed_weight(ctx):
+    ps = ProcessSet([0, 1], weight=2.0)
+    add_process_set(ps, weight=5.0)
+    assert ps.weight == 5.0
+    assert ctx.core.added[-1] == ([0, 1], 5.0)

@@ -49,7 +49,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the checker allows conditional declaration but still verifies types.
 OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_fault_spec_check", "hvd_ctrl_plane_stats",
-                    "hvd_flight_record"}
+                    "hvd_flight_record", "hvd_add_process_set2"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
@@ -90,6 +90,10 @@ PY_DIRECT_VARS = {
     "HOROVOD_ELASTIC_FAST_FAILURE_SECS",
     "HOROVOD_ELASTIC_BLACKLIST_FAILURES",
     "HOROVOD_ELASTIC_BLACKLIST_BASE_SECS",
+    "HOROVOD_AUTOPILOT",
+    "HOROVOD_AUTOPILOT_EVICT_WINDOWS",
+    "HOROVOD_AUTOPILOT_MIN_NP",
+    "HOROVOD_AUTOPILOT_COOLDOWN_SECS",
 }
 
 # Infrastructure plumbing set by one launcher component and read by
@@ -104,6 +108,9 @@ INTERNAL_VARS = {
     "HOROVOD_PROBE_SECRET",
     "HOROVOD_TPU_METADATA_URL",
     "HOROVOD_RANK_FROM_JSRUN",
+    # Assigned per generation by the elastic driver; the coordinator's
+    # loopback policy listener binds it.  Operators never set it by hand.
+    "HOROVOD_AUTOPILOT_PORT",
 }
 
 

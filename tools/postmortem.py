@@ -12,6 +12,11 @@ to its postmortem.json).  The bundle holds:
                     on abort / fatal signal / injected death — including
                     the culprit's, whose digest could not be collected
                     (it was already dead)
+  autopilot.jsonl   the fleet autopilot's decision log (one JSON line per
+                    eviction / scale-up / re-admission, written by the
+                    elastic driver's policy thread; docs/elastic.md) —
+                    rendered so the report shows why the fleet changed
+                    shape, not just that it did
 
 The report names the culprit, shows each rank's last-seen state, and prints
 the merged causal event sequence leading into the abort.  --trace also
@@ -47,9 +52,11 @@ def _load_merge_timeline():
 
 
 def find_bundle(path: str) -> Dict[str, object]:
-    """Locate postmortem.json and any flight.<rank>.json dumps.
+    """Locate postmortem.json, flight.<rank>.json dumps, and the
+    autopilot decision log.
 
-    Returns {"postmortem": path-or-None, "flights": {rank: path}}.
+    Returns {"postmortem": path-or-None, "flights": {rank: path},
+    "autopilot": path-or-None}.
     """
     if os.path.isdir(path):
         directory = path
@@ -62,8 +69,10 @@ def find_bundle(path: str) -> Dict[str, object]:
         m = re.match(r"flight\.(\d+)\.json$", os.path.basename(f))
         if m:
             flights[int(m.group(1))] = f
+    ap = os.path.join(directory, "autopilot.jsonl")
     return {"postmortem": pm if os.path.exists(pm) else None,
-            "flights": flights}
+            "flights": flights,
+            "autopilot": ap if os.path.exists(ap) else None}
 
 
 def _fmt_event(row: List[int], types: Dict[str, str],
@@ -74,13 +83,40 @@ def _fmt_event(row: List[int], types: Dict[str, str],
     return f"{rel}seq={seq:<8} {name:<14} tid={tid} a={a} b={b}"
 
 
+def _load_autopilot(path: Optional[str]) -> List[dict]:
+    """Parse autopilot.jsonl; malformed lines are skipped, not fatal."""
+    if not path:
+        return []
+    decisions: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(row, dict):
+                    decisions.append(row)
+    except OSError:
+        return []
+    return decisions
+
+
+# Mirrors runner/autopilot.py ACT_* (and the flight type-13 `a` field).
+_AUTOPILOT_ACTIONS = {1: "evict", 2: "scale_up", 3: "readmit"}
+
+
 def report(bundle: Dict[str, object], n_events: int,
            out=sys.stdout) -> int:
     pm_path = bundle["postmortem"]
     flights: Dict[int, str] = bundle["flights"]  # type: ignore[assignment]
-    if pm_path is None and not flights:
-        print("error: no postmortem.json or flight.*.json found",
-              file=sys.stderr)
+    autopilot = _load_autopilot(bundle.get("autopilot"))  # type: ignore[arg-type]
+    if pm_path is None and not flights and not autopilot:
+        print("error: no postmortem.json, flight.*.json, or "
+              "autopilot.jsonl found", file=sys.stderr)
         return 1
 
     pm = {}
@@ -158,6 +194,18 @@ def report(bundle: Dict[str, object], n_events: int,
     for ts_us, rank, row in tail:
         print(f"  rank {rank:<3} {_fmt_event(row, types, abort_us)}",
               file=out)
+    if autopilot:
+        print(f"\nAutopilot decisions ({len(autopilot)})", file=out)
+        print("-" * 72, file=out)
+        for d in autopilot:
+            action = d.get("action")
+            name = _AUTOPILOT_ACTIONS.get(action, f"action{action}")
+            ts = d.get("ts")
+            ts_s = f"t={ts:10.3f}s " if isinstance(ts, (int, float)) else ""
+            print(f"  {ts_s}gen={d.get('generation', '?'):<3} "
+                  f"{name:<9} rank={d.get('rank', '?'):<3} "
+                  f"{d.get('detail', '')}", file=out)
+
     if pm:
         print(f"\nmissing ranks   : {sorted(missing) or 'none'}", file=out)
     return 0

@@ -31,6 +31,11 @@ combination of:
            "on" combos assert the black box recorded the workload
            (hvd.flight_record() non-empty, right rank), "off" combos that
            it reports {}; one on-combo in the quick set
+- autopilot: off / on (HOROVOD_AUTOPILOT=1) — "on" combos route through
+           the elastic driver with the fleet-autopilot policy thread
+           polling the coordinator; a healthy fleet must produce zero
+           decisions and an unchanged workload result; one on-combo in
+           the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -39,9 +44,11 @@ builds, the `chaos` fault-injection/fast-abort selftest, the np=4
 fault-injection pytest (`fault-np4`: abort bound, corrupt-tag fail-fast,
 elastic recovery under --fault-inject), the np=4 chaos-postmortem pytest
 (`postmortem-np4`: injected death -> merged postmortem.json with the right
-culprit within the abort bound), the np=256 control-plane soak
-(`ctrl-soak`: flat vs tree coordinator message counts), and the np=8
-tree-vs-flat parity pytest (`ctrl-np8`).
+culprit within the abort bound), the np=4 hands-off autopilot chaos loop
+(`autopilot-np4`: persistent injected straggle -> detect, evict, elastic
+recovery, blacklist-expiry re-admission — zero human input), the np=256
+control-plane soak (`ctrl-soak`: flat vs tree coordinator message
+counts), and the np=8 tree-vs-flat parity pytest (`ctrl-np8`).
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -251,6 +258,10 @@ def combos(quick: bool):
         # flight axis: the one quick recorder-on combo.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
                "on")
+        # autopilot axis: the one quick on-combo — elastic driver + policy
+        # thread over a healthy fleet; zero decisions, same results.
+        yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+               "def", "on")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -296,6 +307,13 @@ def combos(quick: bool):
            "on")
     yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
            "off")
+    # Autopilot axis: policy thread over a healthy fleet (no decisions),
+    # with and without the flat-TCP plane; the adversarial (straggling)
+    # path is the autopilot-np4 check row.
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+           "def", "on")
+    yield ("jax", "native", 3, "off", "off", "tcp", "none", "off", "auto",
+           "def", "on")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -353,6 +371,14 @@ def checks(quick: bool):
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_postmortem.py")]],
            REPO, 600.0)
+    # Hands-off autopilot chaos loop: one rank persistently straggles
+    # (injected delay) -> the autopilot detects, attributes, evicts, the
+    # elastic driver recovers at smaller np, and blacklist expiry
+    # re-admits the host -- asserted end to end with zero human input.
+    yield ("autopilot-np4",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_autopilot.py")]],
+           REPO, 600.0)
     # np=256 in-process control-plane soak: flat vs v9 tree coordinator
     # message counts (>= 8x cut at 256 ranks / 16 fake hosts) plus the
     # sharded rendezvous acceptors under the full HELLO herd.
@@ -384,7 +410,7 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
-              script: str, timeout: float) -> tuple:
+              autopilot: str, script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -411,6 +437,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     env.pop("HOROVOD_FLIGHT_RECORDER", None)
     env.pop("HOROVOD_FLIGHT_RECORDER_SLOTS", None)
     env.pop("HOROVOD_POSTMORTEM_DIR", None)
+    # The autopilot axis owns the policy-engine knob (and its port is
+    # per-generation driver state, never ambient).
+    env.pop("HOROVOD_AUTOPILOT", None)
+    env.pop("HOROVOD_AUTOPILOT_PORT", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -438,6 +468,11 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_FLIGHT_RECORDER"] = "1"
     elif flight == "off":
         env["HOROVOD_FLIGHT_RECORDER"] = "off"
+    if autopilot == "on":
+        # Routes the launch through the elastic driver with the policy
+        # thread attached (launch.py reads the env fallback); the driver
+        # forces HOROVOD_METRICS=1 on the workers.
+        env["HOROVOD_AUTOPILOT"] = "1"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -483,14 +518,17 @@ def main() -> int:
                 combo = combo + ("auto",)
             if len(combo) == 9:  # rows predating the flight axis
                 combo = combo + ("def",)
+            if len(combo) == 10:  # rows predating the autopilot axis
+                combo = combo + ("off",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree, flight) = combo
+             tree, flight, autopilot) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
-                     f"flight={flight}")
+                     f"flight={flight:<4} ap={autopilot}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
+                                       autopilot,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

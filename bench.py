@@ -209,7 +209,10 @@ def _bert_entry(mesh) -> dict:
     import jax.numpy as jnp
     import optax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: pre-promotion location
+        from jax.experimental.shard_map import shard_map
 
     import horovod_tpu as hvd
     from horovod_tpu import models
@@ -271,13 +274,80 @@ def _bert_entry(mesh) -> dict:
     }
 
 
+def _device_codec_entry(mesh) -> dict:
+    """Device-plane int8 ring appendix: the quantized in-jit allreduce
+    (docs/compression.md) vs the plain psum on the same fp32 payload —
+    step time for both, plus the encoded/raw wire ratio straight from the
+    device-plane byte counters (which tick at trace time)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: pre-promotion location
+        from jax.experimental.shard_map import shard_map
+
+    import horovod_tpu.ops.collectives as cl
+    import horovod_tpu.ops.quantize as qz
+    from horovod_tpu.wire import ReduceOp
+
+    n_dev = len(np.asarray(mesh.devices).reshape(-1))
+    if n_dev < 2:
+        return {"device_codec_skipped": "single device: no ring"}
+    per_dev = (1 << 16) if _tiny() else (1 << 22)  # fp32 elems per device
+    n_steps = 3 if _tiny() else 10
+
+    rng = np.random.RandomState(23)
+    x = jnp.asarray(rng.randn(n_dev, per_dev).astype(np.float32))
+
+    def q_fn(shard):
+        return cl.quantized_allreduce(shard, "hvd", op=ReduceOp.SUM,
+                                      min_bytes=4096)
+
+    def p_fn(shard):
+        return jax.lax.psum(shard, "hvd")
+
+    def timeit(fn):
+        try:  # the ppermute ring has no replication rule: turn checks off
+            sm = shard_map(fn, mesh=mesh, in_specs=P("hvd"),
+                           out_specs=P("hvd"), check_vma=False)
+        except TypeError:
+            sm = shard_map(fn, mesh=mesh, in_specs=P("hvd"),
+                           out_specs=P("hvd"), check_rep=False)
+        jitted = jax.jit(sm)
+        out = jitted(x)
+        out.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = jitted(x)
+        float(jnp.sum(out))  # host readback bounds the enqueued steps
+        return out, (time.perf_counter() - t0) / n_steps
+
+    qz.reset_device_byte_counters()
+    q_out, q_dt = timeit(q_fn)
+    raw, enc = qz.device_byte_counters()
+    p_out, p_dt = timeit(p_fn)
+    max_err = float(jnp.max(jnp.abs(q_out - p_out)))
+    return {
+        "device_codec": "int8",
+        "device_codec_wire_ratio": round(enc / max(raw, 1), 3),
+        "device_codec_step_ms": round(q_dt * 1e3, 2),
+        "device_codec_fp32_step_ms": round(p_dt * 1e3, 2),
+        "device_codec_max_abs_err": max_err,
+    }
+
+
 def _measure() -> None:
     import numpy as np
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: pre-promotion location
+        from jax.experimental.shard_map import shard_map
 
     import horovod_tpu as hvd
     from horovod_tpu import models
@@ -419,6 +489,16 @@ def _measure() -> None:
         _emit(result)
     else:
         _log(f"skipping bert entry ({remaining():.0f}s left)")
+
+    if remaining() > 60:
+        try:
+            _log("device-plane int8 codec micro-bench")
+            result.update(_device_codec_entry(mesh))
+        except Exception as exc:
+            result["device_codec_error"] = str(exc)[:200]
+        _emit(result)
+    else:
+        _log(f"skipping device codec entry ({remaining():.0f}s left)")
 
 
 # ---------------------------------------------------------------------------

@@ -65,6 +65,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_char_p, c.c_char_p, c.c_int,           # controller addr port
         c.c_double, c.c_longlong, c.c_int, c.c_int,  # cycle fusion cache autotune
         c.c_char_p, c.c_int, c.c_int,              # autotune_log hierarchical wire_comp
+        c.c_int,                                   # qdev_comp (-1 = no device plane)
         c.c_int, c.c_char_p, c.c_double,           # metrics metrics_file interval
         c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
@@ -164,6 +165,19 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.hvd_fault_spec_check.argtypes = [c.c_char_p]
     except AttributeError:
         pass
+    try:
+        # Old-ABI tolerance: a stale .so predating the device-plane int8
+        # codec loses the native byte counters (data_plane_stats() falls
+        # back to the Python-side counters) and the qdev autotune poll.
+        lib.hvd_device_plane_note.restype = None
+        lib.hvd_device_plane_note.argtypes = [c.c_longlong, c.c_longlong]
+        lib.hvd_device_plane_stats.restype = None
+        lib.hvd_device_plane_stats.argtypes = [
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+        lib.hvd_autotune_qdev.restype = c.c_int
+        lib.hvd_autotune_qdev.argtypes = []
+    except AttributeError:
+        pass
 
 
 class NativeCoreError(RuntimeError):
@@ -209,6 +223,14 @@ class NativeCore(CoreBackend):
         controller = cfg.controller
         if controller in ("auto",):
             controller = "socket" if cfg.size > 1 else "local"
+        # Device-plane codec: 0=none, 1=int8 from config; -1 pins the
+        # autotuner's qdev arm when no jax device plane can exist here.
+        qdev = {"none": 0, "int8": 1}.get(
+            getattr(cfg, "wire_compression_device", "none"), 0)
+        try:
+            import jax  # noqa: F401
+        except Exception:
+            qdev = -1
         rc = self._lib.hvd_init(
             cfg.rank, cfg.size, cfg.local_rank, cfg.local_size,
             controller.encode(), cfg.rendezvous_addr.encode(),
@@ -218,6 +240,7 @@ class NativeCore(CoreBackend):
             (cfg.autotune_log or "").encode(),
             1 if cfg.hierarchical_allreduce else 0,
             {"none": 0, "bf16": 1, "int8": 2}.get(cfg.wire_compression, 0),
+            qdev,
             1 if cfg.metrics_enabled else 0,
             (cfg.metrics_file or "").encode(),
             cfg.metrics_interval_s,
@@ -235,6 +258,17 @@ class NativeCore(CoreBackend):
             raise NativeCoreError(
                 f"native core init failed (rc={rc}, control protocol "
                 f"v{PROTOCOL_VERSION}): {self._last_error()}")
+        if qdev >= 0 and hasattr(self._lib, "hvd_device_plane_note"):
+            # Mirror quantized-collective byte deltas into the native
+            # metrics registry (hvd.metrics() / Prometheus exposure).
+            try:
+                from .ops import quantize as _qz
+            except Exception:
+                pass
+            else:
+                note = self._lib.hvd_device_plane_note
+                _qz.set_native_byte_sink(
+                    lambda raw, enc: note(int(raw), int(enc)))
 
     def shutdown(self) -> None:
         if self._lib.hvd_is_initialized():
@@ -473,7 +507,9 @@ class NativeCore(CoreBackend):
         locality: to ranks on this host vs. across hosts.  The hierarchical
         allreduce's measurable effect is a shrinking cross-host share; wire
         compression's is wire bytes dropping below the raw (pre-codec)
-        bytes, which the data_raw_* counters track."""
+        bytes, which the data_raw_* counters track.  device_raw /
+        device_encoded are the analogous pair for the device plane's
+        quantized in-jit ring (HOROVOD_WIRE_COMPRESSION=device=int8)."""
         local = ctypes.c_longlong()
         xhost = ctypes.c_longlong()
         raw_local = ctypes.c_longlong()
@@ -481,10 +517,26 @@ class NativeCore(CoreBackend):
         self._lib.hvd_data_plane_stats2(
             ctypes.byref(local), ctypes.byref(xhost),
             ctypes.byref(raw_local), ctypes.byref(raw_xhost))
+        dev_raw = dev_enc = 0
+        if hasattr(self._lib, "hvd_device_plane_stats"):
+            a = ctypes.c_longlong()
+            b = ctypes.c_longlong()
+            self._lib.hvd_device_plane_stats(ctypes.byref(a), ctypes.byref(b))
+            dev_raw, dev_enc = a.value, b.value
+        else:
+            # Stale .so: the Python-side counters hold the same totals
+            # (the native registry only ever sees forwarded deltas).
+            try:
+                from .ops import quantize as _qz
+                dev_raw, dev_enc = _qz.device_byte_counters()
+            except Exception:
+                pass
         return {"data_sent_local": local.value,
                 "data_sent_xhost": xhost.value,
                 "data_raw_local": raw_local.value,
-                "data_raw_xhost": raw_xhost.value}
+                "data_raw_xhost": raw_xhost.value,
+                "device_raw": dev_raw,
+                "device_encoded": dev_enc}
 
     _warned_no_metrics = False
 

@@ -36,18 +36,19 @@ double Surface(double x0, double x1, unsigned* rng) {
 int main() {
   {
     BayesianOptimizer bo;
-    // With the hierarchical and wire-compression knobs pinned (no
-    // multi-host topology), the EI search must not waste probes on the
-    // dead arms.
+    // With the hierarchical, wire-compression, and device-codec knobs
+    // pinned (no multi-host topology, no device plane), the EI search
+    // must not waste probes on the dead arms.
     bo.set_tune_x3(false);
     bo.set_tune_x4(false);
+    bo.set_tune_x5(false);
     unsigned rng = 12345;
     // First probe: a deliberately bad corner (tiny fusion, huge cycle).
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
     double first_score = Surface(x0, x1, &rng);
-    bo.AddSample(x0, x1, x2, x3, x4, first_score);
+    bo.AddSample(x0, x1, x2, x3, x4, x5, first_score);
     for (int round = 0; round < 30; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
       if (x3 >= 0.5) {
         std::printf("FAIL: pinned x3 knob was explored\n");
         return 1;
@@ -56,10 +57,14 @@ int main() {
         std::printf("FAIL: pinned x4 knob was explored\n");
         return 1;
       }
-      bo.AddSample(x0, x1, x2, x3, x4, Surface(x0, x1, &rng));
+      if (x5 >= 0.5) {
+        std::printf("FAIL: pinned x5 knob was explored\n");
+        return 1;
+      }
+      bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
     }
-    double bx0, bx1, bx2, bx3, bx4, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
     std::printf("first=%.3e best=%.3e at (%.2f, %.2f, %.0f)\n", first_score,
                 best, bx0, bx1, bx2);
     // The optimum value is ~1e9; the bad corner scores ~0.  Require the
@@ -81,16 +86,17 @@ int main() {
     BayesianOptimizer bo;
     bo.set_tune_x3(false);
     bo.set_tune_x4(false);
+    bo.set_tune_x5(false);
     unsigned rng = 777;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
     for (int round = 0; round < 30; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
       double s = Surface(x0, x1, &rng) * (x2 >= 0.5 ? 1.25 : 1.0);
-      bo.AddSample(x0, x1, x2, x3, x4, s);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, s);
     }
-    double bx0, bx1, bx2, bx3, bx4, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
     std::printf("categorical best=%.3e at (%.2f, %.2f, cat=%.0f)\n", best,
                 bx0, bx1, bx2);
     if (bx2 < 0.5) {
@@ -109,16 +115,17 @@ int main() {
     // With the knob tunable, the optimizer must converge onto it.
     BayesianOptimizer bo;
     bo.set_tune_x4(false);
+    bo.set_tune_x5(false);
     unsigned rng = 4242;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
     for (int round = 0; round < 40; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
       double s = Surface(x0, x1, &rng) * (x3 >= 0.5 ? 1.3 : 1.0);
-      bo.AddSample(x0, x1, x2, x3, x4, s);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, s);
     }
-    double bx0, bx1, bx2, bx3, bx4, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
     std::printf("hier best=%.3e at (%.2f, %.2f, cat=%.0f, hier=%.0f)\n",
                 best, bx0, bx1, bx2, bx3);
     if (bx3 < 0.5) {
@@ -137,16 +144,17 @@ int main() {
     // cost on this synthetic surface.  The optimizer must find the
     // interior level, which a binary knob could not express.
     BayesianOptimizer bo;
+    bo.set_tune_x5(false);
     unsigned rng = 31337;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
     for (int round = 0; round < 40; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
       double mult = x4 < 0.25 ? 1.0 : (x4 < 0.75 ? 1.35 : 1.15);
-      bo.AddSample(x0, x1, x2, x3, x4, Surface(x0, x1, &rng) * mult);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng) * mult);
     }
-    double bx0, bx1, bx2, bx3, bx4, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
     std::printf("wire best=%.3e at (%.2f, %.2f, wire=%.2f)\n", best, bx0,
                 bx1, bx4);
     if (bx4 < 0.25 || bx4 >= 0.75) {
@@ -155,6 +163,35 @@ int main() {
     }
     if (best < 0.8 * 1.35e9) {
       std::printf("FAIL: wire surface peak not approached\n");
+      return 1;
+    }
+  }
+  {
+    // Device-codec arm: the x5=1 arm (int8 device-plane ring — quarter
+    // the ICI bytes on bandwidth-bound steps) scores 20% higher
+    // everywhere.  With the knob tunable, the optimizer must converge
+    // onto it.
+    BayesianOptimizer bo;
+    bo.set_tune_x3(false);
+    bo.set_tune_x4(false);
+    unsigned rng = 90210;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
+    for (int round = 0; round < 40; ++round) {
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
+      double s = Surface(x0, x1, &rng) * (x5 >= 0.5 ? 1.2 : 1.0);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, s);
+    }
+    double bx0, bx1, bx2, bx3, bx4, bx5, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
+    std::printf("qdev best=%.3e at (%.2f, %.2f, qdev=%.0f)\n", best, bx0,
+                bx1, bx5);
+    if (bx5 < 0.5) {
+      std::printf("FAIL: qdev knob did not converge to the better arm\n");
+      return 1;
+    }
+    if (best < 0.8 * 1.2e9) {
+      std::printf("FAIL: qdev surface peak not approached\n");
       return 1;
     }
   }

@@ -173,6 +173,11 @@ struct CoreConfig {
   // HOROVOD_WIRE_COMPRESSION: codec for cross-host ring hops (0=none,
   // 1=bf16, 2=int8).  Coordinator-authoritative like `hierarchical`.
   int wire_compression = 0;
+  // HOROVOD_WIRE_COMPRESSION device= plane: codec for in-jit / eager-XLA
+  // device collectives (0=none, 1=int8; -1 = no device plane, autotune
+  // arm pinned).  Enforced on the Python side; stored here so the
+  // autotuner's qdev coordinate starts from the configured value.
+  int qdev_compression = 0;
   // HOROVOD_METRICS / HOROVOD_METRICS_FILE: enable the native metrics
   // registry; when metrics_file is non-empty the background loop writes a
   // JSON snapshot there every metrics_interval_s (a `{rank}` placeholder

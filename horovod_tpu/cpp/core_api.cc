@@ -346,7 +346,8 @@ void BackgroundLoop() {
       HVD_LOG(DEBUG) << "autotune: fusion=" << fusion << " cycle_ms=" << cycle
                      << " announce_cache=" << g->params.announce_cache()
                      << " hierarchical=" << g->params.hierarchical()
-                     << " wire_compression=" << g->params.wire_compression();
+                     << " wire_compression=" << g->params.wire_compression()
+                     << " qdev=" << g->params.qdev();
     }
 
     double now = MonotonicSeconds();
@@ -421,6 +422,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              const char* controller, const char* addr, int port,
              double cycle_ms, long long fusion, int cache_cap, int autotune,
              const char* autotune_log, int hierarchical, int wire_compression,
+             int qdev_compression,
              int metrics_enabled, const char* metrics_file,
              double metrics_interval_s, const char* timeline_path,
              int timeline_mark_cycles, double stall_warn_s,
@@ -446,6 +448,11 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.hierarchical = hierarchical != 0;
   cfg.wire_compression =
       wire_compression >= 0 && wire_compression <= 2 ? wire_compression : 0;
+  // Device-plane codec (0=none, 1=int8).  -1 means the caller has no
+  // device plane at all (no jax mesh): the knob is then pinned for the
+  // autotuner, not merely off.
+  cfg.qdev_compression =
+      qdev_compression >= -1 && qdev_compression <= 1 ? qdev_compression : 0;
   cfg.metrics_file = metrics_file ? metrics_file : "";
   cfg.metrics = metrics_enabled != 0 || !cfg.metrics_file.empty();
   cfg.metrics_interval_s = metrics_interval_s > 0 ? metrics_interval_s : 10.0;
@@ -542,9 +549,14 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // hop actually crosses hosts (the leader ring, or an all-cross-host
     // flat ring).
     bool wire_tunable = sc != nullptr && sc->WireCompAvailable();
+    // Device-plane codec coordinate: tunable only when the Python side
+    // reported a usable device plane (qdev >= 0); -1 pins the arm.
+    bool qdev_tunable = cfg.qdev_compression >= 0;
+    int qdev_comp = cfg.qdev_compression >= 0 ? cfg.qdev_compression : 0;
     g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log,
                          cfg.hierarchical, hier_tunable,
-                         cfg.wire_compression, wire_tunable);
+                         cfg.wire_compression, wire_tunable,
+                         qdev_comp, qdev_tunable);
   }
   g->background = std::thread(BackgroundLoop);
   return 0;
@@ -857,6 +869,38 @@ void hvd_data_plane_stats2(long long* local, long long* xhost,
   *xhost = x;
   *raw_local = rl;
   *raw_xhost = rx;
+}
+
+// Device-plane (in-jit / eager-XLA) quantized-collective byte accounting.
+// The Python side calls note() once per quantized dispatch with the raw
+// fp32 ring bytes the collective would have moved and the int8-encoded
+// bytes it did move; stats() reads both back.  raw/encoded is the
+// measured device-codec ratio (uncompressed device collectives report
+// nothing — XLA moves those bytes without telling us).
+void hvd_device_plane_note(long long raw_bytes, long long encoded_bytes) {
+  auto& m = GlobalMetrics();
+  if (raw_bytes > 0) {
+    m.device_raw_bytes.fetch_add(raw_bytes, std::memory_order_relaxed);
+  }
+  if (encoded_bytes > 0) {
+    m.device_encoded_bytes.fetch_add(encoded_bytes,
+                                     std::memory_order_relaxed);
+  }
+}
+
+void hvd_device_plane_stats(long long* raw_bytes, long long* encoded_bytes) {
+  auto& m = GlobalMetrics();
+  *raw_bytes = m.device_raw_bytes.load(std::memory_order_relaxed);
+  *encoded_bytes = m.device_encoded_bytes.load(std::memory_order_relaxed);
+}
+
+// The autotuner's current device-plane codec decision (0=none, 1=int8;
+// -1 = not initialized).  The Python side polls it between steps and
+// re-traces with the int8 ring when it flips — the device plane's analog
+// of SetWireCompression on the host ring.
+int hvd_autotune_qdev() {
+  if (g == nullptr) return -1;
+  return g->params.qdev();
 }
 
 // Full local metrics registry as one JSON object; on the coordinator the

@@ -58,6 +58,8 @@ void MetricsRegistry::Reset() {
   aborts_total.store(0, std::memory_order_relaxed);
   faults_injected_total.store(0, std::memory_order_relaxed);
   autopilot_decisions_total.store(0, std::memory_order_relaxed);
+  device_raw_bytes.store(0, std::memory_order_relaxed);
+  device_encoded_bytes.store(0, std::memory_order_relaxed);
   ctrl_msgs_sent.store(0, std::memory_order_relaxed);
   ctrl_msgs_recv.store(0, std::memory_order_relaxed);
   ctrl_bytes_sent.store(0, std::memory_order_relaxed);
@@ -96,6 +98,10 @@ std::string MetricsRegistry::DumpJson(int rank,
      << faults_injected_total.load(std::memory_order_relaxed)
      << ",\"autopilot_decisions_total\":"
      << autopilot_decisions_total.load(std::memory_order_relaxed)
+     << ",\"device_raw_bytes\":"
+     << device_raw_bytes.load(std::memory_order_relaxed)
+     << ",\"device_encoded_bytes\":"
+     << device_encoded_bytes.load(std::memory_order_relaxed)
      << ",\"ctrl_msgs_sent\":"
      << ctrl_msgs_sent.load(std::memory_order_relaxed)
      << ",\"ctrl_msgs_recv\":"

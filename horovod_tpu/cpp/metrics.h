@@ -83,6 +83,15 @@ struct MetricsRegistry {
   // channel (evict / scale / readmit), regardless of driver outcome.
   std::atomic<int64_t> autopilot_decisions_total{0};
 
+  // Device-plane (in-jit / eager-XLA) collective payload accounting,
+  // reported by the Python side per quantized dispatch: raw fp32 ring
+  // bytes the collective WOULD have moved vs the int8 block-scaled bytes
+  // it did move.  Uncompressed device collectives report nothing (XLA
+  // moves those bytes without telling us), so the pair measures the
+  // codec's ratio, not total device traffic.
+  std::atomic<int64_t> device_raw_bytes{0};
+  std::atomic<int64_t> device_encoded_bytes{0};
+
   // Control-plane traffic (protocol v9): negotiation frames and payload
   // bytes moved on this rank's ctrl links.  On the coordinator,
   // ctrl_msgs_recv per cycle is the leader-tree acceptance metric —

@@ -59,12 +59,14 @@ double WireToX4(int wire) {
   return kWireLevels[std::min(2, std::max(0, wire))];
 }
 
-double Rbf(double ax, double ay, double az, double aw, double av, double bx,
-           double by, double bz, double bw, double bv) {
+double Rbf(double ax, double ay, double az, double aw, double av, double au,
+           double bx, double by, double bz, double bw, double bv, double bu) {
   double dx = ax - bx, dy = ay - by, dz = kCatScale * (az - bz),
-         dw = kCatScale * (aw - bw), dv = kCatScale * (av - bv);
-  return std::exp(-(dx * dx + dy * dy + dz * dz + dw * dw + dv * dv) /
-                  (2 * kLengthscale * kLengthscale));
+         dw = kCatScale * (aw - bw), dv = kCatScale * (av - bv),
+         du = kCatScale * (au - bu);
+  return std::exp(
+      -(dx * dx + dy * dy + dz * dz + dw * dw + dv * dv + du * du) /
+      (2 * kLengthscale * kLengthscale));
 }
 
 // Standard normal pdf/cdf for Expected Improvement.
@@ -78,8 +80,8 @@ double phi(double z) {
 // ---- BayesianOptimizer -----------------------------------------------------
 
 void BayesianOptimizer::AddSample(double x0, double x1, double x2, double x3,
-                                  double x4, double score) {
-  xs_.push_back({x0, x1, x2, x3, x4});
+                                  double x4, double x5, double score) {
+  xs_.push_back({x0, x1, x2, x3, x4, x5});
   ys_.push_back(score);
   y_max_ = std::max(y_max_, std::abs(score));
   FitGP();
@@ -94,7 +96,8 @@ void BayesianOptimizer::FitGP() {
   for (int i = 0; i < n; ++i) {
     for (int j = 0; j <= i; ++j) {
       double k = Rbf(xs_[i].x0, xs_[i].x1, xs_[i].x2, xs_[i].x3, xs_[i].x4,
-                     xs_[j].x0, xs_[j].x1, xs_[j].x2, xs_[j].x3, xs_[j].x4);
+                     xs_[i].x5, xs_[j].x0, xs_[j].x1, xs_[j].x2, xs_[j].x3,
+                     xs_[j].x4, xs_[j].x5);
       if (i == j) k += kNoise;
       chol_[i * n + j] = k;
     }
@@ -125,7 +128,8 @@ void BayesianOptimizer::FitGP() {
 }
 
 void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
-                                double x4, double* mean, double* var) const {
+                                double x4, double x5, double* mean,
+                                double* var) const {
   const int n = static_cast<int>(xs_.size());
   if (n == 0) {
     *mean = 0;
@@ -134,8 +138,8 @@ void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
   }
   std::vector<double> kstar(n);
   for (int i = 0; i < n; ++i) {
-    kstar[i] = Rbf(x0, x1, x2, x3, x4, xs_[i].x0, xs_[i].x1, xs_[i].x2,
-                   xs_[i].x3, xs_[i].x4);
+    kstar[i] = Rbf(x0, x1, x2, x3, x4, x5, xs_[i].x0, xs_[i].x1, xs_[i].x2,
+                   xs_[i].x3, xs_[i].x4, xs_[i].x5);
   }
   double m = 0;
   for (int i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
@@ -153,14 +157,15 @@ void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
 }
 
 void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
-                                double* x3, double* x4) {
+                                double* x3, double* x4, double* x5) {
   // Seed phase: spread the first probes over the categories before
   // trusting the GP (the reference warms its GP with a fixed design too).
-  // When x3/x4 are pinned, their seed columns collapse to 0 so no probe
-  // is wasted on a dead arm.
-  static const double kSeeds[][5] = {
-      {0.15, 0.15, 0, 0, 0},   {0.85, 0.15, 1, 1, 1}, {0.5, 0.5, 0, 1, 0.5},
-      {0.5, 0.5, 1, 0, 1},     {0.15, 0.85, 0, 1, 0.5}, {0.85, 0.85, 1, 0, 0}};
+  // When x3/x4/x5 are pinned, their seed columns collapse to 0 so no
+  // probe is wasted on a dead arm.
+  static const double kSeeds[][6] = {
+      {0.15, 0.15, 0, 0, 0, 0},    {0.85, 0.15, 1, 1, 1, 1},
+      {0.5, 0.5, 0, 1, 0.5, 0},    {0.5, 0.5, 1, 0, 1, 1},
+      {0.15, 0.85, 0, 1, 0.5, 1},  {0.85, 0.85, 1, 0, 0, 0}};
   const int n = num_samples();
   if (n < 6) {
     *x0 = kSeeds[n][0];
@@ -168,37 +173,44 @@ void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
     *x2 = kSeeds[n][2];
     *x3 = tune_x3_ ? kSeeds[n][3] : 0.0;
     *x4 = tune_x4_ ? kSeeds[n][4] : 0.0;
+    *x5 = tune_x5_ ? kSeeds[n][5] : 0.0;
     return;
   }
   const double denom = y_max_ > 0 ? y_max_ : 1.0;
   double best_y = *std::max_element(ys_.begin(), ys_.end()) / denom;
-  double best_ei = -1, bx = 0.5, by = 0.5, bz = 1.0, bw = 0.0, bv = 0.0;
+  double best_ei = -1, bx = 0.5, by = 0.5, bz = 1.0, bw = 0.0, bv = 0.0,
+         bu = 0.0;
   const int cat3_max = tune_x3_ ? 1 : 0;
   const int cat4_max = tune_x4_ ? 2 : 0;
-  for (int cat4 = 0; cat4 <= cat4_max; ++cat4) {
-    for (int cat3 = 0; cat3 <= cat3_max; ++cat3) {
-      for (int cat = 0; cat <= 1; ++cat) {
-        for (int i = 0; i <= kGrid; ++i) {
-          for (int j = 0; j <= kGrid; ++j) {
-            // Deterministic jitter decorrelates the grid across rounds.
-            rng_ = rng_ * 1664525u + 1013904223u;
-            double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-            rng_ = rng_ * 1664525u + 1013904223u;
-            double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-            double cx = std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
-            double cy = std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
-            double mean, var;
-            Predict(cx, cy, cat, cat3, kWireLevels[cat4], &mean, &var);
-            double sd = std::sqrt(var);
-            double z = (mean - best_y - 0.01) / sd;
-            double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
-            if (ei > best_ei) {
-              best_ei = ei;
-              bx = cx;
-              by = cy;
-              bz = cat;
-              bw = cat3;
-              bv = kWireLevels[cat4];
+  const int cat5_max = tune_x5_ ? 1 : 0;
+  for (int cat5 = 0; cat5 <= cat5_max; ++cat5) {
+    for (int cat4 = 0; cat4 <= cat4_max; ++cat4) {
+      for (int cat3 = 0; cat3 <= cat3_max; ++cat3) {
+        for (int cat = 0; cat <= 1; ++cat) {
+          for (int i = 0; i <= kGrid; ++i) {
+            for (int j = 0; j <= kGrid; ++j) {
+              // Deterministic jitter decorrelates the grid across rounds.
+              rng_ = rng_ * 1664525u + 1013904223u;
+              double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+              rng_ = rng_ * 1664525u + 1013904223u;
+              double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+              double cx = std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
+              double cy = std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
+              double mean, var;
+              Predict(cx, cy, cat, cat3, kWireLevels[cat4], cat5, &mean,
+                      &var);
+              double sd = std::sqrt(var);
+              double z = (mean - best_y - 0.01) / sd;
+              double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
+              if (ei > best_ei) {
+                best_ei = ei;
+                bx = cx;
+                by = cy;
+                bz = cat;
+                bw = cat3;
+                bv = kWireLevels[cat4];
+                bu = cat5;
+              }
             }
           }
         }
@@ -210,15 +222,17 @@ void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
   *x2 = bz;
   *x3 = bw;
   *x4 = bv;
+  *x5 = bu;
 }
 
 void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
-                             double* x4, double* score) const {
+                             double* x4, double* x5, double* score) const {
   if (ys_.empty()) {
     *x0 = *x1 = 0.5;
     *x2 = 1.0;
     *x3 = 0.0;
     *x4 = 0.0;
+    *x5 = 0.0;
     *score = 0;
     return;
   }
@@ -228,6 +242,7 @@ void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
   *x2 = xs_[i].x2;
   *x3 = xs_[i].x3;
   *x4 = xs_[i].x4;
+  *x5 = xs_[i].x5;
   *score = ys_[i];
 }
 
@@ -237,7 +252,8 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
                                   double cycle_time_ms,
                                   const std::string& log_path,
                                   bool hierarchical, bool hier_tunable,
-                                  int wire_comp, bool wire_tunable) {
+                                  int wire_comp, bool wire_tunable,
+                                  int qdev_comp, bool qdev_tunable) {
   fusion_ = best_fusion_ = fusion_threshold;
   cycle_ms_ = best_cycle_ = cycle_time_ms;
   hier_tunable_ = hier_tunable;
@@ -246,13 +262,16 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
   wire_tunable_ = wire_tunable;
   wire_use_ = best_wire_ = wire_tunable ? wire_comp : 0;
   bo_.set_tune_x4(wire_tunable);
+  qdev_tunable_ = qdev_tunable;
+  qdev_use_ = best_qdev_ = qdev_tunable ? (qdev_comp != 0 ? 1 : 0) : 0;
+  bo_.set_tune_x5(qdev_tunable);
   window_start_ = MonotonicSeconds();
   active_ = true;
   if (!log_path.empty()) {
     log_ = std::fopen(log_path.c_str(), "w");
     if (log_) {
       std::fputs(
-          "time_s,fusion_bytes,cycle_ms,cache_use,hier,wire_comp,"
+          "time_s,fusion_bytes,cycle_ms,cache_use,hier,wire_comp,qdev,"
           "score_bytes_per_s\n",
           log_);
     }
@@ -267,9 +286,10 @@ void ParameterManager::RecordBytes(int64_t bytes) { bytes_ += bytes; }
 
 void ParameterManager::Log(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%d,%d,%.1f\n", MonotonicSeconds(),
+  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%d,%d,%d,%.1f\n", MonotonicSeconds(),
                static_cast<long long>(fusion_), cycle_ms_,
-               cache_use_ ? 1 : 0, hier_use_ ? 1 : 0, wire_use_, score);
+               cache_use_ ? 1 : 0, hier_use_ ? 1 : 0, wire_use_, qdev_use_,
+               score);
   std::fflush(log_);
 }
 
@@ -283,7 +303,7 @@ void ParameterManager::Score(double score) {
   }
   bo_.AddSample(FusionToX(fusion_), CycleToX(cycle_ms_),
                 cache_use_ ? 1.0 : 0.0, hier_use_ ? 1.0 : 0.0,
-                WireToX4(wire_use_), score);
+                WireToX4(wire_use_), qdev_use_ ? 1.0 : 0.0, score);
   if (score > best_score_ * 1.02) {
     windows_since_best_ = 0;
   } else {
@@ -296,6 +316,7 @@ void ParameterManager::Score(double score) {
     best_cache_ = cache_use_;
     best_hier_ = hier_use_;
     best_wire_ = wire_use_;
+    best_qdev_ = qdev_use_;
   }
   // Converge (reference: ParameterManager stops tuning once samples stop
   // improving): lock in the best configuration instead of exploring
@@ -309,20 +330,23 @@ void ParameterManager::Score(double score) {
     cache_use_ = best_cache_;
     hier_use_ = best_hier_;
     wire_use_ = best_wire_;
+    qdev_use_ = best_qdev_;
     HVD_LOG(INFO) << "autotune converged: fusion=" << fusion_
                   << " cycle_ms=" << cycle_ms_
                   << " announce_cache=" << (cache_use_ ? 1 : 0)
                   << " hierarchical=" << (hier_use_ ? 1 : 0)
-                  << " wire_compression=" << wire_use_;
+                  << " wire_compression=" << wire_use_
+                  << " qdev=" << qdev_use_;
     return;
   }
-  double x0, x1, x2, x3, x4;
-  bo_.Suggest(&x0, &x1, &x2, &x3, &x4);
+  double x0, x1, x2, x3, x4, x5;
+  bo_.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
   fusion_ = XToFusion(x0);
   cycle_ms_ = XToCycle(x1);
   cache_use_ = x2 >= 0.5;
   hier_use_ = hier_tunable_ && x3 >= 0.5;
   wire_use_ = wire_tunable_ ? X4ToWire(x4) : 0;
+  qdev_use_ = qdev_tunable_ && x5 >= 0.5 ? 1 : 0;
 }
 
 bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
@@ -337,15 +361,17 @@ bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
   bool old_cache = cache_use_;
   bool old_hier = hier_use_;
   int old_wire = wire_use_;
+  int old_qdev = qdev_use_;
   Score(score);
   *fusion_threshold = fusion_;
   *cycle_time_ms = cycle_ms_;
-  // cache_use_/hier_use_/wire_use_ participate: a categorical-only
-  // proposal must still be applied by the caller, or the next window's GP
-  // sample would be labeled with a setting that was never in effect.
+  // cache_use_/hier_use_/wire_use_/qdev_use_ participate: a categorical-
+  // only proposal must still be applied by the caller, or the next
+  // window's GP sample would be labeled with a setting that was never in
+  // effect.
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
          cache_use_ != old_cache || hier_use_ != old_hier ||
-         wire_use_ != old_wire;
+         wire_use_ != old_wire || qdev_use_ != old_qdev;
 }
 
 }  // namespace hvdtpu

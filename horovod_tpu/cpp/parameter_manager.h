@@ -19,40 +19,44 @@
 namespace hvdtpu {
 
 // Gaussian-process regression + Expected Improvement over two continuous
-// knobs on the unit square plus three CATEGORICAL knobs (reference:
+// knobs on the unit square plus four CATEGORICAL knobs (reference:
 // ParameterManager also tunes categorical flags like cache/hierarchical
 // allreduce — categorical coordinates in the same GP are the cheap
 // TPU-native form; x2 = announce-cache {0,1}, x3 = hierarchical allreduce
-// {0,1}, x4 = wire compression {0, 0.5, 1} for {none, bf16, int8}).
+// {0,1}, x4 = wire compression {0, 0.5, 1} for {none, bf16, int8},
+// x5 = device-plane int8 codec {0,1}).
 // Exposed for the synthetic-surface self-test (autotune_selftest.cc).
 class BayesianOptimizer {
  public:
-  // Observations are (x in [0,1]^2, x2/x3 in {0,1}, x4 in {0,0.5,1},
+  // Observations are (x in [0,1]^2, x2/x3/x5 in {0,1}, x4 in {0,0.5,1},
   // score); scores are internally max-normalized so the kernel scales
   // stay dimensionless.
   void AddSample(double x0, double x1, double x2, double x3, double x4,
-                 double score);
+                 double x5, double score);
   // Next point to try: argmax EI over a jittered grid x the categorical
   // levels.  Falls back to latin-square-ish seed points for the first few
   // calls.
-  void Suggest(double* x0, double* x1, double* x2, double* x3, double* x4);
+  void Suggest(double* x0, double* x1, double* x2, double* x3, double* x4,
+               double* x5);
   // Best observed sample.
   void Best(double* x0, double* x1, double* x2, double* x3, double* x4,
-            double* score) const;
+            double* x5, double* score) const;
   int num_samples() const { return static_cast<int>(xs_.size()); }
   // When the x3 knob cannot take effect (topology not hierarchical), pin
   // it to 0 so the EI search does not waste half its grid on a dead arm.
   void set_tune_x3(bool v) { tune_x3_ = v; }
   // Same pinning rule for x4 (wire compression: no all-cross-host ring).
   void set_tune_x4(bool v) { tune_x4_ = v; }
+  // Same pinning rule for x5 (device-plane codec: no usable device plane).
+  void set_tune_x5(bool v) { tune_x5_ = v; }
 
  private:
   void FitGP();
   void Predict(double x0, double x1, double x2, double x3, double x4,
-               double* mean, double* var) const;
+               double x5, double* mean, double* var) const;
 
   struct Pt {
-    double x0, x1, x2, x3, x4;
+    double x0, x1, x2, x3, x4, x5;
   };
   std::vector<Pt> xs_;
   std::vector<double> ys_;      // raw scores
@@ -62,6 +66,7 @@ class BayesianOptimizer {
   unsigned rng_ = 0x9e3779b9u;
   bool tune_x3_ = true;
   bool tune_x4_ = true;
+  bool tune_x5_ = true;
 };
 
 class ParameterManager {
@@ -71,11 +76,14 @@ class ParameterManager {
   // hierarchical topology exists); when false the knob is pinned off and
   // the GP never explores that arm.  wire_comp / wire_tunable: same pair
   // for the wire-compression codec (0=none, 1=bf16, 2=int8), pinned when
-  // no all-cross-host ring exists.
+  // no all-cross-host ring exists.  qdev_comp / qdev_tunable: same pair
+  // for the device-plane int8 codec (0=none, 1=int8), pinned when the
+  // process has no usable jax device plane.
   void Initialize(int64_t fusion_threshold, double cycle_time_ms,
                   const std::string& log_path, bool hierarchical = false,
                   bool hier_tunable = false, int wire_comp = 0,
-                  bool wire_tunable = false);
+                  bool wire_tunable = false, int qdev_comp = 0,
+                  bool qdev_tunable = false);
   ~ParameterManager();
 
   // Record bytes covered by emitted responses.
@@ -102,6 +110,11 @@ class ParameterManager {
   // (0=none, 1=bf16, 2=int8 — hvdtpu::WireCodec).  Coordinator-only for
   // the same reason as hierarchical().
   int wire_compression() const { return wire_use_; }
+  // Categorical knob: device-plane int8 codec (0=none, 1=int8).  The
+  // Python side polls it and flips the in-jit/eager quantized ring on the
+  // next trace; per-rank consistent because config (and therefore the
+  // tunable bit) is rank-uniform.
+  int qdev() const { return qdev_use_; }
 
  private:
   void Score(double score);
@@ -119,12 +132,15 @@ class ParameterManager {
   bool hier_tunable_ = false;
   int wire_use_ = 0;
   bool wire_tunable_ = false;
+  int qdev_use_ = 0;
+  bool qdev_tunable_ = false;
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
   bool best_cache_ = true;
   bool best_hier_ = false;
   int best_wire_ = 0;
+  int best_qdev_ = 0;
   int warmup_windows_ = 1;
   int windows_since_best_ = 0;
   bool converged_ = false;

@@ -35,7 +35,10 @@ enum class WireCodec : int32_t {
   kInt8 = 2,
 };
 
-// int8 block geometry: one fp32 scale per 256 elements.
+// int8 block geometry: one fp32 scale per 256 elements.  Mirrored as
+// traced math by horovod_tpu/ops/quantize.py (WIRE_BLOCK /
+// WIRE_SCALE_BYTES / WIRE_CODEC_IDS) for the device-plane quantized ring;
+// tools/hvd_lint.py enforces the two stay in sync.
 constexpr int64_t kWireBlock = 256;
 constexpr int64_t kWireScaleBytes = 4;
 

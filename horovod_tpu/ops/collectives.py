@@ -27,7 +27,10 @@ AxisName = Union[str, Sequence[str]]
 
 
 def axis_size(axis_name: AxisName) -> int:
-    return lax.axis_size(axis_name)
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:  # jax < 0.5: psum of a literal 1 is the idiom
+        return lax.psum(1, axis_name)
 
 
 def _axes_tuple(axis_name: AxisName):
@@ -75,7 +78,7 @@ class _Subset:
                 "process_set collectives run over a single mesh axis; got "
                 f"axis_name={axis_name!r}")
         self.axis = axis_name
-        self.n = lax.axis_size(axis_name)
+        self.n = axis_size(axis_name)
         self.members = sorted(set(int(r) for r in member_ranks))
         if not self.members:
             raise ValueError("process set has no members")
@@ -112,6 +115,21 @@ def allreduce(x, axis_name: AxisName, op: ReduceOp = ReduceOp.AVERAGE,
         # UNCHANGED (the documented subset semantics).
         return _subset_allreduce(x, axis_name, op, member_ranks,
                                  prescale_factor, postscale_factor)
+    if (op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+            and prescale_factor == 1.0 and postscale_factor == 1.0):
+        # Device-plane codec auto-dispatch (HOROVOD_WIRE_COMPRESSION
+        # device=int8): eligible fp32 payloads ride the int8 block-scaled
+        # ring; everything else falls through bit-identically.  No
+        # recursion: quantized_allreduce only calls back here when the
+        # same eligibility test fails.
+        codec, min_bytes = _device_codec_defaults()
+        if codec == "int8":
+            axes = ((axis_name,) if isinstance(axis_name, str)
+                    else tuple(axis_name))
+            if len(axes) == 1 and quantized_allreduce_eligible(
+                    x, axis_size(axes[0]), min_bytes):
+                return quantized_allreduce(x, axes[0], op=op,
+                                           min_bytes=min_bytes)
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     if op == ReduceOp.AVERAGE:
@@ -313,7 +331,7 @@ def reducescatter(x, axis_name: AxisName, op: ReduceOp = ReduceOp.SUM,
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
     if op == ReduceOp.AVERAGE:
-        out = out / lax.axis_size(axis_name)
+        out = out / axis_size(axis_name)
     if postscale_factor != 1.0:
         out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
     return out
@@ -335,7 +353,7 @@ def adasum(x, axis_name: AxisName,
     among the members only (|set| must be a power of two); non-members
     ppermute to themselves, and adasum(a, a) = a leaves them unchanged.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if member_ranks is not None:
         members = sorted(set(int(r) for r in member_ranks))
     else:
@@ -370,3 +388,127 @@ def barrier(axis_name: AxisName):
     """A collective no-op that forces synchronisation across the axis."""
     token = jnp.zeros((), dtype=jnp.float32)
     return lax.psum(token, axis_name)
+
+
+# --- Quantized (int8 block-scaled) ring allreduce -------------------------
+
+def _device_codec_defaults():
+    """(codec, min_bytes) from the live context when initialized, else from
+    the environment — trace-time only, never inside the compiled program."""
+    try:
+        from ..context import HorovodContext
+        if HorovodContext.initialized():
+            cfg = HorovodContext.instance().cfg
+            return (getattr(cfg, "wire_compression_device", "none"),
+                    getattr(cfg, "wire_compression_min_bytes", 1 << 16))
+    except Exception:
+        pass
+    from ..utils.env import get_int, get_wire_compression_planes
+    return (get_wire_compression_planes()[1],
+            get_int("HOROVOD_WIRE_COMPRESSION_MIN_BYTES", 1 << 16))
+
+
+def quantized_allreduce_eligible(x, world: int, min_bytes: int) -> bool:
+    """Demotion rule for the device-plane int8 codec, shared by the traced
+    path, the optimizer's error-feedback gate, and the eager device plane
+    so every layer falls the same way: fp32 only (quantizing low-precision
+    or integer payloads either loses exactness or gains nothing), at least
+    ``min_bytes`` of payload (small tensors are latency-bound and the
+    per-block scale overhead erodes the ratio), and a real ring to run on.
+    """
+    dtype = getattr(x, "dtype", None)
+    size = 1
+    for d in getattr(x, "shape", ()):  # static under jit
+        size *= int(d)
+    return (world > 1 and dtype == jnp.float32
+            and size * 4 >= int(min_bytes))
+
+
+def _quantized_ring_allreduce_sum(flat, axis_name: str,
+                                  interpret: Optional[bool] = None):
+    """Int8 block-scaled ring reduce-scatter + all-gather over ONE mesh
+    axis (the traced mirror of the host ring's int8 wire codec).
+
+    Reduce-scatter: world-1 ``ppermute`` hops; each hop quantizes the
+    running partial with ``ops.quantize`` (256-element blocks, scale =
+    max|x|/127 — cpp/wire_codec.h semantics exactly), moves codes + scales
+    to the next rank, and accumulates in fp32 against the receiver's own
+    contribution (the ring never adds quantized values together).
+
+    All-gather: the owner quantizes its fully-reduced chunk ONCE and the
+    encoded representation is forwarded verbatim around the ring — every
+    rank dequantizes the same codes and scales, so the result is
+    bit-identical across ranks (the same verbatim-forwarding rule the host
+    codec uses for its allgather phase).
+    """
+    from . import quantize as qz
+
+    n = axis_size(axis_name)
+    length = flat.shape[0]
+    chunk = -(-length // n)
+    x = jnp.pad(flat, (0, n * chunk - length)) if n * chunk != length else flat
+    chunks = x.reshape(n, chunk)
+    me = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Reduce-scatter: rank r starts the partial for chunk r; after world-1
+    # hops the fully-summed chunk (r+1) % n lands on rank r.
+    acc = lax.dynamic_index_in_dim(chunks, me, 0, keepdims=False)
+    for t in range(n - 1):
+        qb, scales = qz.quantize(acc, interpret)
+        qb = lax.ppermute(qb, axis_name, perm)
+        scales = lax.ppermute(scales, axis_name, perm)
+        own = lax.dynamic_index_in_dim(
+            chunks, jnp.mod(me - t - 1, n), 0, keepdims=False)
+        acc = qz.dequantize(qb, scales, chunk, interpret) + own
+
+    # All-gather: encode once, forward the encoding verbatim.
+    qb, scales = qz.quantize(acc, interpret)
+    out = jnp.zeros((n, chunk), jnp.float32)
+    out = ensure_varying(out, axis_name)
+    for t in range(n):
+        piece = qz.dequantize(qb, scales, chunk, interpret)
+        out = lax.dynamic_update_index_in_dim(
+            out, piece, jnp.mod(me - t + 1, n), 0)
+        if t < n - 1:
+            qb = lax.ppermute(qb, axis_name, perm)
+            scales = lax.ppermute(scales, axis_name, perm)
+    return out.reshape(-1)[:length]
+
+
+def quantized_allreduce(x, axis_name: AxisName,
+                        op: ReduceOp = ReduceOp.SUM,
+                        min_bytes: Optional[int] = None,
+                        interpret: Optional[bool] = None):
+    """Allreduce through the int8 block-scaled ring when ``x`` is eligible;
+    otherwise demotes to the plain (uncompressed) collective, bit-identical
+    to :func:`allreduce`.
+
+    ``min_bytes=None`` reads HOROVOD_WIRE_COMPRESSION_MIN_BYTES (context
+    config when initialized).  Byte accounting
+    (``data_plane_stats()['device_raw'/'device_encoded']``) is recorded per
+    trace — under ``jax.jit`` cache reuse the program moves the same bytes
+    every call, so the per-trace note is the per-call wire cost.
+    """
+    from . import quantize as qz
+
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"quantized_allreduce supports Sum and Average, got {op}")
+    if min_bytes is None:
+        min_bytes = _device_codec_defaults()[1]
+    axes = _axes_tuple(axis_name)
+    world = 1
+    for a in axes:
+        world *= axis_size(a)
+    if (len(axes) != 1
+            or not quantized_allreduce_eligible(x, world, min_bytes)):
+        return allreduce(x, axis_name, op=op)
+    x = ensure_varying(x, axes[0])
+    out = _quantized_ring_allreduce_sum(
+        x.reshape(-1).astype(jnp.float32), axes[0], interpret)
+    raw, encoded = qz.ring_bytes(x.size, world)
+    qz.note_device_bytes(raw, encoded)
+    if op == ReduceOp.AVERAGE:
+        out = out / world
+    return out.reshape(x.shape)

@@ -46,6 +46,15 @@ AXIS = "hvdev"
 
 _MIN_BUCKET = 1024
 
+
+def _shard_map():
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.4.x layout
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 _SUPPORTED_REDUCE = (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN,
                      ReduceOp.MAX, ReduceOp.PRODUCT)
 
@@ -86,6 +95,7 @@ class DevicePlane:
             "allgather": 0,       # device allgather dispatches
             "alltoall": 0,        # device alltoall dispatches
             "identity": 0,        # single-member identity completions
+            "quantized": 0,       # fused allreduces that rode the int8 ring
             "programs_built": 0,  # collective compile-cache misses
             "host_fallback": 0,   # device-resident entries demoted to host
             "late_device_put": 0,  # stale cache-replayed device=1 on a host entry
@@ -231,26 +241,54 @@ class DevicePlane:
                 self._meshes[psid] = result
         return result
 
-    def _collective(self, psid: int, mesh, rop: ReduceOp, dtype, length: int):
+    def _device_codec(self, rop: ReduceOp, dtype, length: int,
+                      k: int) -> str:
+        """``"int8"`` when this fused bucket should ride the quantized ring,
+        else ``"none"``.  Demotion rules mirror the traced path (fp32 Sum/
+        Average, payload >= HOROVOD_WIRE_COMPRESSION_MIN_BYTES, k > 1); the
+        codec comes from config, which negotiation keeps rank-uniform, so
+        every member picks the same program."""
+        if getattr(self._cfg, "wire_compression_device", "none") != "int8":
+            return "none"
+        if k <= 1 or rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            return "none"
+        if np.dtype(dtype) != np.float32:
+            return "none"
+        min_bytes = int(getattr(self._cfg, "wire_compression_min_bytes",
+                                1 << 16))
+        if length * 4 < min_bytes:
+            return "none"
+        return "int8"
+
+    def _collective(self, psid: int, mesh, rop: ReduceOp, dtype, length: int,
+                    codec: str = "none"):
         """Cached jitted fused-allreduce program over (k, L) global arrays:
         every member's [1, L] shard in, every member's reduced [1, L] shard
         out (out_specs stay device-varying so one program shape serves all
-        reduce ops)."""
-        key = (psid, "ar", int(rop), str(np.dtype(dtype)), length,
+        reduce ops).  ``codec="int8"`` swaps the psum for the block-scaled
+        quantized ring (ops.quantize semantics; callers pre-filter via
+        _device_codec)."""
+        key = (psid, "ar", int(rop), str(np.dtype(dtype)), length, codec,
                tuple(d.id for d in mesh.devices.flat))
 
         def build():
             import jax
             from jax import lax
             from jax.sharding import PartitionSpec as P
-            from jax import shard_map
+            shard_map = _shard_map()
 
             from .collectives import ensure_varying
 
             k = int(mesh.devices.size)
 
             def inner(x):  # [1, L]: this member's shard
-                if rop == ReduceOp.SUM:
+                if codec == "int8":
+                    from .collectives import _quantized_ring_allreduce_sum
+
+                    out = _quantized_ring_allreduce_sum(x[0], AXIS)[None]
+                    if rop == ReduceOp.AVERAGE:
+                        out = out / k
+                elif rop == ReduceOp.SUM:
                     out = lax.psum(x, AXIS)
                 elif rop == ReduceOp.AVERAGE:
                     out = lax.psum(x, AXIS) / k
@@ -285,7 +323,7 @@ class DevicePlane:
             import jax.numpy as jnp
             from jax import lax
             from jax.sharding import PartitionSpec as P
-            from jax import shard_map
+            shard_map = _shard_map()
 
             from .collectives import ensure_varying
 
@@ -318,7 +356,7 @@ class DevicePlane:
             import jax.numpy as jnp
             from jax import lax
             from jax.sharding import PartitionSpec as P
-            from jax import shard_map
+            shard_map = _shard_map()
 
             from .collectives import ensure_varying
 
@@ -348,7 +386,7 @@ class DevicePlane:
             import jax.numpy as jnp
             from jax import lax
             from jax.sharding import PartitionSpec as P
-            from jax import shard_map
+            shard_map = _shard_map()
 
             from .collectives import ensure_varying
 
@@ -385,7 +423,7 @@ class DevicePlane:
             import jax.numpy as jnp
             from jax import lax
             from jax.sharding import PartitionSpec as P
-            from jax import shard_map
+            shard_map = _shard_map()
 
             from .collectives import ensure_varying
 
@@ -593,14 +631,21 @@ class DevicePlane:
         packed = jax.device_put(
             self._pack()(tuple(arrays), float(pre), length), my_dev)
         garr = self._to_global(mesh, [packed])
-        out = self._collective(psid, mesh, rop, dtype, length)(garr)
+        codec = self._device_codec(rop, dtype, length, len(ranks))
+        out = self._collective(psid, mesh, rop, dtype, length, codec)(garr)
         row = self._shard_on(out, my_dev)
         shapes = tuple(tuple(e.device_array.shape) for e in entries)
         results = self._unpack()(row, float(post), shapes)
         for e, r in zip(entries, results):
             e.result = r
+        if codec == "int8":
+            from . import quantize as _qz
+
+            _qz.note_device_bytes(*_qz.ring_bytes(length, len(ranks)))
         with self._lock:
             self.stats["allreduce"] += 1
+            if codec == "int8":
+                self.stats["quantized"] += 1
 
     def _exec_reducescatter(self, resp, entry) -> None:
         import jax

@@ -1,0 +1,281 @@
+"""Int8 block-scaled quantization for the device plane (traced/XLA path).
+
+This is the in-``jit`` mirror of the host ring's int8 wire codec
+(``cpp/wire_codec.h``): the same 256-element block geometry, the same
+``scale = max|x| / 127`` rule, and the same all-zero / non-finite-block
+handling, so a tensor quantized on the device plane decodes to exactly the
+values the host codec would have produced.  EQuARX (PAPERS.md) is the
+design reference: block-scaled int8 inside the XLA program keeps the
+compression on-chip — no host transfers — while fp32 accumulation between
+hops preserves reduction accuracy.
+
+Layout: a flat fp32 tensor is viewed as ``[nblocks, WIRE_BLOCK]`` (the last
+block zero-padded; zeros cannot raise ``max|x|``, so a short last block
+quantizes exactly as the byte-stream codec quantizes it).  Quantization
+yields an int8 code array plus one fp32 scale per block — together the
+traced analog of the wire stream's ``[scale][codes]`` block records, and
+what actually rides ``lax.ppermute`` between devices.
+
+The kernels are Pallas with the same dispatch rules as
+``ops/flash_attention.py``: on TPU the Pallas kernel runs natively,
+off-TPU the public entry points fall back to an identical-math jnp
+implementation, and ``interpret=True`` forces the kernels through the
+Pallas interpreter (tests).
+
+Byte accounting: every quantized collective calls :func:`note_device_bytes`
+with the raw-vs-encoded wire byte counts so the realized compression ratio
+is observable (``data_plane_stats()['device_raw'/'device_encoded']``,
+``hvd.metrics()``, Prometheus).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# --- Block geometry and codec ids: MUST mirror cpp/wire_codec.h ----------
+# (tools/hvd_lint.py's wire-codec pass checks these against the header; a
+# drift fails lint.)
+WIRE_BLOCK = 256           # kWireBlock: elements per fp32 scale
+WIRE_SCALE_BYTES = 4       # kWireScaleBytes: little-endian fp32 scale
+WIRE_CODEC_IDS = {"none": 0, "bf16": 1, "int8": 2}   # enum class WireCodec
+# Codecs the device plane can engage.  bf16 stays host-only: on-chip the
+# bf16 cast is a plain convert_element_type XLA already fuses — only the
+# block-scaled int8 path needs a codec implementation here.
+DEVICE_WIRE_CODECS = ("none", "int8")
+
+# Rows per Pallas grid step: 32 sublanes satisfies the int8 (32, 128) and
+# fp32 (8, 128) minimum tile constraints simultaneously (WIRE_BLOCK = 256
+# lanes is a multiple of 128).
+_QUANT_ROWS = 32
+
+
+def encoded_nbytes(count: int) -> int:
+    """Wire bytes for ``count`` fp32 elements under the int8 codec — the
+    same formula as WireEncodedBytes(kInt8, count)."""
+    blocks = -(-int(count) // WIRE_BLOCK)
+    return blocks * WIRE_SCALE_BYTES + int(count)
+
+
+def ring_bytes(count: int, world: int) -> Tuple[int, int]:
+    """Per-rank (raw, encoded) wire bytes for one quantized ring allreduce
+    of ``count`` fp32 elements over ``world`` ranks: reduce-scatter plus
+    all-gather, world-1 hops each, one chunk of ``ceil(count/world)``
+    elements per hop."""
+    world = max(1, int(world))
+    if world == 1:
+        return (0, 0)
+    chunk = -(-int(count) // world)
+    hops = 2 * (world - 1)
+    return (hops * chunk * 4, hops * encoded_nbytes(chunk))
+
+
+# --- Device-plane byte counters ------------------------------------------
+
+_DEV_LOCK = threading.Lock()
+_DEV_RAW = 0
+_DEV_ENCODED = 0
+_NATIVE_SINK: Optional[Callable[[int, int], None]] = None
+
+
+def set_native_byte_sink(fn: Optional[Callable[[int, int], None]]) -> None:
+    """Register a callable forwarding (raw, encoded) deltas to the native
+    metrics registry (NativeCore wires hvd_device_plane_note here) so the
+    counters show up in hvd.metrics() / Prometheus."""
+    global _NATIVE_SINK
+    _NATIVE_SINK = fn
+
+
+def note_device_bytes(raw: int, encoded: int) -> None:
+    global _DEV_RAW, _DEV_ENCODED
+    with _DEV_LOCK:
+        _DEV_RAW += int(raw)
+        _DEV_ENCODED += int(encoded)
+    sink = _NATIVE_SINK
+    if sink is not None:
+        try:
+            sink(int(raw), int(encoded))
+        except Exception:
+            pass
+
+
+def device_byte_counters() -> Tuple[int, int]:
+    with _DEV_LOCK:
+        return (_DEV_RAW, _DEV_ENCODED)
+
+
+def reset_device_byte_counters() -> None:
+    global _DEV_RAW, _DEV_ENCODED
+    with _DEV_LOCK:
+        _DEV_RAW = 0
+        _DEV_ENCODED = 0
+
+
+# --- Block-form reference implementation (identical math to WireEncode) --
+
+def _block_scales(xb):
+    """Per-block (scale, inv) mirroring WireEncode(kInt8) bit-for-bit:
+
+    - max|x| scans with ``a > maxabs`` so NaN elements never win the max
+      (an all-NaN block keeps scale 0 and encodes zeros);
+    - a block whose max is inf gets a non-finite scale -> codes all zero
+      (the stored scale stays inf, so decode flags the block as NaN rather
+      than inventing values).
+
+    ``inv`` is 0 exactly for the all-zero / non-finite blocks (a finite
+    positive scale can never reciprocate to 0 in fp32), so ``inv > 0`` is
+    the block-ok predicate downstream.  Computed in plain jnp — XLA's
+    fp32 divide is correctly rounded, matching the C++ divides; the Pallas
+    interpreter's is not, which is why the divides live outside the kernel.
+    """
+    absx = jnp.abs(xb)
+    maxabs = jnp.max(jnp.where(jnp.isnan(absx), 0.0, absx),
+                     axis=1, keepdims=True)
+    scale = maxabs / 127.0
+    ok = (scale > 0.0) & jnp.isfinite(scale)
+    inv = jnp.where(ok, 1.0 / jnp.where(ok, scale, 1.0), 0.0)
+    return scale.astype(jnp.float32), inv.astype(jnp.float32)
+
+
+def _quantize_codes_ref(xb, inv):
+    """Elementwise half of WireEncode(kInt8): round, clamp, block gate.
+
+    Clamping uses std::min/std::max operand order, under which a NaN
+    element inside an otherwise-finite block lands on +127 (exactly what
+    the C++ loop produces)."""
+    v = jnp.round(xb * inv)
+    v = jnp.where(v < 127.0, v, 127.0)      # std::min(127, v): NaN -> 127
+    v = jnp.where(v > -127.0, v, -127.0)    # std::max(-127, v)
+    return jnp.where(inv > 0.0, v, 0.0).astype(jnp.int8)
+
+
+def _quantize_blocks_ref(xb):
+    """jnp mirror of WireEncode(kInt8) on [nblocks, WIRE_BLOCK] fp32."""
+    scale, inv = _block_scales(xb)
+    return _quantize_codes_ref(xb, inv), scale
+
+
+def _dequantize_blocks_ref(qb, scales):
+    """jnp mirror of WireDecodeRange(kInt8): scale * code, in fp32."""
+    return scales.astype(jnp.float32) * qb.astype(jnp.float32)
+
+
+# --- Pallas kernels -------------------------------------------------------
+
+def _quant_kernel(x_ref, inv_ref, q_ref):
+    # Elementwise only (mul/round/compare/select are exactly rounded on
+    # every backend, so interpret mode is bit-identical to the jnp
+    # fallback); the per-block scale/inv reduction rides in from jnp.
+    x = x_ref[...]                                    # [ROWS, WIRE_BLOCK]
+    inv = inv_ref[...]                                # [ROWS, 1]
+    v = jnp.round(x * inv)
+    v = jnp.where(v < 127.0, v, 127.0)
+    v = jnp.where(v > -127.0, v, -127.0)
+    q_ref[...] = jnp.where(inv > 0.0, v, 0.0).astype(jnp.int8)
+
+
+def _dequant_kernel(q_ref, s_ref, x_ref):
+    x_ref[...] = s_ref[...] * q_ref[...].astype(jnp.float32)
+
+
+def _pad_rows(xb, rows: int):
+    nb = xb.shape[0]
+    nb_pad = -(-nb // rows) * rows
+    if nb_pad != nb:
+        xb = jnp.pad(xb, ((0, nb_pad - nb), (0, 0)))
+    return xb, nb
+
+
+def _quantize_blocks_pallas(xb, interpret: bool):
+    scale, inv = _block_scales(xb)
+    xb, nb = _pad_rows(xb, _QUANT_ROWS)
+    inv_p, _ = _pad_rows(inv, _QUANT_ROWS)
+    grid = (xb.shape[0] // _QUANT_ROWS,)
+    q = pl.pallas_call(
+        _quant_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((_QUANT_ROWS, WIRE_BLOCK), lambda i: (i, 0)),
+                  pl.BlockSpec((_QUANT_ROWS, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_QUANT_ROWS, WIRE_BLOCK), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((xb.shape[0], WIRE_BLOCK), jnp.int8),
+        interpret=interpret,
+    )(xb, inv_p)
+    return q[:nb], scale
+
+
+def _dequantize_blocks_pallas(qb, scales, interpret: bool):
+    qb, nb = _pad_rows(qb, _QUANT_ROWS)
+    scales, _ = _pad_rows(scales, _QUANT_ROWS)
+    grid = (qb.shape[0] // _QUANT_ROWS,)
+    x = pl.pallas_call(
+        _dequant_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((_QUANT_ROWS, WIRE_BLOCK), lambda i: (i, 0)),
+                  pl.BlockSpec((_QUANT_ROWS, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_QUANT_ROWS, WIRE_BLOCK), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((qb.shape[0], WIRE_BLOCK),
+                                       jnp.float32),
+        interpret=interpret,
+    )(qb, scales)
+    return x[:nb]
+
+
+def _dispatch(interpret: Optional[bool]):
+    """flash_attention's dispatch rule: None -> Pallas on TPU, jnp fallback
+    elsewhere; True forces the Pallas interpreter (tests)."""
+    if interpret is None:
+        if jax.default_backend() not in ("tpu", "axon"):
+            return None          # identical-math jnp fallback
+        return False             # native Pallas
+    return bool(interpret)
+
+
+# --- Public block-form API ------------------------------------------------
+
+def quantize_blocks(xb, interpret: Optional[bool] = None):
+    """[nblocks, WIRE_BLOCK] fp32 -> (int8 codes, fp32 [nblocks, 1] scales)."""
+    mode = _dispatch(interpret)
+    if mode is None:
+        return _quantize_blocks_ref(xb)
+    return _quantize_blocks_pallas(xb, mode)
+
+
+def dequantize_blocks(qb, scales, interpret: Optional[bool] = None):
+    mode = _dispatch(interpret)
+    if mode is None:
+        return _dequantize_blocks_ref(qb, scales)
+    return _dequantize_blocks_pallas(qb, scales, mode)
+
+
+def _to_blocks(flat):
+    n = flat.shape[0]
+    nblocks = max(1, -(-n // WIRE_BLOCK))
+    pad = nblocks * WIRE_BLOCK - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(nblocks, WIRE_BLOCK)
+
+
+def quantize(flat, interpret: Optional[bool] = None):
+    """Flat fp32 [n] -> (codes [nblocks, WIRE_BLOCK] int8, scales
+    [nblocks, 1] fp32).  The short last block is zero-padded, which cannot
+    change its max|x| — identical to the byte codec's short-block rule."""
+    return quantize_blocks(_to_blocks(flat.astype(jnp.float32)), interpret)
+
+
+def dequantize(qb, scales, count: int, interpret: Optional[bool] = None):
+    """Inverse of :func:`quantize`: back to flat fp32 [count]."""
+    xb = dequantize_blocks(qb, scales, interpret)
+    return xb.reshape(-1)[:count]
+
+
+def fake_quantize(x, interpret: Optional[bool] = None):
+    """dequantize(quantize(x)) with x's shape — the local quantization
+    image used by error feedback (residual = x - fake_quantize(x))."""
+    flat = x.reshape(-1)
+    qb, s = quantize(flat, interpret)
+    return dequantize(qb, s, flat.shape[0], interpret).reshape(x.shape)

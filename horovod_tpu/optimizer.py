@@ -87,7 +87,7 @@ def _reduce_grad_leaf(leaf, axes, op: ReduceOp,
         if op == ReduceOp.AVERAGE:
             total = 1
             for a in axes:
-                total *= lax.axis_size(a)
+                total *= _jit_ops.axis_size(a)
             out = out / total
         if postscale_factor != 1.0:
             out = out * jnp.asarray(postscale_factor, out.dtype)
@@ -150,6 +150,12 @@ class DistributedOptState(NamedTuple):
     inner_state: Any
     accum: Any          # local gradient accumulator (backward_passes_per_step)
     counter: jnp.ndarray  # int32 scalar
+    # Error-feedback residual tree (device_compression="int8"): per leaf,
+    # the local quantization error carried into the next step so the int8
+    # codec's bias cancels over time instead of accumulating.  None when no
+    # device codec is engaged (the default), keeping the state pytree
+    # identical to pre-codec checkpoints.
+    residual: Any = None
 
 
 class ShardedOptState(NamedTuple):
@@ -165,7 +171,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          gradient_predivide_factor: float = 1.0,
                          process_set: Optional[ProcessSet] = None,
                          axis_name: Optional[str] = None,
-                         shard_optimizer_states: bool = False
+                         shard_optimizer_states: bool = False,
+                         device_compression: Optional[str] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with cross-rank gradient averaging.
 
@@ -182,9 +189,58 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     updates are all-gathered — the same communication volume as the
     allreduce with n× less optimizer memory per chip.  In-jit only;
     incompatible with compression/backward_passes_per_step/predivide.
+
+    ``device_compression`` selects the in-jit device-plane codec for the
+    traced gradient reduction: ``"int8"`` routes eligible leaves (fp32, at
+    least HOROVOD_WIRE_COMPRESSION_MIN_BYTES of payload) through the int8
+    block-scaled ring (``ops.collectives.quantized_allreduce``) with
+    **error feedback**: the state carries a residual tree holding each
+    leaf's local quantization error, added back into the next step's
+    gradient before quantizing, so the codec's per-step bias cancels
+    instead of compounding (docs/compression.md).  ``None`` (default)
+    follows ``HOROVOD_WIRE_COMPRESSION``'s ``device=`` plane; ``"none"``
+    disables regardless of the environment.  Ineligible leaves demote to
+    the uncompressed collective bit-identically; the eager path never
+    quantizes (the host ring has its own coordinator-negotiated codec).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    dev_codec = device_compression
+    if dev_codec is None:
+        dev_codec = _jit_ops._device_codec_defaults()[0]
+    dev_codec = (dev_codec or "none").lower()
+    if dev_codec not in ("none", "int8"):
+        raise ValueError(
+            f"device_compression must be 'none' or 'int8', got {dev_codec!r}")
+    ef_active = dev_codec == "int8"
+    if ef_active and shard_optimizer_states:
+        if device_compression is not None:
+            raise ValueError(
+                "device_compression='int8' is incompatible with "
+                "shard_optimizer_states (the sharded path reduce-scatters "
+                "exactly once; quantizing it is future work)")
+        ef_active = False  # env-driven codec: sharded path just opts out
+    if ef_active:
+        if compression is not Compression.none:
+            raise ValueError(
+                "device_compression='int8' already quantizes the wire; "
+                "combine it with Compression.none")
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "device_compression='int8' requires "
+                "backward_passes_per_step=1 (error feedback needs to see "
+                "every communicated gradient)")
+        if process_set is not None:
+            raise ValueError(
+                "device_compression='int8' runs the full-axis ring; "
+                "process_set subsets are not supported")
+        if gradient_predivide_factor != 1.0:
+            raise ValueError(
+                "device_compression='int8' does not support "
+                "gradient_predivide_factor")
+        if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+            raise ValueError(
+                "device_compression='int8' supports op=Average or Sum")
     if shard_optimizer_states:
         if compression is not Compression.none:
             raise ValueError(
@@ -229,19 +285,68 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             reduced = jax.tree_util.tree_map(lambda g: g / divisor, reduced)
         return reduced
 
+    def reduce_grads_ef(grads, residual):
+        # Error-feedback quantized reduction (traced only): each eligible
+        # leaf communicates corrected = grad + residual through the int8
+        # ring and keeps its own local quantization error for next step.
+        # Ineligible leaves take the plain collective bit-identically and
+        # leave their residual untouched (it stays zero).
+        from .ops import quantize as _qz
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        rleaves = treedef.flatten_up_to(residual)
+        axes = _resolve_axes(axis_name)
+        world = 1
+        for a in axes:
+            world *= _jit_ops.axis_size(a)
+        min_bytes = _jit_ops._device_codec_defaults()[1]
+        vma_tracked = any((_leaf_vma(l) or ()) for l in leaves)
+        out, new_res = [], []
+        for leaf, res in zip(leaves, rleaves):
+            vma = _leaf_vma(leaf)
+            varying = (vma is None or not vma_tracked
+                       or all(a in vma for a in axes))
+            if (len(axes) == 1 and varying
+                    and _jit_ops.quantized_allreduce_eligible(
+                        leaf, world, min_bytes)):
+                corrected = leaf + res
+                out.append(_jit_ops.quantized_allreduce(
+                    corrected, axes[0], op=op))
+                new_res.append(corrected - _qz.fake_quantize(corrected))
+            else:
+                out.append(_reduce_grad_leaf(leaf, axes, op, 1.0, 1.0,
+                                             vma_tracked))
+                new_res.append(res)
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                jax.tree_util.tree_unflatten(treedef, new_res))
+
     def init_fn(params):
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        residual = None
+        if ef_active:
+            # fp32 like the codec: only fp32 leaves ever touch it, and a
+            # zero residual is exact for everything that demotes.
+            residual = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
         return DistributedOptState(
             inner_state=optimizer.init(params),
             accum=zeros,
             counter=jnp.zeros((), dtype=jnp.int32),
+            residual=residual,
         )
 
     def update_fn(grads, state: DistributedOptState, params=None):
         if backward_passes_per_step == 1:
-            reduced = reduce_grads(grads, 1)
+            leaves = jax.tree_util.tree_leaves(grads)
+            if (ef_active and state.residual is not None and leaves
+                    and _is_traced(leaves[0])):
+                reduced, residual = reduce_grads_ef(grads, state.residual)
+            else:
+                reduced = reduce_grads(grads, 1)
+                residual = state.residual
             updates, inner = optimizer.update(reduced, state.inner_state, params)
-            return updates, DistributedOptState(inner, state.accum, state.counter)
+            return updates, DistributedOptState(inner, state.accum,
+                                                state.counter, residual)
 
         accum = jax.tree_util.tree_map(jnp.add, state.accum, grads)
         counter = state.counter + 1
@@ -272,7 +377,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             updates, accum, inner = jax.lax.cond(
                 counter % k == 0, communicate, hold, (accum, state.inner_state))
             counter = jnp.where(counter % k == 0, 0, counter)
-            return updates, DistributedOptState(inner, accum, counter)
+            return updates, DistributedOptState(inner, accum, counter,
+                                                state.residual)
 
         # Eager: plain Python control flow.
         if int(counter) % k == 0:
@@ -280,9 +386,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             updates, inner = optimizer.update(reduced, state.inner_state, params)
             zeros = jax.tree_util.tree_map(jnp.zeros_like, accum)
             return updates, DistributedOptState(inner, zeros,
-                                                jnp.zeros((), jnp.int32))
+                                                jnp.zeros((), jnp.int32),
+                                                state.residual)
         zero_upd = jax.tree_util.tree_map(jnp.zeros_like, grads)
-        return zero_upd, DistributedOptState(state.inner_state, accum, counter)
+        return zero_upd, DistributedOptState(state.inner_state, accum, counter,
+                                             state.residual)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -334,7 +442,7 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
         axes = _axes()
         shard_ax = axes[0]
         try:
-            n = lax.axis_size(shard_ax)
+            n = _jit_ops.axis_size(shard_ax)
         except NameError as exc:
             raise ValueError(
                 "shard_optimizer_states=True runs inside jit/shard_map "
@@ -378,7 +486,7 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
             pre = 1
             for a in axes:
                 if a not in vma:
-                    pre *= lax.axis_size(a)
+                    pre *= _jit_ops.axis_size(a)
             leaf = leaf if pre == 1 else leaf / pre
             return _jit_ops.ensure_varying(leaf, axes)
 
@@ -399,7 +507,7 @@ def _sharded_distributed_optimizer(optimizer: optax.GradientTransformation,
         if op == ReduceOp.AVERAGE:
             total_ranks = 1
             for a in axes:
-                total_ranks *= lax.axis_size(a)
+                total_ranks *= _jit_ops.axis_size(a)
             gshard = gshard / total_ranks
         upd_shard, new_inner = optimizer.update(gshard, state.inner_state,
                                                 state.master)
@@ -492,7 +600,7 @@ def _ps_world_size(process_set, axis_name, grads) -> Any:
     leaves = jax.tree_util.tree_leaves(grads)
     if leaves and _is_traced(leaves[0]):
         ax = axis_name if axis_name is not None else _mesh.mesh_axis_name()
-        return jax.lax.axis_size(ax)
+        return _jit_ops.axis_size(ax)
     from .context import HorovodContext
 
     return len(HorovodContext.instance().core.process_set_ranks(

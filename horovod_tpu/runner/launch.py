@@ -83,10 +83,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "ring + shm-local broadcast when hosts hold "
                         "co-located ranks (HOROVOD_HIERARCHICAL_ALLREDUCE)")
     p.add_argument("--wire-compression", default=None,
-                   choices=["none", "bf16", "int8"],
-                   help="codec for fp32 allreduce payloads on cross-host "
-                        "ring hops; accumulation stays fp32 "
-                        "(HOROVOD_WIRE_COMPRESSION)")
+                   help="codec for fp32 allreduce payloads: a bare codec "
+                        "(none|bf16|int8) applies to cross-host ring hops, "
+                        "or per-plane plane=codec assignments, e.g. "
+                        "'host=bf16,device=int8' ('device=int8' enables the "
+                        "in-jit int8 block-scaled ring); accumulation stays "
+                        "fp32 (HOROVOD_WIRE_COMPRESSION)")
     p.add_argument("--control-tree", default=None,
                    choices=["auto", "on", "off"],
                    help="leader-tree control plane (protocol v9): host "

@@ -260,9 +260,18 @@ class CoreBackend:
     def data_plane_stats(self) -> dict:
         """Cumulative host-data-plane bytes sent, split by locality, plus
         the raw (pre-wire-codec) byte counts (zero for backends without a
-        socket data plane)."""
+        socket data plane).  device_raw / device_encoded track the device
+        plane's quantized in-jit ring and come from the Python-side
+        counters, so every backend reports them."""
+        dev_raw = dev_enc = 0
+        try:
+            from .ops import quantize as _qz
+            dev_raw, dev_enc = _qz.device_byte_counters()
+        except Exception:
+            pass
         return {"data_sent_local": 0, "data_sent_xhost": 0,
-                "data_raw_local": 0, "data_raw_xhost": 0}
+                "data_raw_local": 0, "data_raw_xhost": 0,
+                "device_raw": dev_raw, "device_encoded": dev_enc}
 
     def metrics(self) -> dict:
         """Local metrics registry (counters + histograms) as a dict; empty

@@ -74,28 +74,76 @@ def get_int(name: str, default: int) -> int:
 
 
 WIRE_COMPRESSION_CODECS = ("none", "bf16", "int8")
+# Codecs the in-jit device plane implements (ops/quantize.py): bf16 stays a
+# host-ring-only codec — on-chip a bf16 cast is a plain convert XLA already
+# fuses, so only block-scaled int8 earns a device implementation.
+DEVICE_WIRE_COMPRESSION_CODECS = ("none", "int8")
 
 
-def get_wire_compression() -> str:
-    """Parse HOROVOD_WIRE_COMPRESSION into a validated codec name.
-
-    Unset / empty / "0" / "off" / "false" all mean "none" so boolean-style
-    launch scripts degrade safely; anything else unrecognised falls back to
-    "none" with a warning rather than failing init (and the coordinator's
-    agreed value wins over any per-rank divergence anyway).
-    """
-    raw = os.environ.get("HOROVOD_WIRE_COMPRESSION", "")
-    val = raw.strip().lower()
-    if val in ("", "0", "off", "false", "no"):
-        return "none"
-    if val in WIRE_COMPRESSION_CODECS:
-        return val
+def _warn_wire(raw: str, what: str, allowed) -> None:
     from .logging import get_logger
 
     get_logger().warning(
-        "HOROVOD_WIRE_COMPRESSION=%r not one of %s; using 'none'",
-        raw, "/".join(WIRE_COMPRESSION_CODECS))
-    return "none"
+        "HOROVOD_WIRE_COMPRESSION=%r: %s not one of %s; using 'none'",
+        raw, what, "/".join(allowed))
+
+
+def get_wire_compression_planes() -> "tuple":
+    """Parse HOROVOD_WIRE_COMPRESSION into per-plane codecs
+    ``(host, device)``.
+
+    Accepted forms:
+
+    - bare codec (``int8``) — host (cross-host ring) plane only, the
+      pre-plane-syntax meaning, kept for back-compat;
+    - comma-separated ``plane=codec`` assignments
+      (``host=bf16,device=int8``, ``device=int8``); planes not named stay
+      ``none``.
+
+    Unset / empty / "0" / "off" / "false" all mean "none" so boolean-style
+    launch scripts degrade safely; anything else unrecognised falls back to
+    "none" with a warning rather than failing init (the coordinator's
+    agreed value wins over per-rank divergence on the host plane, and the
+    device plane's demotion rules are deterministic in the tensor, so all
+    ranks fall the same way).
+    """
+    raw = os.environ.get("HOROVOD_WIRE_COMPRESSION", "")
+    val = raw.strip().lower()
+    host, device = "none", "none"
+    if val in ("", "0", "off", "false", "no"):
+        return host, device
+    for token in val.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        if "=" in token:
+            plane, _, codec = token.partition("=")
+            plane, codec = plane.strip(), codec.strip()
+            if plane == "host":
+                if codec in WIRE_COMPRESSION_CODECS:
+                    host = codec
+                else:
+                    _warn_wire(raw, f"host codec {codec!r}",
+                               WIRE_COMPRESSION_CODECS)
+            elif plane == "device":
+                if codec in DEVICE_WIRE_COMPRESSION_CODECS:
+                    device = codec
+                else:
+                    _warn_wire(raw, f"device codec {codec!r}",
+                               DEVICE_WIRE_COMPRESSION_CODECS)
+            else:
+                _warn_wire(raw, f"plane {plane!r}", ("host", "device"))
+        elif token in WIRE_COMPRESSION_CODECS:
+            host = token
+        else:
+            _warn_wire(raw, f"codec {token!r}", WIRE_COMPRESSION_CODECS)
+    return host, device
+
+
+def get_wire_compression() -> str:
+    """Host-plane codec from HOROVOD_WIRE_COMPRESSION (see
+    :func:`get_wire_compression_planes` for the full per-plane syntax)."""
+    return get_wire_compression_planes()[0]
 
 
 def get_float(name: str, default: float) -> float:
@@ -144,7 +192,17 @@ class Config:
     # HOROVOD_WIRE_COMPRESSION: codec for fp32 allreduce payloads on
     # cross-host ring hops ("none" | "bf16" | "int8").  Accumulation stays
     # fp32; the coordinator decides per-response so ranks never diverge.
+    # Per-plane syntax ("device=int8", "host=bf16,device=int8") additionally
+    # engages the in-jit device-plane codec (ops/quantize.py); a bare codec
+    # keeps the historical host-only meaning.
     wire_compression: str = "none"
+    # Device-plane codec parsed from the same variable ("none" | "int8").
+    wire_compression_device: str = "none"
+    # HOROVOD_WIRE_COMPRESSION_MIN_BYTES: payload floor (bytes) below which
+    # either plane's codec demotes to the uncompressed path — small tensors
+    # are latency- not bandwidth-bound, and the scale overhead erodes the
+    # ratio.  Shares the native coordinator's 64 KiB default.
+    wire_compression_min_bytes: int = 1 << 16
 
     # Observability.
     timeline_path: Optional[str] = None
@@ -232,7 +290,10 @@ class Config:
             hierarchical_allreduce=get_bool(
                 "HOROVOD_HIERARCHICAL_ALLREDUCE", False
             ),
-            wire_compression=get_wire_compression(),
+            wire_compression=get_wire_compression_planes()[0],
+            wire_compression_device=get_wire_compression_planes()[1],
+            wire_compression_min_bytes=get_int(
+                "HOROVOD_WIRE_COMPRESSION_MIN_BYTES", 1 << 16),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             metrics_enabled=get_bool(

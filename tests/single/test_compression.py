@@ -70,3 +70,134 @@ def test_none_compressor_identity():
     wire, ctx = Compression.none.compress(x)
     assert wire is x and ctx is None
     assert Compression.none.decompress(wire, ctx) is x
+
+
+# ---------------------------------------------------------------------------
+# Device-plane int8 block codec (horovod_tpu/ops/quantize.py).
+#
+# quantize.py is a traced-math mirror of cpp/wire_codec.h's WireEncode /
+# WireDecodeRange(kInt8); these tests pin the edge-case semantics against a
+# plain-numpy transliteration of the C++ loops and check the two dispatch
+# modes (jnp fallback vs the Pallas interpreter) stay bit-identical.
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+import horovod_tpu.ops.quantize as qz
+
+
+def _np_quantize(flat):
+    """numpy transliteration of WireEncode(kInt8) on a flat fp32 array."""
+    flat = np.asarray(flat, dtype=np.float32)
+    n = flat.size
+    nblocks = max(1, -(-n // qz.WIRE_BLOCK))
+    xb = np.zeros((nblocks, qz.WIRE_BLOCK), np.float32)
+    xb.reshape(-1)[:n] = flat
+    absx = np.abs(xb)
+    absx[np.isnan(absx)] = 0.0  # `a > maxabs` scan: NaN never wins
+    maxabs = absx.max(axis=1, keepdims=True)
+    scale = (maxabs / 127.0).astype(np.float32)
+    ok = (scale > 0.0) & np.isfinite(scale)
+    inv = np.where(ok, np.float32(1.0) / np.where(ok, scale, 1.0),
+                   0.0).astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        v = np.rint(xb * inv)
+        # std::max(-127, std::min(127, v)) operand order: NaN lands on +127
+        v = np.where(v < 127.0, v, 127.0)
+        v = np.where(v > -127.0, v, -127.0)
+    codes = np.where(inv > 0.0, v, 0.0).astype(np.int8)
+    return codes, scale
+
+
+@pytest.mark.parametrize("interpret", [None, True])
+def test_int8_all_zero_block(interpret):
+    x = np.zeros(qz.WIRE_BLOCK * 2, dtype=np.float32)
+    codes, scales = qz.quantize(jnp.asarray(x), interpret=interpret)
+    assert np.all(np.asarray(codes) == 0)
+    assert np.all(np.asarray(scales) == 0.0)
+    back = np.asarray(qz.dequantize(codes, scales, x.size,
+                                    interpret=interpret))
+    np.testing.assert_array_equal(back, x)
+
+
+@pytest.mark.parametrize("interpret", [None, True])
+def test_int8_nonfinite_blocks(interpret):
+    # Block 0: contains +inf -> scale inf, codes all zero (decode flags the
+    # block as NaN via inf*0 rather than inventing values).
+    # Block 1: all NaN -> scale 0 (NaN never wins the maxabs scan), codes 0.
+    # Block 2: one NaN inside a finite block -> that element clamps to +127.
+    x = np.ones(qz.WIRE_BLOCK * 3, dtype=np.float32)
+    x[3] = np.inf
+    x[qz.WIRE_BLOCK:2 * qz.WIRE_BLOCK] = np.nan
+    x[2 * qz.WIRE_BLOCK + 5] = np.nan
+    codes, scales = qz.quantize(jnp.asarray(x), interpret=interpret)
+    codes = np.asarray(codes)
+    scales = np.asarray(scales).reshape(-1)
+    assert np.isinf(scales[0]) and np.all(codes[0] == 0)
+    assert scales[1] == 0.0 and np.all(codes[1] == 0)
+    assert np.isfinite(scales[2]) and scales[2] > 0
+    assert codes[2, 5] == 127
+    ref_codes, ref_scales = _np_quantize(x)
+    np.testing.assert_array_equal(codes, ref_codes)
+    np.testing.assert_array_equal(scales, ref_scales.reshape(-1))
+
+
+@pytest.mark.parametrize("interpret", [None, True])
+def test_int8_short_last_block(interpret):
+    # 600 = 2 full blocks + 88: zero padding cannot raise max|x|, so the
+    # short block quantizes exactly as the byte-stream codec quantizes it.
+    rng = np.random.RandomState(7)
+    x = rng.randn(600).astype(np.float32) * 3.0
+    codes, scales = qz.quantize(jnp.asarray(x), interpret=interpret)
+    ref_codes, ref_scales = _np_quantize(x)
+    np.testing.assert_array_equal(np.asarray(codes), ref_codes)
+    np.testing.assert_array_equal(np.asarray(scales), ref_scales)
+    back = np.asarray(qz.dequantize(codes, scales, x.size,
+                                    interpret=interpret))
+    # Round-to-nearest: per-element error bounded by scale/2.
+    bound = np.repeat(ref_scales.reshape(-1), qz.WIRE_BLOCK)[:x.size] / 2
+    assert np.all(np.abs(back - x) <= bound + 1e-7)
+
+
+def test_int8_dispatch_modes_bit_identical():
+    # The jnp fallback and the Pallas interpreter must agree bit-for-bit
+    # (scales/inv are computed outside the kernel precisely for this).
+    rng = np.random.RandomState(11)
+    x = (rng.randn(qz.WIRE_BLOCK * 4 + 17) * 50).astype(np.float32)
+    x[0] = np.inf
+    x[5] = np.nan
+    c_jnp, s_jnp = qz.quantize(jnp.asarray(x), interpret=None)
+    c_int, s_int = qz.quantize(jnp.asarray(x), interpret=True)
+    np.testing.assert_array_equal(np.asarray(c_jnp), np.asarray(c_int))
+    np.testing.assert_array_equal(np.asarray(s_jnp), np.asarray(s_int))
+    d_jnp = np.asarray(qz.dequantize(c_jnp, s_jnp, x.size, interpret=None))
+    d_int = np.asarray(qz.dequantize(c_int, s_int, x.size, interpret=True))
+    np.testing.assert_array_equal(d_jnp, d_int)
+
+
+def test_int8_fake_quantize_residual_semantics():
+    rng = np.random.RandomState(13)
+    x = (rng.randn(16, 40) * 2).astype(np.float32)
+    fq = np.asarray(qz.fake_quantize(jnp.asarray(x)))
+    assert fq.shape == x.shape
+    codes, scales = qz.quantize(jnp.asarray(x.reshape(-1)))
+    expect = np.asarray(qz.dequantize(codes, scales,
+                                      x.size)).reshape(x.shape)
+    np.testing.assert_array_equal(fq, expect)
+    # all-zero input is a fixed point: residual identically zero
+    z = np.zeros((4, 4), np.float32)
+    np.testing.assert_array_equal(np.asarray(qz.fake_quantize(jnp.asarray(z))),
+                                  z)
+
+
+def test_encoded_nbytes_and_ring_bytes():
+    # WireEncodedBytes(kInt8, n) = ceil(n/256)*4 + n, short block included.
+    assert qz.encoded_nbytes(qz.WIRE_BLOCK) == qz.WIRE_SCALE_BYTES + 256
+    assert qz.encoded_nbytes(1) == qz.WIRE_SCALE_BYTES + 1
+    assert qz.encoded_nbytes(600) == 3 * qz.WIRE_SCALE_BYTES + 600
+    raw, enc = qz.ring_bytes(16384, 8)
+    # 2*(8-1) hops of one 2048-element chunk each
+    assert raw == 14 * 2048 * 4
+    assert enc == 14 * qz.encoded_nbytes(2048)
+    assert enc / raw <= 0.30
+    assert qz.ring_bytes(1024, 1) == (0, 0)

@@ -18,6 +18,14 @@ the codec engages on the cross-host leader ring), reporting cross-host
 wire bytes/step against the fp32 baseline and the max abs error the codec
 introduced.
 
+With --device-codec int8 an additional device-plane section runs: a jitted
+shard_map allreduce over a forced 8-device CPU host platform with the
+HOROVOD_WIRE_COMPRESSION ``device=`` plane on vs off, reporting the int8
+block-scaled ring's encoded-vs-raw wire ratio (from the device-plane byte
+counters), the quantization error, and throughput against the
+uncompressed traced ring.  On CPU the ratio is the point — the hop count
+is identical and interpret-mode kernels are not a speed story.
+
 With --metrics an additional section reruns the cache_on configuration
 with HOROVOD_METRICS=1 and reports the registry's negotiation-throughput
 overhead against the metrics-off baseline (disabled is the baseline
@@ -171,6 +179,76 @@ def run_wire_config(codec: str, np_: int, steps: int, elems: int):
     return agg
 
 
+def _device_worker(steps: int, elems: int):
+    import time
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    import horovod_tpu as hvd
+    import horovod_tpu.ops.quantize as qz
+
+    hvd.init(build_mesh=False)
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs), ("q",))
+
+    def fn(shard):
+        return hvd.allreduce(shard, axis_name="q", op=hvd.Sum)
+
+    try:
+        sm = shard_map(fn, mesh=mesh, in_specs=P("q"), out_specs=P("q"),
+                       check_rep=False)
+    except TypeError:  # newer jax renamed the kwarg
+        sm = shard_map(fn, mesh=mesh, in_specs=P("q"), out_specs=P("q"),
+                       check_vma=False)
+    jitted = jax.jit(sm)
+
+    per_dev = max(1, elems // len(devs))
+    x_np = (((np.arange(len(devs) * per_dev) % 509) / 509.0 - 0.5)
+            .astype(np.float32).reshape(len(devs), per_dev))
+    exact = np.sum(x_np.astype(np.float64), axis=0)
+    x = jnp.asarray(x_np)
+
+    # The byte counters tick at trace time (once per compile), so the
+    # delta around the warmup call IS one step's ring volume.
+    qz.reset_device_byte_counters()
+    out = np.asarray(jitted(x))
+    raw, enc = qz.device_byte_counters()
+    max_err = float(np.max(np.abs(out.astype(np.float64) - exact)))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        jitted(x).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    hvd.shutdown()
+    return {"steps_per_s": steps / dt, "max_abs_err": max_err,
+            "device_raw_bytes_per_step": raw,
+            "device_encoded_bytes_per_step": enc}
+
+
+def run_device_config(codec: str, steps: int, elems: int):
+    from horovod_tpu.runner import run
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "HOROVOD_WIRE_COMPRESSION_MIN_BYTES": "4096"}
+    if codec != "none":
+        env["HOROVOD_WIRE_COMPRESSION"] = f"device={codec}"
+    results = run(_device_worker, args=(steps, elems), np=1, env=env,
+                  stream_prefix=False)
+    agg = dict(results[0])
+    agg.update({"config": f"device_{codec}", "payload_bytes": elems * 4,
+                "steps_per_s": round(agg["steps_per_s"], 2)})
+    print(json.dumps(agg), flush=True)
+    return agg
+
+
 def _sweep_worker(steps: int, tensors: int):
     import numpy as np
     import horovod_tpu as hvd
@@ -246,6 +324,15 @@ def main():
     ap.add_argument("--wire-mb", type=float, default=4.0,
                     help="fp32 payload size for the wire benchmark (MiB)")
     ap.add_argument("--wire-steps", type=int, default=10)
+    ap.add_argument("--device-codec", default=None, choices=["int8"],
+                    help="also benchmark the in-jit device-plane codec "
+                         "(HOROVOD_WIRE_COMPRESSION device= plane) over a "
+                         "forced 8-device CPU host platform: encoded/raw "
+                         "wire ratio, quantization error, steps/s vs the "
+                         "uncompressed traced ring")
+    ap.add_argument("--device-mb", type=float, default=4.0,
+                    help="fp32 payload size for the device benchmark (MiB)")
+    ap.add_argument("--device-steps", type=int, default=20)
     ap.add_argument("--metrics", action="store_true",
                     help="also measure the metrics registry's negotiation "
                          "overhead: cache_on rerun with HOROVOD_METRICS=1, "
@@ -343,6 +430,24 @@ def main():
             "max_abs_err": comp["max_abs_err"],
             "steps_ratio_vs_fp32": round(
                 comp["steps_per_s"] / max(base["steps_per_s"], 1e-9), 3),
+        }), flush=True)
+
+    if args.device_codec:
+        elems = int(args.device_mb * (1 << 20)) // 4
+        dbase = run_device_config("none", args.device_steps, elems)
+        dcomp = run_device_config(args.device_codec, args.device_steps,
+                                  elems)
+        assert dbase["device_raw_bytes_per_step"] == 0, \
+            "baseline must not touch the device codec"
+        print(json.dumps({
+            "metric": "device_codec",
+            "codec": args.device_codec,
+            "device_encoded_vs_raw_ratio": round(
+                dcomp["device_encoded_bytes_per_step"]
+                / max(dcomp["device_raw_bytes_per_step"], 1.0), 3),
+            "max_abs_err": dcomp["max_abs_err"],
+            "steps_ratio_vs_fp32": round(
+                dcomp["steps_per_s"] / max(dbase["steps_per_s"], 1e-9), 3),
         }), flush=True)
 
 

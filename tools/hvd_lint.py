@@ -49,7 +49,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # the checker allows conditional declaration but still verifies types.
 OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_fault_spec_check", "hvd_ctrl_plane_stats",
-                    "hvd_flight_record", "hvd_add_process_set2"}
+                    "hvd_flight_record", "hvd_add_process_set2",
+                    "hvd_device_plane_note", "hvd_device_plane_stats",
+                    "hvd_autotune_qdev"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
@@ -427,7 +429,8 @@ def parse_py_codec_map(core_py_text: str) -> Dict[str, int]:
 
 def protocol_pass(sc_text: str, wire_codec_text: str, core_py_text: str,
                   runtime_py_text: str, env_py_text: str,
-                  doc_files: Dict[str, str]) -> List[Finding]:
+                  doc_files: Dict[str, str],
+                  quantize_py_text: str = "") -> List[Finding]:
     findings: List[Finding] = []
     version, tags = parse_protocol_constants(sc_text)
     if version is None:
@@ -524,6 +527,56 @@ def protocol_pass(sc_text: str, wire_codec_text: str, core_py_text: str,
             "protocol", "PROTO-CODEC-NAMES",
             f"env.py WIRE_COMPRESSION_CODECS {env_names} does not match the "
             f"id-ordered wire_codec.h names {want_order}"))
+
+    # Device-plane mirror: ops/quantize.py reimplements the int8 block
+    # codec as traced math, so its block geometry, codec-id map, and the
+    # device-codec name list must track wire_codec.h / env.py exactly —
+    # a drift here desyncs the in-jit ring from the byte-stream semantics.
+    if quantize_py_text:
+        for py_name, cpp_name in (("WIRE_BLOCK", "kWireBlock"),
+                                  ("WIRE_SCALE_BYTES", "kWireScaleBytes")):
+            qm = re.search(r"^%s\s*=\s*(\d+)" % py_name, quantize_py_text,
+                           re.M)
+            cm = re.search(r"constexpr\s+int64_t\s+%s\s*=\s*(\d+)" % cpp_name,
+                           wire_codec_text)
+            if not qm or not cm:
+                findings.append(Finding(
+                    "protocol", f"PROTO-QBLOCK-MISSING:{py_name}",
+                    f"block-geometry constant missing: quantize.py "
+                    f"{py_name} ({'found' if qm else 'absent'}) vs "
+                    f"wire_codec.h {cpp_name} "
+                    f"({'found' if cm else 'absent'})"))
+            elif int(qm.group(1)) != int(cm.group(1)):
+                findings.append(Finding(
+                    "protocol", f"PROTO-QBLOCK:{py_name}",
+                    f"quantize.py {py_name}={qm.group(1)} but wire_codec.h "
+                    f"{cpp_name}={cm.group(1)}"))
+        qi = re.search(r"^WIRE_CODEC_IDS\s*=\s*(\{[^}]*\})", quantize_py_text,
+                       re.M)
+        q_codecs = ({pm.group(1): int(pm.group(2)) for pm in
+                     re.finditer(r'"(\w+)"\s*:\s*(\d+)', qi.group(1))}
+                    if qi else {})
+        if q_codecs != cpp_codecs:
+            findings.append(Finding(
+                "protocol", "PROTO-QCODEC-MIRROR",
+                f"wire codec ids disagree: wire_codec.h {cpp_codecs} vs "
+                f"quantize.py WIRE_CODEC_IDS {q_codecs}"))
+        dm = re.search(r"^DEVICE_WIRE_CODECS\s*=\s*\((.*?)\)",
+                       quantize_py_text, re.M | re.S)
+        dev_names = re.findall(r'"(\w+)"', dm.group(1)) if dm else []
+        edm = re.search(r"DEVICE_WIRE_COMPRESSION_CODECS\s*=\s*\((.*?)\)",
+                        env_py_text, re.S)
+        env_dev = re.findall(r'"(\w+)"', edm.group(1)) if edm else []
+        if dev_names != env_dev:
+            findings.append(Finding(
+                "protocol", "PROTO-DEVICE-CODEC-NAMES",
+                f"quantize.py DEVICE_WIRE_CODECS {dev_names} does not match "
+                f"env.py DEVICE_WIRE_COMPRESSION_CODECS {env_dev}"))
+        for name in dev_names:
+            if name not in cpp_codecs:
+                findings.append(Finding(
+                    "protocol", f"PROTO-DEVICE-CODEC-UNKNOWN:{name}",
+                    f"device codec {name!r} has no wire_codec.h enum id"))
     return findings
 
 
@@ -570,7 +623,8 @@ def run_repo(root: str = REPO) -> List[Finding]:
         py_files["horovod_tpu/_core.py"],
         py_files["horovod_tpu/runtime.py"],
         py_files["horovod_tpu/utils/env.py"],
-        doc_files)
+        doc_files,
+        quantize_py_text=py_files.get("horovod_tpu/ops/quantize.py", ""))
     return findings
 
 

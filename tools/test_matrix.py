@@ -36,6 +36,14 @@ combination of:
            polling the coordinator; a healthy fleet must produce zero
            decisions and an unchanged workload result; one on-combo in
            the quick set
+- qdev:    off / int8 / demote (the HOROVOD_WIRE_COMPRESSION ``device=``
+           plane) — the in-jit int8 block-scaled device ring, exercised
+           over a forced 4-device CPU host platform; "int8" asserts the
+           auto-dispatch engaged (byte counters moved, scale/2-bounded
+           error), "demote" that the min-bytes floor keeps the codec cold
+           and the result bit-identical to the plain collective; np=1
+           rows plus one cross-plane row (host bf16 x device int8); one
+           int8 combo in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -138,6 +146,9 @@ WORKLOAD = textwrap.dedent("""
     # on cross-host topologies (tolerance keyed off the knob; the small
     # tensors above stay under the floor, so their exact asserts hold).
     wire = os.environ.get("HOROVOD_WIRE_COMPRESSION", "none")
+    if "=" in wire:  # per-plane syntax: the host ring takes the host= entry
+        wire = dict(kv.split("=", 1)
+                    for kv in wire.split(",")).get("host", "none")
     wtol = {"bf16": dict(rtol=0.04, atol=1e-3),
             "int8": dict(rtol=0.05, atol=6.0)}.get(wire, dict(rtol=1e-6))
     big = ((np.arange(1 << 16) % 251) + r).astype(np.float32)
@@ -145,6 +156,52 @@ WORKLOAD = textwrap.dedent("""
                for rr in range(s))
     np.testing.assert_allclose(hvd.allreduce(big, op=hvd.Sum, name="m.wire"),
                                wexp, **wtol)
+
+    # qdev axis: the in-jit device-plane ring (HOROVOD_WIRE_COMPRESSION
+    # device=int8) over the forced multi-device host platform.  "int8"
+    # must engage the auto-dispatch (byte counters move) within the codec's
+    # scale/2 error bound; "demote" pins the min-bytes floor: codec stays
+    # cold and the result is bit-identical to the plain collective.
+    qdev = os.environ.get("HVD_MATRIX_QDEV", "off")
+    if qdev != "off":
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        import horovod_tpu.ops.quantize as qz
+        devs = jax.devices()
+        assert len(devs) >= 2, "qdev combo expects a forced multi-dev host"
+        mesh = Mesh(np.asarray(devs), ("q",))
+
+        def _smap(fn):
+            try:
+                return shard_map(fn, mesh=mesh, in_specs=P("q"),
+                                 out_specs=P("q"), check_rep=False)
+            except TypeError:  # newer jax renamed the kwarg
+                return shard_map(fn, mesh=mesh, in_specs=P("q"),
+                                 out_specs=P("q"), check_vma=False)
+
+        qx = ((np.arange(len(devs) * 4096) % 509) / 509.0 - 0.5) \\
+            .astype(np.float32).reshape(len(devs), 4096)
+        qz.reset_device_byte_counters()
+        qout = np.asarray(jax.jit(_smap(
+            lambda shard: hvd.allreduce(shard, axis_name="q")))(
+                jnp.asarray(qx)))
+        qraw, qenc = qz.device_byte_counters()
+        qmean = np.broadcast_to(qx.mean(axis=0), qx.shape)
+        if qdev == "int8":
+            assert qraw > 0 and qenc < qraw, (qraw, qenc)
+            qerr = float(np.max(np.abs(qout - qmean)))
+            assert qerr < 0.5 / len(devs), qerr
+        else:  # demote
+            assert (qraw, qenc) == (0, 0), (qraw, qenc)
+            import jax.lax as lax
+            qplain = np.asarray(jax.jit(_smap(
+                lambda shard: lax.pmean(shard, "q")))(jnp.asarray(qx)))
+            np.testing.assert_array_equal(qout, qplain)
 
     # flight axis: the always-on black box must have recorded the work
     # (ctrl frames exist at np>1 only; np=1 has no socket control plane).
@@ -219,6 +276,9 @@ TORCH_WORKLOAD = textwrap.dedent("""
 
     # big fp32 payload above the wire-compression floor (see jax workload).
     wire = os.environ.get("HOROVOD_WIRE_COMPRESSION", "none")
+    if "=" in wire:  # per-plane syntax: the host ring takes the host= entry
+        wire = dict(kv.split("=", 1)
+                    for kv in wire.split(",")).get("host", "none")
     wtol = {"bf16": dict(rtol=0.04, atol=1e-3),
             "int8": dict(rtol=0.05, atol=6.0)}.get(wire, dict(rtol=1e-6))
     big = torch.remainder(torch.arange(1 << 16, dtype=torch.float32),
@@ -262,6 +322,9 @@ def combos(quick: bool):
         # thread over a healthy fleet; zero decisions, same results.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
                "def", "on")
+        # qdev axis: the one quick device-codec combo (forced 4-dev host).
+        yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+               "def", "off", "int8")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -314,6 +377,20 @@ def combos(quick: bool):
            "def", "on")
     yield ("jax", "native", 3, "off", "off", "tcp", "none", "off", "auto",
            "def", "on")
+    # qdev axis: in-jit device-plane codec over a forced 4-device host
+    # platform — engagement (counters move, bounded error), purepy parity
+    # (the device ring is pure jax; it must not care which core runs the
+    # host plane), one cross-plane combo (host bf16 leader ring + device
+    # int8 ring in the same process), and the min-bytes demotion (codec
+    # configured but cold, bit-identical result).
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int8")
+    yield ("jax", "purepy", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int8")
+    yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off", "auto",
+           "def", "off", "int8")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "demote")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -410,7 +487,8 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
-              autopilot: str, script: str, timeout: float) -> tuple:
+              autopilot: str, qdev: str, script: str,
+              timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -458,8 +536,21 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         # np=3 gives hosts {0,1} + {2} — the smallest hierarchical topology.
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
         env["HOROVOD_HIER_FAKE_HOSTS"] = "2"
+    # The wire and qdev axes share one knob: bare codec = host plane only,
+    # per-plane syntax once the device ring is in play.
+    wire_planes = []
     if wire != "none":
-        env["HOROVOD_WIRE_COMPRESSION"] = wire
+        wire_planes.append(f"host={wire}" if qdev != "off" else wire)
+    if qdev != "off":
+        wire_planes.append("device=int8")
+    if wire_planes:
+        env["HOROVOD_WIRE_COMPRESSION"] = ",".join(wire_planes)
+    if qdev != "off":
+        env["HVD_MATRIX_QDEV"] = qdev
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=4")
+        env["HOROVOD_WIRE_COMPRESSION_MIN_BYTES"] = str(
+            (1 << 30) if qdev == "demote" else 4096)
     if metrics == "on":
         env["HOROVOD_METRICS"] = "1"
     if tree != "auto":
@@ -520,15 +611,17 @@ def main() -> int:
                 combo = combo + ("def",)
             if len(combo) == 10:  # rows predating the autopilot axis
                 combo = combo + ("off",)
+            if len(combo) == 11:  # rows predating the qdev axis
+                combo = combo + ("off",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree, flight, autopilot) = combo
+             tree, flight, autopilot, qdev) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
-                     f"flight={flight:<4} ap={autopilot}")
+                     f"flight={flight:<4} ap={autopilot} qdev={qdev}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
-                                       autopilot,
+                                       autopilot, qdev,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

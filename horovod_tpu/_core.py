@@ -178,6 +178,16 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.hvd_autotune_qdev.argtypes = []
     except AttributeError:
         pass
+    try:
+        # Old-ABI tolerance: a stale .so predating the elastic-migration
+        # plane loses the type-14 forensics and the generation gauge; the
+        # migration protocol itself is Python-side and keeps working.
+        lib.hvd_migrate_note.restype = None
+        lib.hvd_migrate_note.argtypes = [c.c_int, c.c_longlong, c.c_int]
+        lib.hvd_elastic_generation_set.restype = None
+        lib.hvd_elastic_generation_set.argtypes = [c.c_longlong]
+    except AttributeError:
+        pass
 
 
 class NativeCoreError(RuntimeError):
@@ -258,6 +268,14 @@ class NativeCore(CoreBackend):
             raise NativeCoreError(
                 f"native core init failed (rc={rc}, control protocol "
                 f"v{PROTOCOL_VERSION}): {self._last_error()}")
+        if hasattr(self._lib, "hvd_elastic_generation_set"):
+            # Publish the elastic generation the driver assigned us (0 for
+            # non-elastic jobs) as the hvd_elastic_generation gauge.
+            try:
+                gen = int(os.environ.get("HOROVOD_ELASTIC_GENERATION", "0"))
+            except ValueError:
+                gen = 0
+            self._lib.hvd_elastic_generation_set(gen)
         if qdev >= 0 and hasattr(self._lib, "hvd_device_plane_note"):
             # Mirror quantized-collective byte deltas into the native
             # metrics registry (hvd.metrics() / Prometheus exposure).
@@ -561,6 +579,15 @@ class NativeCore(CoreBackend):
         if n <= 0:
             return {}
         return json.loads(buf.raw[:n].decode())
+
+    def migrate_note(self, phase: int, nbytes: int,
+                     source_rank: int = -1) -> None:
+        """Record one elastic-migration phase natively: the migrate
+        counters, a type-14 flight event, and a MIGRATE timeline instant.
+        Silently a no-op on a stale .so predating the entry point."""
+        if hasattr(self._lib, "hvd_migrate_note"):
+            self._lib.hvd_migrate_note(int(phase), int(nbytes),
+                                       int(source_rank))
 
     _warned_no_flight = False
 

@@ -11,13 +11,45 @@ broadcast from rank 0 so every rank resumes bit-identically.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import re
-from typing import Any, Optional
+import threading
+from typing import Any, List, Optional
 
 from . import basics
 from .functions import broadcast_object
+from .utils.logging import get_logger
+
+log = get_logger()
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed file survives power loss (the
+    rename itself is atomic but not durable until the dir entry is)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. non-POSIX filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_pickle(path: str, obj: Any) -> None:
+    """tmp + fsync + rename + dir-fsync: a crash at any point leaves either
+    the old file or the new one, never a truncated hybrid."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
 
 
 def _has_orbax() -> bool:
@@ -72,12 +104,7 @@ class Checkpointer:
                     # Atomic: a crash mid-write must never leave a truncated
                     # ckpt_N.pkl for latest_step() to pick over an older
                     # intact one (orbax finalizes atomically already).
-                    tmp = self._path(step) + ".pkl.tmp"
-                    with open(tmp, "wb") as f:
-                        pickle.dump(host_state, f)
-                        f.flush()
-                        os.fsync(f.fileno())
-                    os.replace(tmp, self._path(step) + ".pkl")
+                    _atomic_pickle(self._path(step) + ".pkl", host_state)
             except Exception as exc:  # noqa: BLE001 - propagate to all ranks
                 err = f"{type(exc).__name__}: {exc}"
         if basics.is_initialized() and basics.size() > 1:
@@ -88,44 +115,251 @@ class Checkpointer:
             raise RuntimeError(f"checkpoint save failed on rank 0: {err}")
 
     # -- restore ------------------------------------------------------------
-    def latest_step(self) -> Optional[int]:
+    def _steps(self) -> List[int]:
         if not os.path.isdir(self.directory):
-            return None
-        steps = []
+            return []
+        steps = set()
         for name in os.listdir(self.directory):
             m = re.fullmatch(r"ckpt_(\d+)(\.pkl)?", name)
             if m:
-                steps.append(int(m.group(1)))
-        return max(steps) if steps else None
+                steps.add(int(m.group(1)))
+        return sorted(steps, reverse=True)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._steps()
+        return steps[0] if steps else None
+
+    def _load_step(self, step: int, target: Any = None) -> Any:
+        if self.use_orbax and os.path.isdir(self._path(step)):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            return ckptr.restore(self._path(step), item=target)
+        with open(self._path(step) + ".pkl", "rb") as f:
+            return pickle.load(f)
 
     def restore(self, step: Optional[int] = None, target: Any = None) -> Any:
         """Read a checkpoint on rank 0 and broadcast it to every rank
         (the reference's broadcast_parameters-on-restart idiom).  Returns
-        None if no checkpoint exists."""
-        if step is None:
-            step = self.latest_step() if self._is_root() else None
-            if basics.is_initialized() and basics.size() > 1:
-                step = broadcast_object(step, root_rank=0,
-                                        name="ckpt.latest_step")
-            if step is None:
-                return None
+        None if no checkpoint exists.
+
+        With no explicit ``step``, a corrupt or truncated latest
+        checkpoint is skipped and the next older intact one is restored —
+        the restore path must never trust whatever happens to exist on
+        disk after a crash."""
+        explicit = step is not None
         state = None
         err: Optional[str] = None
+        found: Optional[int] = None
         if self._is_root():
+            candidates = [step] if explicit else self._steps()
+            errors = []
+            for s in candidates:
+                try:
+                    state = self._load_step(s, target=target)
+                    found = s
+                    break
+                except Exception as exc:  # noqa: BLE001 - propagate below
+                    errors.append(f"step {s}: {type(exc).__name__}: {exc}")
+                    if not explicit:
+                        log.warning("checkpoint at step %s unreadable (%s); "
+                                    "falling back to an older one", s, exc)
+            if found is None and errors:
+                err = "; ".join(errors)
+        if basics.is_initialized() and basics.size() > 1:
+            err, found, state = broadcast_object(
+                (err, found, state), root_rank=0, name="ckpt.restore")
+        if err is not None:
+            raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
+        if found is None:
+            return None
+        return state
+
+
+class ShardedCheckpointer:
+    """Async, per-rank sharded checkpointing.
+
+    Every rank writes its own shard (its slice of the elastic training
+    state) instead of funnelling the whole tree through rank 0:
+    ``<dir>/ckpt_<step>/shard_<rank>.pkl`` plus a rank-0 ``manifest.json``
+    naming the world size.  Writes are asynchronous by default — ``save()``
+    snapshots to host memory synchronously (so the caller may mutate state
+    immediately) and hands the file I/O to a background thread; call
+    :meth:`wait_until_finished` (or the next ``save``) to join it.  Orbax
+    serializes shards when available; the pickle fallback uses the same
+    tmp+fsync+rename+dir-fsync discipline as :class:`Checkpointer`.
+
+    This is the degraded-path restore source for elastic migration: attach
+    one via ``hvd.elastic.migrate.attach_checkpointer(ckpt)`` and the
+    migration falls back to it when peer shards cannot cover a loss.  On
+    restore into a *different* world size, rank ``r`` reads shard
+    ``r if r < saved_world else r % saved_world`` — the same claim rule
+    the live migration uses, so both paths agree on who resumes what.
+    """
+
+    def __init__(self, directory: str, use_orbax: Optional[bool] = None,
+                 async_write: bool = True):
+        self.directory = os.path.abspath(directory)
+        self.use_orbax = _has_orbax() if use_orbax is None else use_orbax
+        self.async_write = async_write
+        self._thread: Optional[threading.Thread] = None
+        self._thread_err: Optional[str] = None
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- identity -----------------------------------------------------------
+    def _world(self):
+        if basics.is_initialized():
+            return basics.rank(), basics.size()
+        return 0, 1
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step}")
+
+    def _shard_path(self, step: int, shard: int) -> str:
+        return os.path.join(self._step_dir(step), f"shard_{shard}.pkl")
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state: Any) -> None:
+        """Snapshot ``state`` to host memory and write this rank's shard.
+        Synchronous part: device→host copy + manifest.  Async part (when
+        ``async_write``): serialization and the atomic file dance."""
+        self.wait_until_finished()
+        rank, size = self._world()
+        try:
+            import jax
+
+            host_state = jax.device_get(state)
+        except ImportError:  # pragma: no cover
+            host_state = state
+        step_dir = self._step_dir(step)
+        os.makedirs(step_dir, exist_ok=True)
+        if rank == 0:
+            # The manifest is written first and names the expected shard
+            # set; a step only counts as complete once every named shard
+            # file exists (shard files appear atomically via rename).
+            _atomic_pickle_json(os.path.join(step_dir, "manifest.json"),
+                                {"step": step, "world": size})
+
+        def _write():
             try:
-                if self.use_orbax and os.path.isdir(self._path(step)):
+                if self.use_orbax:
                     import orbax.checkpoint as ocp
 
                     ckptr = ocp.PyTreeCheckpointer()
-                    state = ckptr.restore(self._path(step), item=target)
+                    ckptr.save(self._shard_path(step, rank)[:-len(".pkl")],
+                               host_state, force=True)
                 else:
-                    with open(self._path(step) + ".pkl", "rb") as f:
-                        state = pickle.load(f)
-            except Exception as exc:  # noqa: BLE001 - propagate to all ranks
-                err = f"{type(exc).__name__}: {exc}"
-        if basics.is_initialized() and basics.size() > 1:
-            err, state = broadcast_object((err, state), root_rank=0,
-                                          name="ckpt.restore")
-        if err is not None:
-            raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
+                    _atomic_pickle(self._shard_path(step, rank), host_state)
+            except Exception as exc:  # noqa: BLE001 - surfaced at join
+                self._thread_err = f"{type(exc).__name__}: {exc}"
+
+        if self.async_write:
+            self._thread = threading.Thread(
+                target=_write, name=f"hvd-ckpt-shard-{rank}", daemon=True)
+            self._thread.start()
+        else:
+            _write()
+            self._raise_pending()
+
+    def wait_until_finished(self) -> None:
+        """Join the in-flight shard write (raises its error, if any)."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._raise_pending()
+
+    def _raise_pending(self) -> None:
+        if self._thread_err is not None:
+            err, self._thread_err = self._thread_err, None
+            raise RuntimeError(f"sharded checkpoint write failed: {err}")
+
+    # -- restore ------------------------------------------------------------
+    def _manifest(self, step: int) -> Optional[dict]:
+        try:
+            with open(os.path.join(self._step_dir(step), "manifest.json"),
+                      encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _complete(self, step: int) -> bool:
+        man = self._manifest(step)
+        if man is None:
+            return False
+        for shard in range(int(man.get("world", 0))):
+            p = self._shard_path(step, shard)
+            if not (os.path.exists(p) or os.path.isdir(p[:-len(".pkl")])):
+                return False
+        return True
+
+    def _steps(self) -> List[int]:
+        if not os.path.isdir(self.directory):
+            return []
+        steps = []
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"ckpt_(\d+)", name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps, reverse=True)
+
+    def latest_step(self) -> Optional[int]:
+        """Newest step whose manifest names only shards that exist."""
+        for s in self._steps():
+            if self._complete(s):
+                return s
+        return None
+
+    def restore(self, step: Optional[int] = None) -> Any:
+        """Load this rank's shard of the newest complete step (or
+        ``step``).  All ranks agree on the step via a rank-0 broadcast;
+        the shard reads themselves are local and parallel.  Returns None
+        when nothing restorable exists."""
+        self.wait_until_finished()
+        rank, size = self._world()
+        if step is None:
+            step = self.latest_step() if rank == 0 else None
+            if basics.is_initialized() and size > 1:
+                step = broadcast_object(step, root_rank=0,
+                                        name="ckpt.shard_step")
+            if step is None:
+                return None
+        man = self._manifest(step)
+        world = int(man["world"]) if man else size
+        shard = rank if rank < world else rank % world
+        path = self._shard_path(step, shard)
+        err: Optional[str] = None
+        state = None
+        try:
+            if self.use_orbax and os.path.isdir(path[:-len(".pkl")]):
+                import orbax.checkpoint as ocp
+
+                ckptr = ocp.PyTreeCheckpointer()
+                state = ckptr.restore(path[:-len(".pkl")])
+            else:
+                with open(path, "rb") as f:
+                    state = pickle.load(f)
+        except Exception as exc:  # noqa: BLE001 - all ranks compare notes
+            err = f"{type(exc).__name__}: {exc}"
+        if basics.is_initialized() and size > 1:
+            from .functions import allgather_object
+
+            errs = allgather_object(err, name="ckpt.shard_status")
+            bad = [f"rank {r}: {e}" for r, e in enumerate(errs)
+                   if e is not None]
+            if bad:
+                raise RuntimeError(
+                    "sharded checkpoint restore failed: " + "; ".join(bad))
+        elif err is not None:
+            raise RuntimeError(f"sharded checkpoint restore failed: {err}")
         return state
+
+
+def _atomic_pickle_json(path: str, obj: Any) -> None:
+    """Same atomic discipline as :func:`_atomic_pickle`, JSON payload."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))

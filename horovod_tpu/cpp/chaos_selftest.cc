@@ -20,6 +20,7 @@
 // handshake gains or loses a fence.
 
 #include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <string>
@@ -27,6 +28,7 @@
 #include <vector>
 
 #include "fault_injection.h"
+#include "flight_recorder.h"
 #include "metrics.h"
 #include "socket_controller.h"
 
@@ -311,6 +313,67 @@ int main() {
   if (lr[3].init_ok && lr[3].reason.empty()) {
     Fail("leader-recv", 3, "orphaned child aborted without a reason");
   }
+
+  // --- migration: forensic planes written concurrently with a collapse --
+  // A hammer thread drives NoteMigration (replication refreshes plus a
+  // migration's manifest/transfer/reassemble phases) while an injected
+  // ring drop collapses the job.  The sanitizer builds prove the type-14
+  // flight path and the hvd_migrate_* counters are race- and UB-free
+  // against the abort machinery (exactly the moment a real migration
+  // observes); the plain build asserts the events landed with the
+  // documented a/b encoding.
+  InitFlightRecorder(true, 4096, "", 0);
+  const int64_t mig_before =
+      GlobalMetrics().migrate_events_total.load(std::memory_order_relaxed);
+  std::atomic<bool> mig_stop{false};
+  std::thread mig_hammer([&mig_stop] {
+    int64_t n = 0;
+    while (!mig_stop.load(std::memory_order_relaxed)) {
+      NoteMigration(kMigrateReplicate, 4096, -1);
+      NoteMigration(kMigrateManifest, 3, -1);
+      NoteMigration(kMigrateTransfer, 4096, static_cast<int>(n % kRanks));
+      NoteMigration(kMigrateReassemble, 4096, 1);
+      ++n;
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+  ExpectAllAborted(
+      "migrate",
+      RunScenario("migrate", "ring-recv:" + std::to_string(rr2) + ":2:drop",
+                  /*cycles=*/2, /*do_barrier=*/false),
+      /*bound_s=*/6.0);
+  mig_stop.store(true);
+  mig_hammer.join();
+  NoteMigration(kMigrateFallback, 0, -1);
+  MetricsRegistry& mm = GlobalMetrics();
+  if (mm.migrate_events_total.load(std::memory_order_relaxed) <= mig_before) {
+    Fail("migrate", -1, "migrate_events_total never advanced");
+  }
+  if (mm.migrate_bytes_total.load(std::memory_order_relaxed) <= 0) {
+    Fail("migrate", -1, "migrate_bytes_total never accumulated");
+  }
+  if (mm.migrate_fallbacks_total.load(std::memory_order_relaxed) < 1) {
+    Fail("migrate", -1, "migrate_fallbacks_total missed the fallback");
+  }
+  std::vector<FlightEvent> mig_tail;
+  FlightTail(4096, &mig_tail);
+  bool saw_transfer = false;
+  for (const FlightEvent& e : mig_tail) {
+    if (e.type != kFlightMigrate) continue;
+    const int phase = e.a >> 8;
+    const int src = (e.a & 0xFF) - 1;
+    if (phase < kMigrateReplicate || phase > kMigrateFallback) {
+      Fail("migrate", -1, "type-14 event with out-of-range phase " +
+                              std::to_string(phase));
+    }
+    if (phase == kMigrateTransfer && src >= 0 && e.b == 4096) {
+      saw_transfer = true;
+    }
+  }
+  if (!saw_transfer) {
+    Fail("migrate", -1, "no transfer-phase type-14 event recorded");
+  }
+  ResetFlightRecorderForTest();
 
   ::unsetenv("HOROVOD_FAULT_INJECT");
   InitFaultInjection();

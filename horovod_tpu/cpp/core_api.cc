@@ -961,4 +961,34 @@ const char* hvd_fault_spec_check(const char* spec) {
   return err.c_str();
 }
 
+// Elastic-migration forensic note (docs/elastic.md "Zero-downtime
+// migration"): one call per migration phase on each participating rank.
+// Routes through the shared NoteMigration (metrics counters + flight
+// type 14) and lands a MIGRATE instant on the host timeline.  A fallback
+// phase forces a flight dump like an autopilot decision does — the
+// checkpoint path it announces usually follows a generation teardown.
+void hvd_migrate_note(int phase, long long bytes, int source_rank) {
+  NoteMigration(phase, bytes, source_rank);
+  if (g != nullptr) {
+    g->timeline.Instant(
+        "MIGRATE", "{\"phase\":" + std::to_string(phase) +
+                       ",\"bytes\":" + std::to_string(bytes) +
+                       ",\"source_rank\":" + std::to_string(source_rank) +
+                       "}");
+  }
+  if (phase == kMigrateFallback && FlightOn() &&
+      !FlightPostmortemDir().empty()) {
+    FlightDumpToFile();
+  }
+}
+
+// Publishes the elastic generation this rank joined (from the driver's
+// assignment) as a metrics gauge, so scrapes can correlate migrate/abort
+// counters with re-formations.  Callable before/without init — the
+// registry is process-global.
+void hvd_elastic_generation_set(long long generation) {
+  GlobalMetrics().elastic_generation.store(generation,
+                                           std::memory_order_relaxed);
+}
+
 }  // extern "C"

@@ -18,6 +18,7 @@
 
 #include <sys/resource.h>
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
@@ -26,6 +27,7 @@
 #include <thread>
 #include <vector>
 
+#include "metrics.h"
 #include "socket_controller.h"
 
 namespace hvdtpu {
@@ -49,6 +51,11 @@ int FreePort() {
   if (!probe.Listen("127.0.0.1", 0)) return -1;
   return probe.port();
 }
+
+// When set, every rank notes one replication refresh per negotiation cycle
+// — the soak's migration-aware row: 256 concurrent NoteMigration writers
+// against the live control plane.
+std::atomic<bool> g_migrate{false};
 
 // Reusable rendezvous-style barrier: the main thread participates so it can
 // snapshot the coordinator's counters while every rank thread is parked
@@ -117,6 +124,9 @@ void SoakRank(const char* phase_name, int rank, int size, int port,
       if (resps.size() != 1 || !resps[0].error.empty()) {
         *err = "cycle " + std::to_string(cycle) + ": bad response";
         break;
+      }
+      if (g_migrate.load(std::memory_order_relaxed)) {
+        NoteMigration(kMigrateReplicate, req.nbytes, -1);
       }
     }
   }
@@ -220,6 +230,35 @@ int main() {
       Fail("soak", -1,
            "flat/tree ratio " + std::to_string(flat) + "/" +
                std::to_string(tree) + " is below the required 8x");
+    }
+  }
+
+  // Migration-aware row: the same tree geometry with every rank noting a
+  // peer-shard replication refresh per cycle.  Proves np=256 concurrent
+  // NoteMigration writers are race-free against the live control plane
+  // (sanitizer builds) and that forensic noting does not perturb the
+  // per-cycle control-message shape.
+  if (failures == 0) {
+    GlobalMetrics().enabled.store(true, std::memory_order_relaxed);
+    const int64_t mig0 =
+        GlobalMetrics().migrate_events_total.load(std::memory_order_relaxed);
+    g_migrate.store(true, std::memory_order_relaxed);
+    const int64_t tree_mig = RunPhase("tree+migrate", "on", np, cycles);
+    g_migrate.store(false, std::memory_order_relaxed);
+    const int64_t mig_delta =
+        GlobalMetrics().migrate_events_total.load(std::memory_order_relaxed) -
+        mig0;
+    const int64_t tree_expect = (np / hosts - 1) + (hosts - 1);
+    if (mig_delta < static_cast<int64_t>(np) * cycles) {
+      Fail("tree+migrate", -1,
+           "migrate_events_total advanced " + std::to_string(mig_delta) +
+               ", expected >= " + std::to_string(np * cycles));
+    }
+    if (tree_mig != tree_expect) {
+      Fail("tree+migrate", 0,
+           "replication noting perturbed the control plane: " +
+               std::to_string(tree_mig) + " msgs/cycle, expected " +
+               std::to_string(tree_expect));
     }
   }
 

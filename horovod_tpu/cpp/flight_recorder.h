@@ -39,6 +39,8 @@ enum FlightType : int32_t {
   kFlightAbort = 11,      // a = culprit rank, b = 0 observed / 1 broadcast
   kFlightDigest = 12,     // a = source rank,  b = events carried
   kFlightAutopilot = 13,  // a = action code,  b = target rank
+  kFlightMigrate = 14,    // a = phase<<8 | source rank (+1; 0 = none),
+                          // b = payload bytes
 };
 
 struct FlightEvent {

@@ -3,6 +3,8 @@
 #include <cstdio>
 #include <sstream>
 
+#include "flight_recorder.h"
+
 namespace hvdtpu {
 
 int64_t Histogram::QuantileUs(double q) const {
@@ -64,6 +66,10 @@ void MetricsRegistry::Reset() {
   ctrl_msgs_recv.store(0, std::memory_order_relaxed);
   ctrl_bytes_sent.store(0, std::memory_order_relaxed);
   ctrl_bytes_recv.store(0, std::memory_order_relaxed);
+  migrate_events_total.store(0, std::memory_order_relaxed);
+  migrate_bytes_total.store(0, std::memory_order_relaxed);
+  migrate_fallbacks_total.store(0, std::memory_order_relaxed);
+  elastic_generation.store(0, std::memory_order_relaxed);
   negotiation_wait_us.Reset();
   ring_hop_us.Reset();
   shm_fence_us.Reset();
@@ -110,6 +116,15 @@ std::string MetricsRegistry::DumpJson(int rank,
      << ctrl_bytes_sent.load(std::memory_order_relaxed)
      << ",\"ctrl_bytes_recv\":"
      << ctrl_bytes_recv.load(std::memory_order_relaxed)
+     << ",\"migrate_events_total\":"
+     << migrate_events_total.load(std::memory_order_relaxed)
+     << ",\"migrate_bytes_total\":"
+     << migrate_bytes_total.load(std::memory_order_relaxed)
+     << ",\"migrate_fallbacks_total\":"
+     << migrate_fallbacks_total.load(std::memory_order_relaxed)
+     << "},\"gauges\":{"
+     << "\"elastic_generation\":"
+     << elastic_generation.load(std::memory_order_relaxed)
      << "},\"histograms\":{"
      << "\"negotiation_wait_us\":" << negotiation_wait_us.Json()
      << ",\"ring_hop_us\":" << ring_hop_us.Json()
@@ -140,6 +155,25 @@ std::string MetricsRegistry::DumpJson(int rank,
 MetricsRegistry& GlobalMetrics() {
   static MetricsRegistry registry;
   return registry;
+}
+
+void NoteMigration(int phase, int64_t bytes, int source_rank) {
+  if (MetricsOn()) {
+    MetricsRegistry& m = GlobalMetrics();
+    m.migrate_events_total.fetch_add(1, std::memory_order_relaxed);
+    if (bytes > 0)
+      m.migrate_bytes_total.fetch_add(bytes, std::memory_order_relaxed);
+    if (phase == kMigrateFallback)
+      m.migrate_fallbacks_total.fetch_add(1, std::memory_order_relaxed);
+  }
+  if (FlightOn()) {
+    // a = phase << 8 | (source_rank + 1); 0 in the low byte means "no
+    // specific peer".  Ranks past 254 saturate rather than bleed into
+    // the phase bits.
+    int src = source_rank < 0 ? 0 : (source_rank >= 254 ? 255
+                                                        : source_rank + 1);
+    FlightRecord(kFlightMigrate, (phase << 8) | src, bytes);
+  }
 }
 
 std::string JsonEscape(const std::string& s) {

@@ -101,6 +101,19 @@ struct MetricsRegistry {
   std::atomic<int64_t> ctrl_bytes_sent{0};
   std::atomic<int64_t> ctrl_bytes_recv{0};
 
+  // Elastic state-migration plane (docs/elastic.md "Zero-downtime
+  // migration"): replication refreshes, shard handoffs and their payload
+  // bytes, and checkpoint fallbacks taken when peer shards could not
+  // cover a loss.
+  std::atomic<int64_t> migrate_events_total{0};
+  std::atomic<int64_t> migrate_bytes_total{0};
+  std::atomic<int64_t> migrate_fallbacks_total{0};
+
+  // Gauges (last-written value, not monotone): the elastic generation
+  // this rank most recently joined, so dashboards can correlate
+  // migrate/abort counters with re-formations.
+  std::atomic<int64_t> elastic_generation{0};
+
   // Latency distributions.
   Histogram negotiation_wait_us;  // enqueue -> fused response mapped back
   Histogram ring_hop_us;          // one pipelined chunk exchange step
@@ -139,6 +152,24 @@ MetricsRegistry& GlobalMetrics();
 inline bool MetricsOn() {
   return GlobalMetrics().enabled.load(std::memory_order_relaxed);
 }
+
+// Elastic-migration phase codes, carried in the type-14 flight event's
+// `a` field as phase << 8 | (source_rank + 1).  Keep in sync with
+// horovod_tpu/elastic/migrate.py PHASE_* and tools/postmortem.py
+// _MIGRATE_PHASES.
+enum MigratePhase : int {
+  kMigrateReplicate = 1,   // periodic shard refresh onto ring neighbors
+  kMigrateManifest = 2,    // post-reformation shard-manifest allgather
+  kMigrateTransfer = 3,    // targeted shard transfers to claimants
+  kMigrateReassemble = 4,  // per-rank state reassembly from shards
+  kMigrateFallback = 5,    // replication could not cover; checkpoint path
+};
+
+// Shared note point for the migration plane, callable from the extern-C
+// ABI and the in-process selftests alike: bumps the migrate counters
+// (under MetricsOn) and records a type-14 flight event (under FlightOn).
+// `source_rank` < 0 means "no specific peer".
+void NoteMigration(int phase, int64_t bytes, int source_rank);
 
 // JSON string-body escaping shared by the timeline writer, the metrics
 // dump, and the error-string paths: quotes, backslashes, and all control

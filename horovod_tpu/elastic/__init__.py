@@ -18,6 +18,7 @@ from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils.logging import get_logger
 from .state import State, ObjectState, JaxState, ElasticSampler  # noqa: F401
 from . import client as _client
+from . import migrate  # noqa: F401  (re-export: hvd.elastic.migrate)
 
 log = get_logger()
 
@@ -43,7 +44,9 @@ def run(func):
             if reset_required:
                 _reset(state)
                 reset_required = False
-            state.sync()
+            # Migration-aware sync: resume from in-memory peer shards when
+            # they cover the re-formation, checkpoint/broadcast otherwise.
+            migrate.sync_state(state)
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as exc:
@@ -93,4 +96,5 @@ def _reset(state: State) -> None:
     from ..process_sets import reregister_all
 
     reregister_all()
+    migrate.on_reset()
     state.on_reset()

@@ -1,0 +1,571 @@
+"""Zero-downtime elastic state migration: peer-shard replication plus live
+state handoff across re-formations (docs/elastic.md "Zero-downtime
+migration").
+
+Instead of restarting every elastic generation from the last rank-0
+checkpoint, each rank continuously replicates a shard of the full training
+state — the committed :class:`~horovod_tpu.elastic.state.ObjectState`
+snapshot: model params, optimizer moments, error-feedback residuals, step
+counters — onto its ``HOROVOD_MIGRATE_REPLICAS`` ring-successor ranks,
+refreshed every ``HOROVOD_MIGRATE_INTERVAL_STEPS`` commits over the
+existing eager data plane (one byte-split ``alltoall`` per refresh).
+
+On re-formation the ``@hvd.elastic.run`` wrapper calls :func:`sync_state`
+instead of the plain rank-0 ``state.sync()`` broadcast.  The migration
+protocol is collectively symmetric — survivors re-entering after
+``_reset`` and freshly respawned workers execute the identical sequence:
+
+1. **Manifest** — every rank allgathers what it holds (its live identity
+   and the shard records in its store).
+2. **Plan** — :func:`plan_migration` computes, identically on every rank,
+   the consistent cut to resume from, who provides each shard, who claims
+   it, and which orphaned shards are parked on custodians.
+3. **Transfer** — one targeted byte-split ``alltoall`` moves exactly the
+   missing shards.
+4. **Reassemble** — each rank adopts its claimed shard bit-for-bit (the
+   sha256 digest is verified) and re-seeds replication for the new ring.
+
+When some shard cannot be covered (all its replica holders died, or
+replication is disabled) every rank deterministically takes the same
+fallback: restore from the attached checkpointer
+(:class:`horovod_tpu.checkpoint.ShardedCheckpointer` — async, per-rank
+shards) when it has data, else the reference rank-0 ``sync()`` broadcast.
+
+Every phase is a first-class forensic event: flight-recorder type 14
+(``migrate``), the ``hvd_migrate_*`` metrics counters, a ``MIGRATE``
+timeline instant, and a ``migrate`` row in the autopilot journal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+# Phase codes carried in the type-14 flight event (mirror of MigratePhase
+# in cpp/metrics.h and _MIGRATE_PHASES in tools/postmortem.py).
+PHASE_REPLICATE = 1
+PHASE_MANIFEST = 2
+PHASE_TRANSFER = 3
+PHASE_REASSEMBLE = 4
+PHASE_FALLBACK = 5
+
+PHASE_NAMES = {PHASE_REPLICATE: "replicate", PHASE_MANIFEST: "manifest",
+               PHASE_TRANSFER: "transfer", PHASE_REASSEMBLE: "reassemble",
+               PHASE_FALLBACK: "fallback"}
+
+
+@dataclasses.dataclass
+class ShardRecord:
+    """One rank's full committed state, pickled, plus the metadata the
+    migration planner needs.  ``owner``/``world`` name the shard in the
+    numbering of the world it was cut from; ``commits`` is the lockstep
+    commit count at the cut (the planner's consistency coordinate)."""
+
+    owner: int
+    world: int
+    commits: int
+    digest: str
+    data: bytes
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.data)
+
+    def meta(self) -> Tuple[int, int, int, int, str]:
+        return (self.world, self.owner, self.commits, self.nbytes,
+                self.digest)
+
+
+class ShardStore:
+    """Per-process shard memory.  Lives in plain Python memory, so it
+    survives ``hvd.shutdown()`` → ``hvd.init()`` re-formations; a respawned
+    worker starts with an empty store and is fed by the migration."""
+
+    def __init__(self):
+        self.own: Optional[ShardRecord] = None
+        # (world, owner, commits) -> record replicated to us by a peer.
+        self.peers: Dict[Tuple[int, int, int], ShardRecord] = {}
+        # Orphaned shards this rank is custodian of after a shrink,
+        # forwarded on every replication so they stay covered.
+        self.parked: Dict[Tuple[int, int, int], ShardRecord] = {}
+        # Lockstep commit counter (one per State.commit on every rank).
+        self.commits = 0
+        # Commits since the last replication refresh; primed past the
+        # interval so the first commit after a migration re-seeds.
+        self.since_repl = 0
+        self.checkpointer = None
+
+    def records(self) -> List[ShardRecord]:
+        out = [] if self.own is None else [self.own]
+        out.extend(self.peers.values())
+        out.extend(self.parked.values())
+        return out
+
+    def find(self, world: int, owner: int, commits: int) \
+            -> Optional[ShardRecord]:
+        if (self.own is not None and self.own.world == world
+                and self.own.owner == owner and self.own.commits == commits):
+            return self.own
+        key = (world, owner, commits)
+        return self.peers.get(key) or self.parked.get(key)
+
+    def prune(self, world: int, commits: int) -> None:
+        """Drop records older than the adopted cut (they can never be a
+        future cut: the planner always resumes at the newest coverable
+        one)."""
+        for d in (self.peers, self.parked):
+            for key in [k for k in d
+                        if k[0] != world or k[2] < commits]:
+                del d[key]
+
+
+_store = ShardStore()
+
+
+def store() -> ShardStore:
+    return _store
+
+
+def reset_store_for_test() -> None:
+    global _store
+    _store = ShardStore()
+
+
+def attach_checkpointer(ckpt) -> None:
+    """Register the checkpointer :func:`sync_state` falls back to when
+    peer shards cannot cover a loss (typically a
+    :class:`~horovod_tpu.checkpoint.ShardedCheckpointer`)."""
+    _store.checkpointer = ckpt
+
+
+# ---------------------------------------------------------------------------
+# config / plumbing
+# ---------------------------------------------------------------------------
+
+def _cfg():
+    from .. import basics
+    from ..context import HorovodContext
+
+    if not basics.is_initialized():
+        return None
+    return HorovodContext.instance().cfg
+
+
+def _note(phase: int, nbytes: int, source_rank: int = -1) -> None:
+    from .. import basics
+    from ..context import HorovodContext
+
+    if not basics.is_initialized():
+        return
+    note = getattr(HorovodContext.instance().core, "migrate_note", None)
+    if note is not None:
+        note(phase, nbytes, source_rank)
+
+
+def _journal(detail: str) -> None:
+    """Rank 0 appends a ``migrate`` row to the autopilot journal so the
+    post-mortem report names migrations alongside fleet decisions."""
+    from .. import basics
+
+    if basics.is_initialized() and basics.rank() != 0:
+        return
+    pm_dir = os.environ.get("HOROVOD_POSTMORTEM_DIR")
+    if not pm_dir:
+        return
+    try:
+        gen = int(os.environ.get("HOROVOD_ELASTIC_GENERATION", "0") or 0)
+    except ValueError:
+        gen = 0
+    row = {"ts": time.time(), "generation": gen, "action": "migrate",
+           "rank": basics.rank() if basics.is_initialized() else 0,
+           "detail": detail}
+    try:
+        with open(os.path.join(pm_dir, "autopilot.jsonl"), "a",
+                  encoding="utf-8") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _snapshot_bytes(payload: Dict[str, Any]) -> bytes:
+    return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _apply_record(state, rec: ShardRecord) -> None:
+    got = _digest(rec.data)
+    if got != rec.digest:
+        raise RuntimeError(
+            f"migration shard (owner {rec.owner}, world {rec.world}, "
+            f"commit {rec.commits}) failed digest check: {got[:12]} != "
+            f"{rec.digest[:12]}")
+    payload = pickle.loads(rec.data)
+    if not (isinstance(payload, dict) and "attrs" in payload):
+        payload = {"attrs": payload}  # plain attr-dict record (hand-built)
+    state._migration_apply(payload)
+
+
+# ---------------------------------------------------------------------------
+# replication (runs inside State.commit)
+# ---------------------------------------------------------------------------
+
+def on_commit(state) -> None:
+    """Called by ``State.commit()`` right after ``save()``: counts the
+    lockstep commit and, every ``HOROVOD_MIGRATE_INTERVAL_STEPS`` commits,
+    refreshes this rank's shard on its ring successors."""
+    st = _store
+    st.commits += 1
+    st.since_repl += 1
+    cfg = _cfg()
+    if cfg is None or cfg.migrate_replicas <= 0:
+        return
+    from .. import basics
+
+    if basics.size() <= 1:
+        return
+    if st.since_repl < max(1, cfg.migrate_interval_steps):
+        return
+    st.since_repl = 0
+    _replicate(state, cfg)
+
+
+def _replicate(state, cfg) -> None:
+    from .. import basics
+    from ..mpi_ops import alltoall
+
+    st = _store
+    rank, size = basics.rank(), basics.size()
+    data = _snapshot_bytes(state._migration_snapshot())
+    st.own = ShardRecord(owner=rank, world=size, commits=st.commits,
+                         digest=_digest(data), data=data)
+    nrep = min(cfg.migrate_replicas, size - 1)
+    successors = {(rank + i) % size for i in range(1, nrep + 1)}
+    # Parked orphans ride along so shards from a shrunken world stay
+    # replicated even though their owner is gone.
+    payload = pickle.dumps([st.own] + list(st.parked.values()),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    chunks = [payload if d in successors else b"" for d in range(size)]
+    buf = np.frombuffer(b"".join(chunks), dtype=np.uint8).copy()
+    splits = [len(c) for c in chunks]
+    received, rsplits = alltoall(buf, splits=splits,
+                                 name="elastic.migrate.replicate")
+    received = np.asarray(received)
+    offset = 0
+    for src, n in enumerate(np.asarray(rsplits).ravel().tolist()):
+        n = int(n)
+        if n:
+            for rec in pickle.loads(received[offset:offset + n].tobytes()):
+                st.peers[(rec.world, rec.owner, rec.commits)] = rec
+        offset += n
+    # One refresh replicated len(successors) copies of this shard.
+    _note(PHASE_REPLICATE, len(payload) * len(successors))
+    # Keep at most one replication generation of peer shards: a newer
+    # record for the same (world, owner) supersedes the old cut.
+    for key in [k for k in st.peers
+                if (k[0], k[1]) == (st.own.world, st.own.owner)
+                and k[2] < st.own.commits]:
+        del st.peers[key]
+    newest = max(k[2] for k in st.peers) if st.peers else st.commits
+    for key in [k for k in st.peers if k[2] < newest - 1]:
+        del st.peers[key]
+
+
+# ---------------------------------------------------------------------------
+# the migration planner (pure — unit-tested without any collectives)
+# ---------------------------------------------------------------------------
+
+def build_manifest() -> dict:
+    """This rank's contribution to the migration plan: the identity of the
+    live state it carries (owner id in that state's world numbering) and
+    the metadata of every shard record it holds."""
+    st = _store
+    return {
+        "live_owner": st.own.owner if st.own is not None else None,
+        "live_world": st.own.world if st.own is not None else 0,
+        "live_commits": st.commits,
+        "records": [r.meta() for r in st.records()],
+    }
+
+
+def plan_migration(manifests: List[dict], new_size: int) -> dict:
+    """Compute the migration plan from the allgathered manifests.
+
+    Pure and deterministic: every rank runs it on the identical input and
+    reaches the identical plan (including the fallback verdict), so the
+    collective sequence that follows never diverges.
+
+    Returns a dict with ``mode`` one of:
+
+    - ``cold`` — nobody holds anything: generation-0 start (or replication
+      disabled everywhere); the caller does the reference rank-0 sync.
+    - ``live`` — every shard owner is alive with intact in-memory state:
+      resume at the live commit count; only newcomers receive transfers.
+    - ``replica`` — some owners died: every rank rolls to the newest
+      replication cut covering all owners and adopts its claimed shard.
+    - ``fallback`` — no cut covers every owner: checkpoint restore.
+
+    Non-cold plans carry ``world`` (the shard namespace = owner count),
+    ``cut`` (the commit count resumed from), ``claims`` (new rank ->
+    owner), ``holders`` (owner -> providing new rank), ``transfers``
+    (``(src, dst, owner)`` triples), ``orphans`` and ``custodians``.
+    """
+    live_worlds = [m["live_world"] for m in manifests
+                   if m["live_owner"] is not None]
+    rec_worlds = [meta[0] for m in manifests for meta in m["records"]]
+    if not live_worlds and not rec_worlds:
+        return {"mode": "cold"}
+    # Live identities define the current shard namespace.  A stray record
+    # from an older world (e.g. a parked orphan the fleet trained past
+    # during a shrunken window) must not drag the plan back to a dead
+    # numbering — prefer the live world, use records only when nobody
+    # carries live state (all-respawn recovery).
+    world = max(live_worlds) if live_worlds else max(rec_worlds)
+    owners = set(range(world))
+
+    live: Dict[int, int] = {}
+    for r, m in enumerate(manifests):
+        if m["live_owner"] is not None and m["live_world"] == world:
+            live.setdefault(int(m["live_owner"]), r)
+
+    def _holds(r: int, owner: int, cut: int) -> bool:
+        return any(meta[0] == world and meta[1] == owner and meta[2] == cut
+                   for meta in manifests[r]["records"])
+
+    if owners <= set(live):
+        # Every owner survived (pure growth / no-op re-formation): the
+        # cut is the live state itself; nobody rolls back.
+        mode = "live"
+        cut = max(m["live_commits"] for r, m in enumerate(manifests)
+                  if r in live.values())
+        holders = dict(live)
+    else:
+        # Some owner is gone: resume from the newest replication cut
+        # that covers every owner of the shard namespace.
+        per: Dict[int, Dict[int, int]] = {o: {} for o in owners}
+        for r, m in enumerate(manifests):
+            for (w, o, c, _nb, _dg) in m["records"]:
+                if w == world and o in per:
+                    prev = per[o].get(c)
+                    per[o][c] = r if prev is None else min(prev, r)
+        common = set.intersection(*[set(d) for d in per.values()]) \
+            if per else set()
+        if not common:
+            missing = sorted(o for o in owners if not per[o])
+            return {"mode": "fallback", "world": world,
+                    "reason": f"no replication cut covers owners "
+                              f"{missing or sorted(owners)} of world "
+                              f"{world}"}
+        mode = "replica"
+        cut = max(common)
+        holders = {o: per[o][cut] for o in owners}
+
+    claims = {r: (r if r < world else r % world) for r in range(new_size)}
+    orphans = sorted(owners - set(claims.values()))
+    custodians = {o: o % new_size for o in orphans}
+
+    transfers: List[Tuple[int, int, int]] = []
+    for r in range(new_size):
+        o = claims[r]
+        if mode == "live":
+            if live.get(o) == r:
+                continue  # keeps its own live state
+        elif _holds(r, o, cut):
+            continue  # already stores the cut record
+        if holders[o] != r:
+            transfers.append((holders[o], r, o))
+    for o in orphans:
+        d = custodians[o]
+        if mode == "live" or not _holds(d, o, cut):
+            if holders[o] != d:
+                transfers.append((holders[o], d, o))
+
+    return {"mode": mode, "world": world, "cut": cut, "claims": claims,
+            "holders": holders, "transfers": transfers, "orphans": orphans,
+            "custodians": custodians}
+
+
+# ---------------------------------------------------------------------------
+# the migration phase (runs at every elastic-wrapper loop entry)
+# ---------------------------------------------------------------------------
+
+def sync_state(state) -> None:
+    """Migration-aware replacement for the wrapper's ``state.sync()``:
+    resume the new world from in-memory peer shards when they cover the
+    loss, fall back to the checkpoint (then the rank-0 broadcast) when
+    they cannot.  Collectively symmetric — survivors and respawned
+    workers run the identical sequence."""
+    from .. import basics
+
+    if not basics.is_initialized() or basics.size() <= 1:
+        state.sync()
+        return
+
+    from ..functions import allgather_object
+
+    st = _store
+    rank, size = basics.rank(), basics.size()
+    manifest = build_manifest()
+    manifests = allgather_object(manifest, name="elastic.migrate.manifest")
+    _note(PHASE_MANIFEST, sum(len(m["records"]) for m in manifests))
+
+    plan = plan_migration(manifests, size)
+    mode = plan["mode"]
+    if mode == "cold":
+        state.sync()
+        return
+    if mode == "fallback":
+        _fallback(state, plan["reason"])
+        return
+
+    world, cut = plan["world"], plan["cut"]
+    _run_transfers(state, plan, manifests)
+    _reassemble(state, plan)
+    _journal(f"mode={mode} world={world} size={size} cut={cut} "
+             f"transfers={len(plan['transfers'])} "
+             f"orphans={len(plan['orphans'])}")
+    log.info("elastic migration: %s resume of world %d at commit %d "
+             "(rank %d/%d, %d transfers)", mode, world, cut, rank, size,
+             len(plan["transfers"]))
+
+
+def _outgoing_record(state, plan, owner: int) -> ShardRecord:
+    """The record this rank provides for ``owner`` under ``plan``."""
+    st = _store
+    world, cut = plan["world"], plan["cut"]
+    if plan["mode"] == "live":
+        # Live mode ships the CURRENT state (which may be ahead of the
+        # last replication refresh) — serialized once per migration.
+        if st.own is None or st.own.commits != cut \
+                or st.own.owner != owner:
+            data = _snapshot_bytes(state._migration_live())
+            st.own = ShardRecord(owner=owner, world=world, commits=cut,
+                                 digest=_digest(data), data=data)
+        return st.own
+    rec = st.find(world, owner, cut)
+    if rec is None:  # the plan said we hold it; a miss is a real bug
+        raise RuntimeError(
+            f"migration plan names rank {plan['holders'][owner]} as holder "
+            f"of shard {owner}@{cut} (world {world}) but the store has no "
+            f"such record")
+    return rec
+
+
+def _run_transfers(state, plan, manifests) -> None:
+    from .. import basics
+    from ..mpi_ops import alltoall
+
+    st = _store
+    rank, size = basics.rank(), basics.size()
+    if not plan["transfers"]:
+        return
+    outgoing: Dict[int, List[ShardRecord]] = {}
+    sent_bytes = 0
+    for (src, dst, owner) in plan["transfers"]:
+        if src != rank:
+            continue
+        rec = _outgoing_record(state, plan, owner)
+        outgoing.setdefault(dst, []).append(rec)
+        sent_bytes += rec.nbytes
+    chunks = [pickle.dumps(outgoing[d], protocol=pickle.HIGHEST_PROTOCOL)
+              if d in outgoing else b"" for d in range(size)]
+    buf = np.frombuffer(b"".join(chunks), dtype=np.uint8).copy()
+    splits = [len(c) for c in chunks]
+    received, rsplits = alltoall(buf, splits=splits,
+                                 name="elastic.migrate.transfer")
+    received = np.asarray(received)
+    offset = 0
+    for src, n in enumerate(np.asarray(rsplits).ravel().tolist()):
+        n = int(n)
+        if n:
+            for rec in pickle.loads(received[offset:offset + n].tobytes()):
+                st.peers[(rec.world, rec.owner, rec.commits)] = rec
+                _note(PHASE_TRANSFER, rec.nbytes, src)
+        offset += n
+    if sent_bytes:
+        _note(PHASE_TRANSFER, sent_bytes)
+
+
+def _reassemble(state, plan) -> None:
+    from .. import basics
+
+    st = _store
+    rank, size = basics.rank(), basics.size()
+    world, cut, mode = plan["world"], plan["cut"], plan["mode"]
+    claim = plan["claims"][rank]
+    keeps_live = (mode == "live" and st.own is not None
+                  and st.own.owner == claim and st.own.world == world)
+    if not keeps_live:
+        rec = st.find(world, claim, cut)
+        if rec is None:
+            raise RuntimeError(
+                f"migration transfer did not deliver shard {claim}@{cut} "
+                f"(world {world}) to rank {rank}")
+        _apply_record(state, rec)
+        st.own = rec
+        _note(PHASE_REASSEMBLE, rec.nbytes, plan["holders"][claim])
+    else:
+        _note(PHASE_REASSEMBLE, 0, rank)
+    # Adopt the cut's commit coordinate and keep custody of orphans.
+    st.commits = cut
+    for o in plan["orphans"]:
+        if plan["custodians"][o] == rank:
+            rec = st.find(world, o, cut)
+            if rec is not None:
+                st.parked[(world, o, cut)] = rec
+    st.prune(world, cut)
+    # Custody is exactly the plan's orphan set: drop parked shards whose
+    # owner is live again (claimed by a rank of the new world).
+    st.parked = {k: v for k, v in st.parked.items()
+                 if k[1] in plan["orphans"]}
+    # Force a replication refresh at the next commit so the new ring's
+    # successors hold shards again without waiting a full interval.
+    cfg = _cfg()
+    st.since_repl = cfg.migrate_interval_steps if cfg else 1 << 30
+
+
+def _fallback(state, reason: str) -> None:
+    """Deterministic degraded path: every rank reached the same verdict
+    from the same manifests, so the collective shape stays symmetric."""
+    from .. import basics
+
+    st = _store
+    _note(PHASE_FALLBACK, 0)
+    _journal(f"fallback: {reason}")
+    log.warning("elastic migration: falling back (%s)", reason)
+    restored = None
+    if st.checkpointer is not None:
+        restored = st.checkpointer.restore()
+    if isinstance(restored, dict) and restored:
+        for k, v in restored.items():
+            setattr(state, k, v)
+            if k not in state._known_attrs:
+                state._known_attrs.append(k)
+        state.save()
+    else:
+        # No checkpoint either: the reference rank-0 broadcast is the
+        # last resort (a fresh worker then starts from rank 0's state).
+        state.sync()
+    st.since_repl = 1 << 30  # re-seed replication at the next commit
+    st.commits = int(np.max([st.commits, 0]))
+
+
+def on_reset() -> None:
+    """Light hook run by ``elastic._reset`` after re-init: the heavy
+    lifting happens in :func:`sync_state` (which both survivors and
+    respawned workers reach), so the reset itself only logs."""
+    log.debug("elastic migration: reset observed; store holds %d records",
+              len(_store.records()))

@@ -58,8 +58,15 @@ class State:
 
     def commit(self) -> None:
         """Snapshot the state and surface pending host updates — call at
-        batch/epoch boundaries you are willing to roll back to."""
+        batch/epoch boundaries you are willing to roll back to.  Each
+        commit also advances peer-shard replication (see
+        :mod:`horovod_tpu.elastic.migrate`), so the snapshot is not only
+        rollback-safe locally but recoverable from ring neighbors after
+        this rank dies."""
         self.save()
+        from . import migrate
+
+        migrate.on_commit(self)
         self.check_host_updates()
 
 
@@ -96,6 +103,28 @@ class ObjectState(State):
                                   name="elastic.state")
         for k, v in synced.items():
             setattr(self, k, v)
+        self.save()
+
+    # -- migration payloads (horovod_tpu.elastic.migrate) -------------------
+    # Subclasses that keep committed state outside ``_saved`` (TorchState's
+    # module/optimizer state_dicts) override these three so peer-shard
+    # replication captures and restores the FULL committed state, not just
+    # the plain attributes.
+    def _migration_snapshot(self) -> Dict[str, Any]:
+        """Last committed payload, replicated onto ring successors."""
+        return {"attrs": self._saved}
+
+    def _migration_live(self) -> Dict[str, Any]:
+        """Current payload for a live handoff (may be ahead of the last
+        commit snapshot)."""
+        return {"attrs": {k: _to_host(v)
+                          for k, v in self._public_attrs().items()}}
+
+    def _migration_apply(self, payload: Dict[str, Any]) -> None:
+        for k, v in payload.get("attrs", {}).items():
+            setattr(self, k, v)
+            if k not in self._known_attrs:
+                self._known_attrs.append(k)
         self.save()
 
 

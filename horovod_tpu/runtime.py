@@ -283,6 +283,12 @@ class CoreBackend:
         box); empty for backends without the native recorder."""
         return {}
 
+    def migrate_note(self, phase: int, nbytes: int,
+                     source_rank: int = -1) -> None:
+        """Record one elastic-migration phase on the forensic planes
+        (metrics counters, flight type 14, MIGRATE timeline instant);
+        a no-op for backends without the native registry."""
+
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError
 

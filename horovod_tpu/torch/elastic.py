@@ -130,3 +130,26 @@ class TorchState(ObjectState):
             for k, v in synced.items():
                 setattr(self, k, v)
         self.save()
+
+    # -- migration payloads (horovod_tpu.elastic.migrate) -------------------
+    # Handled objects live outside ObjectState._saved, so peer-shard
+    # replication must carry their state_dicts explicitly — otherwise a
+    # respawned rank adopting a replica would get the right epoch counter
+    # but keep its fresh random-init model.
+    def _migration_snapshot(self):
+        payload = super()._migration_snapshot()
+        payload["handled"] = self._handled_saved
+        return payload
+
+    def _migration_live(self):
+        payload = super()._migration_live()
+        payload["handled"] = {k: copy.deepcopy(v.state_dict())
+                              for k, v in self._handled.items()}
+        return payload
+
+    def _migration_apply(self, payload) -> None:
+        super()._migration_apply(payload)
+        for k, snap in payload.get("handled", {}).items():
+            if k in self._handled:
+                self._handled[k].load_state_dict(copy.deepcopy(snap))
+        self.save()

@@ -238,6 +238,13 @@ class Config:
     # Elastic.
     elastic_timeout_s: float = DEFAULT_ELASTIC_TIMEOUT_S
     elastic_enabled: bool = False
+    # Zero-downtime state migration (docs/elastic.md): each rank keeps a
+    # replicated shard of its committed training state on
+    # HOROVOD_MIGRATE_REPLICAS ring-successor ranks (0 disables
+    # replication — re-formation always falls back to the checkpoint),
+    # refreshed every HOROVOD_MIGRATE_INTERVAL_STEPS commits.
+    migrate_replicas: int = 2
+    migrate_interval_steps: int = 1
 
     # Fleet autopilot (driver-internal).  HOROVOD_AUTOPILOT_PORT is set by
     # the elastic driver on rank 0 only: the coordinator opens a loopback
@@ -315,6 +322,9 @@ class Config:
                 "HOROVOD_ELASTIC_TIMEOUT", DEFAULT_ELASTIC_TIMEOUT_S
             ),
             elastic_enabled=get_bool("HOROVOD_ELASTIC", False),
+            migrate_replicas=max(0, get_int("HOROVOD_MIGRATE_REPLICAS", 2)),
+            migrate_interval_steps=max(
+                1, get_int("HOROVOD_MIGRATE_INTERVAL_STEPS", 1)),
             autopilot_port=get_int("HOROVOD_AUTOPILOT_PORT", 0),
             force_pure_python=get_bool("HVD_TPU_PURE_PY", False),
         )

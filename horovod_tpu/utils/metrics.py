@@ -6,6 +6,8 @@ it from an existing HTTP endpoint).  Naming scheme (docs/observability.md):
 
 - counters ->  ``hvd_<name>_total{rank="R"}``  (a trailing ``_total`` in
   the native counter name is not doubled)
+- gauges -> ``hvd_<name>{rank="R"}`` — bare name, no ``_total`` suffix
+  (last-written values, e.g. ``hvd_elastic_generation``)
 - histograms -> ``hvd_<name>_bucket{rank="R",le="<2^i>"}`` cumulative
   series per power-of-two microsecond bucket, a ``le="+Inf"`` overflow
   series, plus ``hvd_<name>_sum`` / ``hvd_<name>_count``
@@ -39,6 +41,12 @@ def render_prometheus(dump: Dict) -> str:
     for name, value in sorted((dump.get("counters") or {}).items()):
         metric = _counter_name(name)
         lines.append(f"# TYPE {metric} counter")
+        lines.append(f'{metric}{{rank="{rank}"}} {int(value)}')
+    for name, value in sorted((dump.get("gauges") or {}).items()):
+        # Gauges keep the bare name — no ``_total`` suffix (they are
+        # last-written values, e.g. hvd_elastic_generation).
+        metric = f"hvd_{name}"
+        lines.append(f"# TYPE {metric} gauge")
         lines.append(f'{metric}{{rank="{rank}"}} {int(value)}')
     for name, h in sorted((dump.get("histograms") or {}).items()):
         metric = f"hvd_{name}"

@@ -1,0 +1,393 @@
+"""Unit tests for zero-downtime elastic migration (elastic/migrate.py) and
+the checkpoint robustness satellites (checkpoint.py).
+
+The migration planner is a pure function over allgathered manifests, so
+every protocol decision — cut selection, claims, custody of orphans,
+transfer dedup, the deterministic fallback verdict — is tested here
+without any collectives; the live np=4 chaos path is
+tests/parallel/test_migration.py.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from horovod_tpu.checkpoint import Checkpointer, ShardedCheckpointer
+from horovod_tpu.elastic import migrate
+from horovod_tpu.elastic.migrate import (PHASE_FALLBACK, PHASE_REPLICATE,
+                                         ShardRecord, ShardStore,
+                                         plan_migration)
+from horovod_tpu.elastic.state import ObjectState
+
+
+@pytest.fixture(autouse=True)
+def fresh_store():
+    migrate.reset_store_for_test()
+    yield
+    migrate.reset_store_for_test()
+
+
+def man(live_owner, live_world, live_commits, records):
+    return {"live_owner": live_owner, "live_world": live_world,
+            "live_commits": live_commits, "records": records}
+
+
+def rec_meta(world, owner, commits, nbytes=64, digest="d"):
+    return (world, owner, commits, nbytes, digest)
+
+
+# ---------------------------------------------------------------------------
+# planner: cut selection / claims / custody / transfers
+# ---------------------------------------------------------------------------
+
+def test_cold_start_has_nothing_to_migrate():
+    plan = plan_migration([man(None, 0, 0, []) for _ in range(4)], 4)
+    assert plan["mode"] == "cold"
+
+
+def test_live_mode_no_op_reformation_moves_nothing():
+    mans = [man(i, 4, 12, [rec_meta(4, i, 10)]) for i in range(4)]
+    plan = plan_migration(mans, 4)
+    assert plan["mode"] == "live"
+    assert plan["cut"] == 12  # live state, not the stale replication cut
+    assert plan["transfers"] == []
+    assert plan["orphans"] == []
+
+
+def test_shrink_rolls_back_to_replication_cut_and_parks_orphan():
+    # np=4 at commit 12, replicated at 10; rank 2 dies -> survivors are
+    # new ranks 0,1,2 carrying old identities 0,1,3.
+    mans = [
+        man(0, 4, 12, [rec_meta(4, 0, 10), rec_meta(4, 2, 10),
+                       rec_meta(4, 3, 10)]),
+        man(1, 4, 12, [rec_meta(4, 1, 10), rec_meta(4, 3, 10),
+                       rec_meta(4, 0, 10)]),
+        man(3, 4, 12, [rec_meta(4, 3, 10), rec_meta(4, 1, 10),
+                       rec_meta(4, 2, 10)]),
+    ]
+    plan = plan_migration(mans, 3)
+    assert plan["mode"] == "replica"
+    assert (plan["world"], plan["cut"]) == (4, 10)
+    # Stable claims: new rank r resumes shard r of the old namespace.
+    assert plan["claims"] == {0: 0, 1: 1, 2: 2}
+    # Shard 3 is orphaned (nobody claims it at np=3) and parked at 3%3=0.
+    assert plan["orphans"] == [3]
+    assert plan["custodians"] == {3: 0}
+    # Every claimant/custodian already holds its record: zero transfers.
+    assert plan["transfers"] == []
+
+
+def test_regrow_transfers_parked_shard_to_returning_rank():
+    # Frozen re-grow after the shrink above: new rank 3 is a respawn with
+    # an empty store; rank 2 (old identity 3's custodian here) provides.
+    mans = [
+        man(0, 4, 10, [rec_meta(4, 0, 10)]),
+        man(1, 4, 10, [rec_meta(4, 1, 10)]),
+        man(2, 4, 10, [rec_meta(4, 2, 10), rec_meta(4, 3, 10)]),
+        man(None, 0, 0, []),
+    ]
+    plan = plan_migration(mans, 4)
+    assert plan["mode"] == "replica"
+    assert plan["claims"][3] == 3
+    assert plan["transfers"] == [(2, 3, 3)]
+    assert plan["orphans"] == []
+
+
+def test_newest_common_cut_wins():
+    # Owner 0 replicated at 10 and 20 everywhere, owner 1 only at 10 and
+    # 20 on one holder: the newest cut covering BOTH is 20.
+    mans = [
+        man(None, 0, 0, [rec_meta(2, 0, 10), rec_meta(2, 0, 20),
+                         rec_meta(2, 1, 10)]),
+        man(None, 0, 0, [rec_meta(2, 1, 20)]),
+    ]
+    plan = plan_migration(mans, 2)
+    assert plan["mode"] == "replica"
+    assert plan["cut"] == 20
+
+
+def test_uncoverable_owner_forces_deterministic_fallback():
+    mans = [
+        man(0, 4, 12, [rec_meta(4, 0, 10)]),
+        man(1, 4, 12, [rec_meta(4, 1, 10)]),
+        man(None, 0, 0, []),
+    ]
+    plan = plan_migration(mans, 3)
+    assert plan["mode"] == "fallback"
+    assert "2" in plan["reason"] and "3" in plan["reason"]
+
+
+def test_mismatched_cuts_with_no_intersection_fall_back():
+    # Both owners have records, but never at the same commit count.
+    mans = [
+        man(None, 0, 0, [rec_meta(2, 0, 10)]),
+        man(None, 0, 0, [rec_meta(2, 1, 20)]),
+    ]
+    plan = plan_migration(mans, 2)
+    assert plan["mode"] == "fallback"
+
+
+def test_live_growth_ships_current_state_to_newcomers():
+    # np=2 -> np=4: both owners alive, newcomers claim o = r % 2.
+    mans = [
+        man(0, 2, 7, [rec_meta(2, 0, 5)]),
+        man(1, 2, 7, [rec_meta(2, 1, 5)]),
+        man(None, 0, 0, []),
+        man(None, 0, 0, []),
+    ]
+    plan = plan_migration(mans, 4)
+    assert plan["mode"] == "live"
+    assert plan["cut"] == 7
+    assert plan["claims"] == {0: 0, 1: 1, 2: 0, 3: 1}
+    assert sorted(plan["transfers"]) == [(0, 2, 0), (1, 3, 1)]
+
+
+def test_consecutive_shrinks_stay_covered():
+    # After one 4->3 shrink the survivors kept their peer records; a
+    # second death (old identity 1, new rank 1) must still be coverable.
+    mans = [
+        man(0, 4, 10, [rec_meta(4, 0, 10), rec_meta(4, 1, 10),
+                       rec_meta(4, 3, 10)]),
+        man(2, 4, 10, [rec_meta(4, 2, 10), rec_meta(4, 1, 10)]),
+    ]
+    plan = plan_migration(mans, 2)
+    assert plan["mode"] == "replica"
+    assert plan["claims"] == {0: 0, 1: 1}
+    assert set(plan["orphans"]) == {2, 3}
+    # Both claimants already hold their shards (no transfer for owners 0
+    # and 1); only the orphan custody moves: shard 2 to custodian 0,
+    # shard 3 to custodian 1.
+    assert sorted(plan["transfers"]) == [(0, 1, 3), (1, 0, 2)]
+
+
+def test_progressed_regrow_prefers_live_world_over_stale_parked():
+    # Survivors of a 4->3 shrink kept training (re-branded to world 3);
+    # rank 0 still parks old identity 3's world-4 shard.  On re-grow the
+    # plan must follow the LIVE world (3) — the stale parked record must
+    # not drag the namespace back to the dead world-4 numbering (which
+    # would be uncoverable and force a spurious fallback).
+    mans = [
+        man(0, 3, 25, [rec_meta(3, 0, 24), rec_meta(4, 3, 10)]),
+        man(1, 3, 25, [rec_meta(3, 1, 24), rec_meta(3, 0, 24)]),
+        man(2, 3, 25, [rec_meta(3, 2, 24), rec_meta(3, 1, 24)]),
+        man(None, 0, 0, []),
+    ]
+    plan = plan_migration(mans, 4)
+    assert plan["mode"] == "live"
+    assert plan["world"] == 3
+    assert plan["cut"] == 25
+    # The newcomer duplicates shard 0 (claims 3 % 3); documented transient.
+    assert plan["claims"][3] == 0
+    assert plan["transfers"] == [(0, 3, 0)]
+
+
+def test_plan_is_deterministic_across_ranks():
+    mans = [
+        man(0, 3, 9, [rec_meta(3, 0, 8), rec_meta(3, 2, 8)]),
+        man(1, 3, 9, [rec_meta(3, 1, 8), rec_meta(3, 0, 8)]),
+        man(None, 0, 0, [rec_meta(3, 2, 8)]),
+    ]
+    plans = [plan_migration([dict(m) for m in mans], 3) for _ in range(3)]
+    assert plans[0] == plans[1] == plans[2]
+
+
+# ---------------------------------------------------------------------------
+# shard store + record integrity
+# ---------------------------------------------------------------------------
+
+def _record(owner, world, commits, attrs):
+    data = pickle.dumps(attrs)
+    return ShardRecord(owner=owner, world=world, commits=commits,
+                       digest=migrate._digest(data), data=data)
+
+
+def test_store_find_prefers_own_then_peers_and_prunes_stale():
+    st = ShardStore()
+    st.own = _record(0, 4, 10, {"x": 1})
+    st.peers[(4, 1, 10)] = _record(1, 4, 10, {"x": 2})
+    st.peers[(4, 1, 8)] = _record(1, 4, 8, {"x": 0})
+    st.parked[(3, 2, 9)] = _record(2, 3, 9, {"x": 3})
+    assert st.find(4, 0, 10) is st.own
+    assert st.find(4, 1, 10).commits == 10
+    assert st.find(4, 9, 10) is None
+    st.prune(world=4, commits=10)
+    # The stale peer cut and the old-world parked record are gone.
+    assert (4, 1, 8) not in st.peers
+    assert st.parked == {}
+    assert (4, 1, 10) in st.peers
+
+
+def test_apply_record_verifies_digest_and_restores_attrs():
+    state = ObjectState(step=3, w=np.zeros(4, np.float32))
+    rec = _record(1, 2, 5, {"step": 9, "w": np.full(4, 7.0, np.float32)})
+    migrate._apply_record(state, rec)
+    assert state.step == 9
+    np.testing.assert_array_equal(state.w, np.full(4, 7.0, np.float32))
+    # The snapshot was refreshed too (restore() returns the adopted state).
+    state.step = 0
+    state.restore()
+    assert state.step == 9
+
+
+def test_apply_record_rejects_corrupt_payload():
+    state = ObjectState(step=3)
+    rec = _record(1, 2, 5, {"step": 9})
+    rec.data = rec.data[:-1] + bytes([rec.data[-1] ^ 0xFF])
+    with pytest.raises(RuntimeError, match="digest"):
+        migrate._apply_record(state, rec)
+    assert state.step == 3  # untouched
+
+
+def test_on_commit_counts_but_skips_replication_uninitialized():
+    state = ObjectState(step=0)
+    state.commit()
+    state.commit()
+    assert migrate.store().commits == 2
+    assert migrate.store().own is None  # no world, no replication
+
+
+def test_fallback_restores_from_attached_checkpointer(tmp_path):
+    class FakeCkpt:
+        def restore(self):
+            return {"step": 42, "w": np.full(2, 5.0, np.float32)}
+
+    migrate.attach_checkpointer(FakeCkpt())
+    notes = []
+    state = ObjectState(step=0, w=np.zeros(2, np.float32))
+    # Not initialized -> _note is a no-op; call the internal directly.
+    migrate._fallback(state, "test reason")
+    assert state.step == 42
+    np.testing.assert_array_equal(state.w, np.full(2, 5.0, np.float32))
+    assert notes == []  # no core attached, nothing crashed
+
+
+# ---------------------------------------------------------------------------
+# checkpoint robustness (satellite: atomic writes, corrupt-latest fallback)
+# ---------------------------------------------------------------------------
+
+def test_pickle_write_is_atomic_no_tmp_left(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+    ckpt.save(5, {"a": np.arange(3)})
+    names = os.listdir(tmp_path)
+    assert "ckpt_5.pkl" in names
+    assert not any(n.endswith(".tmp") for n in names)
+
+
+def test_restore_skips_corrupt_latest_and_falls_back_to_older(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+    ckpt.save(1, {"step": 1})
+    ckpt.save(2, {"step": 2})
+    # Simulate a crash that left a truncated latest checkpoint.
+    with open(os.path.join(str(tmp_path), "ckpt_3.pkl"), "wb") as f:
+        f.write(b"\x80\x04truncated")
+    assert ckpt.latest_step() == 3
+    state = ckpt.restore()
+    assert state == {"step": 2}
+
+
+def test_restore_explicit_corrupt_step_raises(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+    ckpt.save(1, {"step": 1})
+    with open(os.path.join(str(tmp_path), "ckpt_2.pkl"), "wb") as f:
+        f.write(b"junk")
+    with pytest.raises(RuntimeError, match="restore failed"):
+        ckpt.restore(step=2)
+
+
+def test_restore_empty_directory_returns_none(tmp_path):
+    ckpt = Checkpointer(str(tmp_path), use_orbax=False)
+    assert ckpt.restore() is None
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpointer (async per-rank writer)
+# ---------------------------------------------------------------------------
+
+def test_sharded_roundtrip_sync_and_async(tmp_path):
+    for async_write in (False, True):
+        d = str(tmp_path / f"a{int(async_write)}")
+        ckpt = ShardedCheckpointer(d, use_orbax=False,
+                                   async_write=async_write)
+        ckpt.save(7, {"step": 7, "w": np.arange(4, dtype=np.float32)})
+        ckpt.wait_until_finished()
+        assert ckpt.latest_step() == 7
+        state = ckpt.restore()
+        assert state["step"] == 7
+        np.testing.assert_array_equal(state["w"],
+                                      np.arange(4, dtype=np.float32))
+
+
+def test_sharded_incomplete_step_is_not_latest(tmp_path):
+    ckpt = ShardedCheckpointer(str(tmp_path), use_orbax=False,
+                               async_write=False)
+    ckpt.save(1, {"step": 1})
+    # Forge a newer step whose manifest promises a shard that never landed
+    # (crash between manifest and shard write).
+    step_dir = os.path.join(str(tmp_path), "ckpt_2")
+    os.makedirs(step_dir)
+    with open(os.path.join(step_dir, "manifest.json"), "w") as f:
+        f.write('{"step": 2, "world": 1}')
+    assert ckpt.latest_step() == 1
+    assert ckpt.restore()["step"] == 1
+
+
+def test_sharded_async_write_error_surfaces_on_join(tmp_path):
+    ckpt = ShardedCheckpointer(str(tmp_path), use_orbax=False,
+                               async_write=True)
+
+    class Unpicklable:
+        def __reduce__(self):
+            raise TypeError("nope")
+
+    ckpt.save(1, {"bad": Unpicklable()})
+    with pytest.raises(RuntimeError, match="shard"):
+        ckpt.wait_until_finished()
+
+
+def test_sharded_restore_claims_modulo_on_smaller_world(tmp_path):
+    # A np=2 checkpoint restored single-process: rank 0 reads shard 0.
+    d = str(tmp_path)
+    ckpt = ShardedCheckpointer(d, use_orbax=False, async_write=False)
+    ckpt.save(3, {"who": "shard0"})
+    # Forge the second shard + manifest of a larger world.
+    with open(os.path.join(d, "ckpt_3", "shard_1.pkl"), "wb") as f:
+        pickle.dump({"who": "shard1"}, f)
+    with open(os.path.join(d, "ckpt_3", "manifest.json"), "w") as f:
+        f.write('{"step": 3, "world": 2}')
+    assert ckpt.restore()["who"] == "shard0"
+
+
+def test_torch_state_migration_payload_carries_handled_state():
+    # TorchState keeps module/optimizer snapshots in _handled_saved, not in
+    # ObjectState._saved — a replica record must carry them, or a respawned
+    # rank adopting it would get the epoch counter but keep its fresh
+    # random-init model (tests/integration test_elastic torch worker).
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.torch.elastic import TorchState
+
+    torch.manual_seed(1)
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = TorchState(model=model, optimizer=opt, epoch=5)
+    state.save()
+    data = migrate._snapshot_bytes(state._migration_snapshot())
+    rec = ShardRecord(owner=0, world=2, commits=7,
+                      digest=migrate._digest(data), data=data)
+
+    torch.manual_seed(99)  # diverged init, as a respawned worker would have
+    model2 = torch.nn.Linear(4, 2)
+    opt2 = torch.optim.SGD(model2.parameters(), lr=0.1)
+    state2 = TorchState(model=model2, optimizer=opt2, epoch=0)
+    assert not torch.equal(model2.weight, model.weight)
+
+    migrate._apply_record(state2, rec)
+    assert state2.epoch == 5
+    assert torch.equal(model2.weight, model.weight)
+    assert torch.equal(model2.bias, model.bias)
+    # The adoption is commit-grade: restore() returns the adopted state.
+    with torch.no_grad():
+        model2.weight.zero_()
+    state2.restore()
+    assert torch.equal(model2.weight, model.weight)

@@ -116,6 +116,37 @@ def test_replay_preserves_registration_order(ctx):
     assert a.process_set_id < b.process_set_id
 
 
+def test_two_consecutive_reformations_preserve_weight_and_membership(ctx):
+    """Shrink then re-grow: two reregister_all() hops back to back — the
+    path a real eviction + blacklist-expiry cycle takes.  QoS weights and
+    the membership algebra must survive BOTH hops, not just the first
+    (a replay that consumed desired_ranks would pass one hop and fail the
+    second)."""
+    a = add_process_set([0, 1, 2], weight=3.0)
+    b = add_process_set([2, 3], weight=0.5)
+
+    # Hop 1: rank 3's host evicted; world re-forms as {0,1,2}.
+    ctx.core.world = [0, 1, 2]
+    ctx.core.added.clear()
+    reregister_all()
+    assert a.ranks == [0, 1, 2]
+    assert b.ranks == [2]
+    # Both replayed registrations carried their QoS weight through hop 1.
+    assert ctx.core.added == [([0, 1, 2], 3.0), ([2], 0.5)]
+
+    # Hop 2: blacklist sentence expired; the fleet re-grows to np=4.
+    ctx.core.world = [0, 1, 2, 3]
+    ctx.core.added.clear()
+    reregister_all()
+    assert a.ranks == [0, 1, 2]
+    assert b.ranks == [2, 3]  # returning rank re-admitted
+    assert ctx.core.added == [([0, 1, 2], 3.0), ([2, 3], 0.5)]
+    # The original requests are still intact for any further hop.
+    assert a.desired_ranks == [0, 1, 2]
+    assert b.desired_ranks == [2, 3]
+    assert a.process_set_id is not None and b.process_set_id is not None
+
+
 def test_removed_set_is_not_replayed(ctx):
     ps = add_process_set([1, 2])
     remove_process_set(ps)

@@ -51,7 +51,8 @@ OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_fault_spec_check", "hvd_ctrl_plane_stats",
                     "hvd_flight_record", "hvd_add_process_set2",
                     "hvd_device_plane_note", "hvd_device_plane_stats",
-                    "hvd_autotune_qdev"}
+                    "hvd_autotune_qdev", "hvd_migrate_note",
+                    "hvd_elastic_generation_set"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where

@@ -14,9 +14,10 @@ to its postmortem.json).  The bundle holds:
                     (it was already dead)
   autopilot.jsonl   the fleet autopilot's decision log (one JSON line per
                     eviction / scale-up / re-admission, written by the
-                    elastic driver's policy thread; docs/elastic.md) —
-                    rendered so the report shows why the fleet changed
-                    shape, not just that it did
+                    elastic driver's policy thread; docs/elastic.md), plus
+                    "migrate" rows appended by zero-downtime elastic state
+                    migration — rendered so the report shows why the fleet
+                    changed shape, not just that it did
 
 The report names the culprit, shows each rank's last-seen state, and prints
 the merged causal event sequence leading into the abort.  --trace also
@@ -75,11 +76,23 @@ def find_bundle(path: str) -> Dict[str, object]:
             "autopilot": ap if os.path.exists(ap) else None}
 
 
+# Mirrors cpp/metrics.h MigratePhase (flight type-14 `a` upper byte).
+_MIGRATE_PHASES = {1: "replicate", 2: "manifest", 3: "transfer",
+                   4: "reassemble", 5: "fallback"}
+
+
 def _fmt_event(row: List[int], types: Dict[str, str],
                abort_us: Optional[int]) -> str:
     ts_us, seq, typ, tid, a, b = row[:6]
     name = types.get(str(typ), f"type{typ}")
     rel = "" if abort_us is None else f"{(ts_us - abort_us) / 1e3:+10.1f}ms "
+    if name == "migrate":
+        # a = phase<<8 | source_rank+1 (0 = no source); b = payload bytes.
+        phase = _MIGRATE_PHASES.get(a >> 8, f"phase{a >> 8}")
+        src = (a & 0xFF) - 1
+        src_s = str(src) if src >= 0 else "-"
+        return (f"{rel}seq={seq:<8} {name:<14} tid={tid} "
+                f"phase={phase} src={src_s} bytes={b}")
     return f"{rel}seq={seq:<8} {name:<14} tid={tid} a={a} b={b}"
 
 
@@ -199,7 +212,12 @@ def report(bundle: Dict[str, object], n_events: int,
         print("-" * 72, file=out)
         for d in autopilot:
             action = d.get("action")
-            name = _AUTOPILOT_ACTIONS.get(action, f"action{action}")
+            if isinstance(action, str):
+                # Newer rows (elastic migration) journal the action name
+                # directly instead of an ACT_* code.
+                name = action
+            else:
+                name = _AUTOPILOT_ACTIONS.get(action, f"action{action}")
             ts = d.get("ts")
             ts_s = f"t={ts:10.3f}s " if isinstance(ts, (int, float)) else ""
             print(f"  {ts_s}gen={d.get('generation', '?'):<3} "

@@ -44,6 +44,11 @@ combination of:
            and the result bit-identical to the plain collective; np=1
            rows plus one cross-plane row (host bf16 x device int8); one
            int8 combo in the quick set
+- migrate: off / on (HOROVOD_MIGRATE_REPLICAS) — "on" combos commit an
+           elastic ObjectState and assert peer-shard replication landed
+           the committed snapshot bit-exact on the ring successors' shard
+           stores (docs/elastic.md "Zero-downtime migration"); one
+           on-combo in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -54,9 +59,13 @@ elastic recovery under --fault-inject), the np=4 chaos-postmortem pytest
 (`postmortem-np4`: injected death -> merged postmortem.json with the right
 culprit within the abort bound), the np=4 hands-off autopilot chaos loop
 (`autopilot-np4`: persistent injected straggle -> detect, evict, elastic
-recovery, blacklist-expiry re-admission — zero human input), the np=256
-control-plane soak (`ctrl-soak`: flat vs tree coordinator message
-counts), and the np=8 tree-vs-flat parity pytest (`ctrl-np8`).
+recovery, blacklist-expiry re-admission — zero human input), the np=4
+zero-downtime migration chaos pytest (`migration-np4`: rank death ->
+re-form np=3 resuming bit-identically from peer shards with zero
+checkpoint reads -> blacklist-expiry re-grow to np=4, plus the degraded
+checkpoint-fallback path), the np=256 control-plane soak (`ctrl-soak`:
+flat vs tree coordinator message counts, plus a migration-noting row),
+and the np=8 tree-vs-flat parity pytest (`ctrl-np8`).
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -214,6 +223,27 @@ WORKLOAD = textwrap.dedent("""
     elif fl == "off":
         assert hvd.flight_record() == {}, "recorder off but ring non-empty"
 
+    # migrate axis: a committed elastic state must land, bit-exact, on the
+    # ring successors' shard stores via the data-plane replication path.
+    if os.environ.get("HVD_MATRIX_MIGRATE") == "on" and s > 1:
+        import pickle
+        from horovod_tpu.elastic import migrate as mig
+
+        est = hvd.elastic.ObjectState(
+            step=0, w=np.full(4, float(r), np.float32))
+        est.step = 1
+        est.commit()
+        st = mig.store()
+        assert st.own is not None and st.own.owner == r, (r, st.own)
+        assert len(st.peers) >= min(2, s - 1), sorted(st.peers)
+        pred = (r - 1) % s
+        recs = [p for p in st.peers.values() if p.owner == pred]
+        assert recs, sorted(st.peers)
+        attrs = pickle.loads(recs[0].data)["attrs"]
+        assert attrs["step"] == 1, attrs
+        np.testing.assert_array_equal(
+            attrs["w"], np.full(4, float(pred), np.float32))
+
     # metrics axis: the registry must have seen the work done above.
     if os.environ.get("HOROVOD_METRICS") == "1":
         m = hvd.metrics()
@@ -325,6 +355,10 @@ def combos(quick: bool):
         # qdev axis: the one quick device-codec combo (forced 4-dev host).
         yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
                "def", "off", "int8")
+        # migrate axis: the one quick on-combo — peer-shard replication
+        # rides a committed elastic state over the shm data plane.
+        yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+               "def", "off", "off", "on")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -391,6 +425,15 @@ def combos(quick: bool):
            "def", "off", "int8")
     yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
            "def", "off", "demote")
+    # Migrate axis: replication across the plane shapes the shards actually
+    # ride in production — shm, the flat TCP ring, and the hier topology —
+    # plus a metrics-on row so the hvd_migrate_* counters are scraped live.
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "off", "on")
+    yield ("jax", "native", 2, "on", "on", "tcp", "none", "off", "auto",
+           "def", "off", "off", "on")
+    yield ("jax", "native", 3, "on", "on", "hier", "none", "on", "auto",
+           "def", "off", "off", "on")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -456,6 +499,14 @@ def checks(quick: bool):
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_autopilot.py")]],
            REPO, 600.0)
+    # Zero-downtime migration chaos: injected rank death -> fast abort ->
+    # re-form np=3 resuming bit-identically from peer shards (zero
+    # checkpoint reads) -> blacklist-expiry re-grow to np=4; plus the
+    # degraded path (replicas lost -> sharded-checkpoint fallback).
+    yield ("migration-np4",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_migration.py")]],
+           REPO, 600.0)
     # np=256 in-process control-plane soak: flat vs v9 tree coordinator
     # message counts (>= 8x cut at 256 ranks / 16 fake hosts) plus the
     # sharded rendezvous acceptors under the full HELLO herd.
@@ -487,7 +538,7 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
-              autopilot: str, qdev: str, script: str,
+              autopilot: str, qdev: str, migrate: str, script: str,
               timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -519,6 +570,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # per-generation driver state, never ambient).
     env.pop("HOROVOD_AUTOPILOT", None)
     env.pop("HOROVOD_AUTOPILOT_PORT", None)
+    # The migrate axis owns the replication knobs: an ambient setting
+    # would make every combo pay the replication alltoall per commit.
+    env.pop("HOROVOD_MIGRATE_REPLICAS", None)
+    env.pop("HOROVOD_MIGRATE_INTERVAL_STEPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -564,6 +619,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         # thread attached (launch.py reads the env fallback); the driver
         # forces HOROVOD_METRICS=1 on the workers.
         env["HOROVOD_AUTOPILOT"] = "1"
+    if migrate == "on":
+        env["HVD_MATRIX_MIGRATE"] = "on"
+        env["HOROVOD_MIGRATE_REPLICAS"] = "2"
+        env["HOROVOD_MIGRATE_INTERVAL_STEPS"] = "1"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -613,15 +672,18 @@ def main() -> int:
                 combo = combo + ("off",)
             if len(combo) == 11:  # rows predating the qdev axis
                 combo = combo + ("off",)
+            if len(combo) == 12:  # rows predating the migrate axis
+                combo = combo + ("off",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree, flight, autopilot, qdev) = combo
+             tree, flight, autopilot, qdev, migrate) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
-                     f"flight={flight:<4} ap={autopilot} qdev={qdev}")
+                     f"flight={flight:<4} ap={autopilot} qdev={qdev} "
+                     f"mig={migrate}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
-                                       autopilot, qdev,
+                                       autopilot, qdev, migrate,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

@@ -71,6 +71,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
         c.c_int, c.c_int, c.c_char_p,              # flight_on flight_slots postmortem_dir
         c.c_int,                                   # autopilot_port (0 = off)
+        c.c_int, c.c_int,                          # step_trace_on step_trace_slots
     ]
     lib.hvd_shutdown.restype = c.c_int
     lib.hvd_is_initialized.restype = c.c_int
@@ -156,6 +157,13 @@ def _declare(lib: ctypes.CDLL) -> None:
         # degrades flight_record() to {} instead of raising.
         lib.hvd_flight_record.restype = c.c_int
         lib.hvd_flight_record.argtypes = [c.c_char_p, c.c_int]
+    except AttributeError:
+        pass
+    try:
+        # Old-ABI tolerance: a stale .so predating causal step tracing
+        # degrades step_trace() to {} instead of raising.
+        lib.hvd_step_trace.restype = c.c_int
+        lib.hvd_step_trace.argtypes = [c.c_char_p, c.c_int]
     except AttributeError:
         pass
     try:
@@ -263,6 +271,8 @@ class NativeCore(CoreBackend):
             cfg.flight_recorder_slots,
             (cfg.postmortem_dir or "").encode(),
             cfg.autopilot_port,
+            1 if cfg.step_trace_enabled else 0,
+            cfg.step_trace_slots,
         )
         if rc != 0:
             raise NativeCoreError(
@@ -611,6 +621,33 @@ class NativeCore(CoreBackend):
             cap *= 4
             buf = ctypes.create_string_buffer(cap)
             n = self._lib.hvd_flight_record(buf, cap)
+        if n <= 0:
+            return {}
+        return json.loads(buf.raw[:n].decode())
+
+    _warned_no_steptrace = False
+
+    def step_trace(self) -> dict:
+        """Snapshot of this rank's causal step-trace ring: {"schema",
+        "rank", "world", "phases", "steps", "fleet"} where steps are
+        [step, start_us, end_us, <5 phase us>] rows and fleet (rank 0
+        only) carries per-step cross-rank sums with dominant_phase /
+        dominant_rank attribution.  {} when tracing is off
+        (HOROVOD_STEP_TRACE=off) or the .so predates it."""
+        if not hasattr(self._lib, "hvd_step_trace"):
+            if not NativeCore._warned_no_steptrace:
+                NativeCore._warned_no_steptrace = True
+                log.warning("native core predates causal step tracing "
+                            "(hvd_step_trace missing); step_trace() "
+                            "returns {}")
+            return {}
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_step_trace(buf, cap)
+        while n == -2:  # buffer too small: grow and retry
+            cap *= 4
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.hvd_step_trace(buf, cap)
         if n <= 0:
             return {}
         return json.loads(buf.raw[:n].decode())

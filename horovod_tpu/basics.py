@@ -223,6 +223,20 @@ def flight_record() -> dict:
     return HorovodContext.instance().core.flight_record()
 
 
+def step_trace() -> dict:
+    """Snapshot of this rank's causal step-trace ring — the fifth
+    observability pillar.  Keys: ``rank``, ``world``, ``phases`` (the
+    breakdown order: negotiation_wait / fusion / ring / fence / idle),
+    ``steps`` as ``[step, start_us, end_us, <5 phase us>]`` rows, and on
+    rank 0 ``fleet`` — per-step cross-rank phase sums with
+    ``dominant_phase`` / ``dominant_rank`` attribution.  Empty when
+    HOROVOD_STEP_TRACE=off or the backend has no native tracer.  The same
+    payload is written to HOROVOD_POSTMORTEM_DIR as
+    ``steptrace.<rank>.json`` at shutdown/abort for
+    ``tools/critical_path.py``."""
+    return HorovodContext.instance().core.step_trace()
+
+
 # -- timeline ---------------------------------------------------------------
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

@@ -215,6 +215,10 @@ class HorovodContext:
             getattr(self.core, "parallel_lanes", False) and cfg.size > 1
             and get_bool("HOROVOD_EXECUTOR_LANES", True))
         self._lanes: Dict[int, "_ExecutorLane"] = {}
+        # Live cockpit (HOROVOD_COCKPIT, rank 0 only): loopback HTTP
+        # endpoint streaming the fleet's step attribution; None when off.
+        from .cockpit import maybe_start_cockpit
+        self.cockpit = maybe_start_cockpit(self)
         self._executor = threading.Thread(
             target=self._executor_loop, name="hvd-executor", daemon=True
         )
@@ -258,6 +262,8 @@ class HorovodContext:
             return
         inst._shutdown.set()
         inst._executor.join(timeout=5.0)
+        if getattr(inst, "cockpit", None) is not None:
+            inst.cockpit.stop()
         inst.core.shutdown()
         # Fail any still-pending handles so blocked synchronize() callers
         # wake with an error instead of hanging forever.

@@ -196,6 +196,13 @@ struct CoreConfig {
   // cluster view (straggler windows, counters) and accepting autopilot
   // decision records.  0 disables — the default, costing nothing.
   int autopilot_port = 0;
+  // HOROVOD_STEP_TRACE / HOROVOD_STEP_TRACE_SLOTS: causal step tracing —
+  // per-step phase attribution recorded into a per-rank ring (step_trace.h)
+  // and aggregated fleet-wide on the coordinator from CYCLE trailers.  On
+  // by default (a site pays a relaxed fetch_add); when off, one relaxed
+  // bool load per site, same bar as the flight recorder.
+  bool step_trace = true;
+  int step_trace_slots = 256;
   // C++-selftest-only (never ABI-exposed): skip the O(n^2) data-plane mesh,
   // shm, and hierarchical setup so in-process control-plane soaks can run
   // hundreds of ranks within fd/time budgets.  Data-plane ops are invalid

@@ -31,6 +31,7 @@
 #include "metrics.h"
 #include "parameter_manager.h"
 #include "socket_controller.h"
+#include "step_trace.h"
 #include "timeline.h"
 
 namespace hvdtpu {
@@ -196,6 +197,11 @@ void BackgroundLoop() {
           static_cast<int64_t>((work_start - sleep_start) * 1e6),
           std::memory_order_relaxed);
     }
+    if (StepTraceOn()) {
+      StepTraceAddPhaseUs(
+          kPhaseIdle,
+          static_cast<int64_t>((work_start - sleep_start) * 1e6));
+    }
     g->timeline.MarkCycle();
 
     std::vector<TensorRequest> newreqs;
@@ -244,6 +250,7 @@ void BackgroundLoop() {
         // aborts that never touched the abort machinery (cache divergence,
         // local controller) still leave their black box here.
         if (FlightOn()) FlightDumpToFile();
+        if (StepTraceOn()) StepTraceDumpToFile();
       }
       FailAllOutstanding("Horovod negotiation failed: " + s.reason);
       continue;
@@ -269,21 +276,26 @@ void BackgroundLoop() {
           continue;
         }
         r.handles.push_back(it->second.handle);
-        if (MetricsOn()) {
+        if (MetricsOn() || StepTraceOn()) {
           // Same span the timeline's NEGOTIATE B/E pair measures, so the
           // registry total and the trace agree.
           const int64_t wait_us = static_cast<int64_t>(
               (MonotonicSeconds() - it->second.enqueued_at) * 1e6);
-          GlobalMetrics().negotiation_wait_us.ObserveUs(wait_us);
-          // Per-tenant latency: the same wait attributed to the response's
-          // process set, the QoS scheduling signal hvd.metrics() exposes.
-          GlobalMetrics().RecordTenantWaitUs(r.process_set_id, wait_us);
+          if (MetricsOn()) {
+            GlobalMetrics().negotiation_wait_us.ObserveUs(wait_us);
+            // Per-tenant latency: the same wait attributed to the
+            // response's process set, the QoS scheduling signal
+            // hvd.metrics() exposes.
+            GlobalMetrics().RecordTenantWaitUs(r.process_set_id, wait_us);
+          }
+          StepTraceAddPhaseUs(kPhaseNegotiation, wait_us);
         }
         g->outstanding.erase(it);
         g->timeline.End(name, "NEGOTIATE");
       }
       for (const auto& m : r.metas) bytes += m.nbytes;
     }
+    bool step_work = false;  // did this cycle ship a real fused response?
     for (const auto& r : responses) {
       if (r.target_rank >= 0 && r.target_rank != g->cfg.rank) continue;
       if (!r.error.empty() && r.handles.empty()) {
@@ -321,7 +333,21 @@ void BackgroundLoop() {
           mreg.RecordTenant(r.process_set_id,
                             static_cast<int64_t>(r.metas.size()), rbytes);
         }
+        if (r.error.empty() && !r.metas.empty()) step_work = true;
         DeliverResponse(r);
+      }
+    }
+    if (step_work && StepTraceOn() &&
+        dynamic_cast<SocketController*>(g->controller.get()) == nullptr) {
+      // np=1 (local controller): no coordinator trailer will ever arrive,
+      // so close the step here with the same "shipped real work" rule the
+      // socket coordinator uses, and feed the fleet view directly so the
+      // cockpit's /state breakdown works single-process too.
+      StepTraceAdvance(StepTraceCurrentStep() + 1);
+      int64_t sid = 0;
+      int64_t phases[kStepPhases];
+      if (StepTraceLastCompleted(&sid, phases)) {
+        StepTraceFleetPhases(0, sid, phases);
       }
     }
     if (bytes > 0) g->params.RecordBytes(bytes);
@@ -390,6 +416,7 @@ void BackgroundLoop() {
         g->timeline.Instant("ABORT",
                             "{\"reason\":\"" + JsonEscape(msg) + "\"}");
         if (FlightOn()) FlightDumpToFile();
+        if (StepTraceOn()) StepTraceDumpToFile();
         FailAllOutstanding("Horovod stall shutdown: " + msg);
       }
     }
@@ -428,7 +455,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int timeline_mark_cycles, double stall_warn_s,
              double stall_shutdown_s, int log_level, int flight_enabled,
              int flight_slots, const char* postmortem_dir,
-             int autopilot_port) {
+             int autopilot_port, int step_trace_on, int step_trace_slots) {
   if (g != nullptr) return -1;
   SetInitError("");  // a fresh attempt must not inherit a stale reason
   g = new GlobalState();
@@ -461,6 +488,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.stall_warn_s = stall_warn_s;
   cfg.stall_shutdown_s = stall_shutdown_s;
   cfg.autopilot_port = autopilot_port > 0 ? autopilot_port : 0;
+  cfg.step_trace = step_trace_on != 0;
+  cfg.step_trace_slots = step_trace_slots > 0 ? step_trace_slots : 256;
   SetLogLevel(log_level);
   g->cycle_ms = cycle_ms > 0 ? cycle_ms : 1.0;
   g->fusion_threshold.store(fusion);
@@ -501,6 +530,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // a black box behind.
   InitFlightRecorder(flight_enabled != 0, flight_slots,
                      postmortem_dir ? postmortem_dir : "", cfg.rank);
+  // Step tracing arms alongside it (same postmortem dir for the abort-time
+  // steptrace.<rank>.json dump) so the first negotiated step is attributed.
+  InitStepTrace(cfg.step_trace, cfg.step_trace_slots,
+                postmortem_dir ? postmortem_dir : "", cfg.rank, cfg.size);
 
   if (cfg.size > 1 || cfg.controller == "socket") {
     g->controller = std::make_unique<SocketController>(cfg);
@@ -586,6 +619,10 @@ int hvd_shutdown() {
   // Final snapshot so short runs (shorter than the interval) still leave
   // a complete metrics file behind.
   if (!g->metrics_path.empty()) WriteMetricsFile();
+  // Same courtesy for the step trace: a clean exit leaves the attribution
+  // behind for tools/critical_path.py without requiring an abort.
+  if (StepTraceOn()) StepTraceDumpToFile();
+  GlobalStepTraceGate().enabled.store(false, std::memory_order_relaxed);
   GlobalMetrics().enabled.store(false, std::memory_order_relaxed);
   g->timeline.Stop();
   {
@@ -929,6 +966,18 @@ int hvd_flight_record(char* buf, int cap) {
   if (g == nullptr) return -1;
   if (!FlightOn()) return 0;
   std::string json = FlightDumpJson();
+  if (static_cast<int>(json.size()) + 1 > cap) return -2;
+  std::memcpy(buf, json.data(), json.size());
+  buf[json.size()] = '\0';
+  return static_cast<int>(json.size());
+}
+
+// Same contract as hvd_flight_record: -1 not initialized, 0 tracing off,
+// -2 buffer too small (caller doubles and retries), else JSON length.
+int hvd_step_trace(char* buf, int cap) {
+  if (g == nullptr) return -1;
+  if (!StepTraceOn()) return 0;
+  std::string json = StepTraceDumpJson();
   if (static_cast<int>(json.size()) + 1 > cap) return -2;
   std::memcpy(buf, json.data(), json.size());
   buf[json.size()] = '\0';

@@ -17,6 +17,7 @@
 
 #include "fault_injection.h"
 #include "logging.h"
+#include "step_trace.h"
 
 namespace hvdtpu {
 
@@ -35,7 +36,7 @@ constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
 // snapshot trailer on worker CYCLE frames, v6 the wire_comp codec byte in
 // responses, v5 the host key in the rendezvous HELLO/book + the hier bit
 // in responses)
-constexpr int32_t kProtocolVersion = 9;
+constexpr int32_t kProtocolVersion = 10;
 // Mesh-HELLO psid for child->leader ctrl-tree links: negative, so it can
 // never collide with a real process-set id (those start at 1) and always
 // lands in the pending-channel stash when it races a mesh establishment.
@@ -1673,6 +1674,11 @@ Status SocketController::CoordinatorCycle(
     ParseFullAndMetrics(rank, rd.GetI32(), &rd, &errors);
   }
 
+  // Fusion phase: everything between the gather and the finished response
+  // list — readiness collection, group gating, FuseRequests, QoS ordering,
+  // cache/seq bookkeeping.  This is the coordinator's per-cycle "thinking"
+  // span the step trace attributes to kPhaseFusion.
+  const double fuse_t0 = StepTraceOn() ? MonotonicSeconds() : 0.0;
   // Collect ready tensors in deterministic (arrival-order) sequence.
   // Joined ranks (hvd.join) count as announced for every tensor — they
   // will participate with zero contributions.
@@ -1799,13 +1805,43 @@ Status SocketController::CoordinatorCycle(
                    });
   out->insert(out->begin(), errors.begin(), errors.end());
   UpdateCachesAndSeq(out);
+  if (fuse_t0 > 0.0) {
+    StepTraceAddPhaseUs(
+        kPhaseFusion,
+        static_cast<int64_t>((MonotonicSeconds() - fuse_t0) * 1e6));
+  }
+  if (StepTraceOn()) {
+    // A cycle that ships at least one real fused response closes a step.
+    // The coordinator advances here; workers follow from the RESPONSES
+    // trailer below, so every rank counts the same steps.
+    bool step_work = false;
+    for (const auto& r : *out) {
+      if (r.error.empty() && !r.metas.empty()) {
+        step_work = true;
+        break;
+      }
+    }
+    if (step_work) {
+      StepTraceAdvance(StepTraceCurrentStep() + 1);
+      int64_t sid = 0;
+      int64_t phases[kStepPhases];
+      if (StepTraceLastCompleted(&sid, phases)) {
+        // The coordinator's own snapshot joins the fleet view directly —
+        // its trailer never crosses a socket.
+        StepTraceFleetPhases(0, sid, phases);
+      }
+    }
+  }
 
   // Broadcast the identical response list down the gather topology: every
   // direct source gets one frame; tree leaders fan their copy out to their
-  // children verbatim.
+  // children verbatim.  v10: an unconditional step-id trailer follows the
+  // responses — the coordinator's current step (-1 when tracing is off) —
+  // which workers use to advance their own step rings in lockstep.
   Writer w;
   w.PutI32(static_cast<int32_t>(out->size()));
   for (const auto& r : *out) SerializeResponse(r, &w);
+  w.PutI64(StepTraceOn() ? StepTraceCurrentStep() : -1);
   const std::string payload = w.data();
   for (int rank : sources) {
     if (departed_ranks_.count(rank)) continue;
@@ -1825,6 +1861,12 @@ Status SocketController::CoordinatorCycle(
 }
 
 void SocketController::RecordAnnounceLag(int rank, double lag_s) {
+  if (StepTraceOn()) {
+    // Announce lag is the dominant-rank signal: the coordinator waited
+    // this long between the first announcement of a tensor and this
+    // rank's, attributed to the step currently forming.
+    StepTraceFleetLagUs(rank, static_cast<int64_t>(lag_s * 1e6));
+  }
   if (!MetricsOn()) return;
   if (rank < 0 || rank >= static_cast<int>(announce_lag_.size())) return;
   announce_lag_[rank]->ObserveSeconds(lag_s);
@@ -2061,17 +2103,32 @@ std::string SocketController::BuildCycleFrame(
   for (const auto* r : full) SerializeRequest(*r, &w);
   // v7 trailer: piggyback this rank's metrics snapshot (cumulative) on
   // the cycle frame it sends anyway — the coordinator's cluster view
-  // costs no extra round trips.
-  if (MetricsOn()) {
-    const auto& m = GlobalMetrics();
-    w.PutI32(1);
-    w.PutI64(m.negotiation_wait_us.count.load(std::memory_order_relaxed));
-    w.PutI64(m.negotiation_wait_us.sum_us.load(std::memory_order_relaxed));
-    w.PutI64(m.negotiation_wait_us.QuantileUs(0.5));
-    w.PutI64(m.negotiation_wait_us.QuantileUs(0.99));
-    w.PutI64(m.cycle_busy_us.load(std::memory_order_relaxed));
-    w.PutI64(m.cycle_idle_us.load(std::memory_order_relaxed));
-    w.PutI64(m.cycle_count.load(std::memory_order_relaxed));
+  // costs no extra round trips.  v10 extends it: marker 2 carries the
+  // same 7 metric i64s (zeros when the registry is off) followed by this
+  // rank's last completed step snapshot (step id + kStepPhases phase
+  // sums), feeding the coordinator's fleet attribution.
+  int64_t st_sid = 0;
+  int64_t st_phases[kStepPhases];
+  const bool has_step =
+      StepTraceOn() && StepTraceLastCompleted(&st_sid, st_phases);
+  if (MetricsOn() || has_step) {
+    w.PutI32(has_step ? 2 : 1);
+    if (MetricsOn()) {
+      const auto& m = GlobalMetrics();
+      w.PutI64(m.negotiation_wait_us.count.load(std::memory_order_relaxed));
+      w.PutI64(m.negotiation_wait_us.sum_us.load(std::memory_order_relaxed));
+      w.PutI64(m.negotiation_wait_us.QuantileUs(0.5));
+      w.PutI64(m.negotiation_wait_us.QuantileUs(0.99));
+      w.PutI64(m.cycle_busy_us.load(std::memory_order_relaxed));
+      w.PutI64(m.cycle_idle_us.load(std::memory_order_relaxed));
+      w.PutI64(m.cycle_count.load(std::memory_order_relaxed));
+    } else {
+      for (int i = 0; i < 7; ++i) w.PutI64(0);
+    }
+    if (has_step) {
+      w.PutI64(st_sid);
+      for (int p = 0; p < kStepPhases; ++p) w.PutI64(st_phases[p]);
+    }
   } else {
     w.PutI32(0);
   }
@@ -2097,6 +2154,15 @@ void SocketController::ParseResponsesTail(Reader* rd, int32_t n,
                                   static_cast<WireCodec>(r.wire_comp)};
         }
       }
+    }
+  }
+  // v10 step-id trailer: the coordinator's current step after this cycle
+  // (-1 when tracing is off there).  Absent on pre-v10 coordinators —
+  // tolerated so mixed builds don't tear the frame apart mid-upgrade.
+  if (rd->remaining() >= 8) {
+    const int64_t sid = rd->GetI64();
+    if (rd->ok() && sid > StepTraceCurrentStep() && StepTraceOn()) {
+      StepTraceAdvance(sid);
     }
   }
 }
@@ -2169,9 +2235,12 @@ void SocketController::ParseFullAndMetrics(int rank, int32_t n_full,
     Announce(rank, DeserializeRequest(rd), errors);
   }
   // v7 trailer: the rank's piggybacked metrics snapshot (cumulative;
-  // absent marker when its registry is disabled).
+  // marker 0 when nothing piggybacks).  v10 marker 2 appends the rank's
+  // last completed step snapshot; its metric slots are zero-filled when
+  // the sender's registry is off, so cluster_ only stores real ones
+  // (cycle_count > 0 — a live registry always counts cycles).
   int32_t has_metrics = rd->GetI32();
-  if (has_metrics == 1) {
+  if (has_metrics == 1 || has_metrics == 2) {
     RankMetricsSnapshot s;
     s.neg_count = rd->GetI64();
     s.neg_sum_us = rd->GetI64();
@@ -2181,9 +2250,19 @@ void SocketController::ParseFullAndMetrics(int rank, int32_t n_full,
     s.cycle_idle_us = rd->GetI64();
     s.cycle_count = rd->GetI64();
     s.updated_at = MonotonicSeconds();
-    std::lock_guard<std::mutex> l(metrics_mu_);
-    if (rank >= 0 && rank < static_cast<int>(cluster_.size())) {
-      cluster_[rank] = s;
+    if (s.cycle_count > 0) {
+      std::lock_guard<std::mutex> l(metrics_mu_);
+      if (rank >= 0 && rank < static_cast<int>(cluster_.size())) {
+        cluster_[rank] = s;
+      }
+    }
+  }
+  if (has_metrics == 2) {
+    const int64_t sid = rd->GetI64();
+    int64_t phases[kStepPhases];
+    for (int p = 0; p < kStepPhases; ++p) phases[p] = rd->GetI64();
+    if (rd->ok() && StepTraceOn()) {
+      StepTraceFleetPhases(rank, sid, phases);
     }
   }
 }
@@ -2347,6 +2426,9 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
                          nullptr);
     }
   }
+  // Tree-aggregate merge: the leader's share of the fusion phase (the
+  // coordinator's fuse/gate span is measured in CoordinatorCycle).
+  const double agg_t0 = StepTraceOn() ? MonotonicSeconds() : 0.0;
   Writer w;
   w.PutI32(-3);  // leader aggregate sentinel in the cycle-frame position
   w.PutI32(static_cast<int32_t>(groups.size()));
@@ -2362,6 +2444,11 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
   for (const auto& [rank, rest] : rests) {
     w.PutI32(rank);
     w.PutString(rest);
+  }
+  if (agg_t0 > 0.0) {
+    StepTraceAddPhaseUs(
+        kPhaseFusion,
+        static_cast<int64_t>((MonotonicSeconds() - agg_t0) * 1e6));
   }
   if (FlightOn()) {
     // One aggregate frame per host per cycle: how many child frames this
@@ -2649,7 +2736,8 @@ Status SocketController::ChunkedStep(
   CountSend(send_to, send_len + hdr,
             (raw_len < 0 ? send_len : raw_len) + hdr);
   if (FlightOn()) FlightRecord(kFlightRingHop, tag, send_len + hdr);
-  const double hop_t0 = MetricsOn() ? MonotonicSeconds() : 0.0;
+  const double hop_t0 =
+      (MetricsOn() || StepTraceOn()) ? MonotonicSeconds() : 0.0;
   ChunkExchangeError err;
   if (!ChunkedDuplexExchange(socks[send_to], send_base, send_len,
                              socks[recv_from], recv_len, chunk_bytes,
@@ -2681,7 +2769,9 @@ Status SocketController::ChunkedStep(
                              std::to_string(recv_from) + ")");
   }
   if (hop_t0 > 0.0) {
-    GlobalMetrics().ring_hop_us.ObserveSeconds(MonotonicSeconds() - hop_t0);
+    const double hop_s = MonotonicSeconds() - hop_t0;
+    if (MetricsOn()) GlobalMetrics().ring_hop_us.ObserveSeconds(hop_s);
+    StepTraceAddPhaseUs(kPhaseRing, static_cast<int64_t>(hop_s * 1e6));
   }
   return Status::OK();
 }
@@ -3396,7 +3486,9 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
   // above kTagShmSize) — the public Barrier() is a user-visible collective,
   // not plane bookkeeping.
   const double fence_t0 =
-      tag_base >= kTagShmSize && MetricsOn() ? MonotonicSeconds() : 0.0;
+      tag_base >= kTagShmSize && (MetricsOn() || StepTraceOn())
+          ? MonotonicSeconds()
+          : 0.0;
   if (FlightOn() && tag_base >= kTagShmSize) {
     FlightRecord(kFlightShmFence, tag_base, 0);
   }
@@ -3423,7 +3515,9 @@ Status SocketController::SockBarrier(std::vector<Socket>& socks,
     if (!st.ok()) return st;
   }
   if (fence_t0 > 0.0) {
-    GlobalMetrics().shm_fence_us.ObserveSeconds(MonotonicSeconds() - fence_t0);
+    const double fence_s = MonotonicSeconds() - fence_t0;
+    if (MetricsOn()) GlobalMetrics().shm_fence_us.ObserveSeconds(fence_s);
+    StepTraceAddPhaseUs(kPhaseFence, static_cast<int64_t>(fence_s * 1e6));
   }
   return Status::OK();
 }

@@ -161,6 +161,14 @@ class ElasticCoordinatorClient:
             os.environ["HOROVOD_AUTOPILOT_PORT"] = str(a["policy_port"])
         else:
             os.environ.pop("HOROVOD_AUTOPILOT_PORT", None)
+        # Live cockpit: same rank-0-only rule.  The driver hands out the
+        # SAME port every generation, so SSE clients reconnect to a stable
+        # address after a re-formation; HOROVOD_COCKPIT itself is the
+        # user-facing on/off switch and rides the normal environment.
+        if a.get("cockpit_port") and int(a["rank"]) == 0:
+            os.environ["HOROVOD_COCKPIT_PORT"] = str(a["cockpit_port"])
+        else:
+            os.environ.pop("HOROVOD_COCKPIT_PORT", None)
         return a
 
     def mark_ready(self) -> None:

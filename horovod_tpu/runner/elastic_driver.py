@@ -151,7 +151,8 @@ class ElasticDriver:
                  min_np: int, max_np: Optional[int],
                  base_env: Optional[Dict[str, str]] = None,
                  start_timeout: float = 120.0, verbose: bool = False,
-                 ssh_port: Optional[int] = None, autopilot: bool = False):
+                 ssh_port: Optional[int] = None, autopilot: bool = False,
+                 cockpit: bool = False):
         self.discovery = discovery
         self.command = command
         self.min_np = min_np
@@ -166,6 +167,13 @@ class ElasticDriver:
         self.autopilot = autopilot
         self._policy_port: Optional[int] = None
         self._policy_gen = -1
+        # Live cockpit (HOROVOD_COCKPIT): rank 0 serves /metrics, /state,
+        # and /events on this loopback port.  Chosen ONCE and reused for
+        # every generation, so an hvd_top.py SSE client simply reconnects
+        # to the same address when a re-formation replaces rank 0.
+        self.cockpit = cockpit
+        self._cockpit_port: Optional[int] = None
+        self._cockpit_gen = -1
 
         self._lock = threading.Lock()
         self._workers: Dict[str, _Worker] = {}      # worker_id -> worker
@@ -493,6 +501,16 @@ class ElasticDriver:
         if self.autopilot and rdv_addr == "127.0.0.1":
             policy_port = (r0_ports.pop(0) if r0_ports
                            else find_free_port("127.0.0.1"))
+        # Cockpit endpoint: same loopback trust boundary as the policy
+        # channel, but the port is sticky across generations (picked on the
+        # first local-rank-0 formation, reused after) so live SSE clients
+        # survive a re-formation by reconnecting to the address they know.
+        cockpit_port = None
+        if self.cockpit and rdv_addr == "127.0.0.1":
+            if self._cockpit_port is None:
+                self._cockpit_port = (r0_ports.pop(0) if r0_ports
+                                      else find_free_port("127.0.0.1"))
+            cockpit_port = self._cockpit_port
         local_sizes = collections.Counter(w.host for w in expected)
         local_seen: Dict[str, int] = {}
         hosts_order = list(dict.fromkeys(w.host for w in expected))
@@ -511,11 +529,13 @@ class ElasticDriver:
                 "rendezvous_port": rdv_port,
                 "jax_coordinator": jax_coord,
                 "policy_port": policy_port,
+                "cockpit_port": cockpit_port,
             })
         self._generation = gen
         self._formed_size = size
         self._policy_port = policy_port
         self._policy_gen = gen
+        self._cockpit_gen = gen if cockpit_port is not None else -1
         if self.verbose:
             print(f"elastic driver: generation {gen} formed with {size} "
                   f"worker(s)", file=sys.stderr)
@@ -550,6 +570,13 @@ class ElasticDriver:
         """(generation, port) of the current coordinator's loopback policy
         listener, or (gen, None) when unavailable this generation."""
         return self._policy_gen, self._policy_port
+
+    def cockpit_endpoint(self):
+        """(generation, port) of the live cockpit on the current rank 0,
+        or (gen, None) when the cockpit is off or rank 0 is remote.  The
+        port is stable across generations by construction."""
+        return self._cockpit_gen, (
+            self._cockpit_port if self._cockpit_gen >= 0 else None)
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:
@@ -632,5 +659,6 @@ def run_elastic(args, command: List[str]) -> int:
     driver = ElasticDriver(discovery, command, min_np, max_np, base_env,
                            start_timeout=args.start_timeout,
                            verbose=args.verbose, ssh_port=args.ssh_port,
-                           autopilot=getattr(args, "autopilot", False))
+                           autopilot=getattr(args, "autopilot", False),
+                           cockpit=getattr(args, "cockpit", False))
     return driver.run()

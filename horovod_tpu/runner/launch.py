@@ -65,6 +65,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "(implies elastic mode and HOROVOD_METRICS=1; "
                         "decision rules and HOROVOD_AUTOPILOT_* knobs in "
                         "docs/elastic.md)")
+    p.add_argument("--cockpit", action="store_true",
+                   help="live cluster cockpit: rank 0 serves /metrics, "
+                        "/state and /events (SSE) on a loopback port the "
+                        "elastic driver keeps stable across re-formations; "
+                        "watch it with tools/hvd_top.py "
+                        "(docs/observability.md)")
     # Tuning flags mirroring the reference CLI -> env contract.
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cycle-time-ms", type=float, default=None)
@@ -234,6 +240,11 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         # Straggler attribution (the autopilot's input) lives behind the
         # metrics plane; the policy loop is useless without it.
         env["HOROVOD_METRICS"] = "1"
+    if getattr(args, "cockpit", False):
+        # The cockpit's /state straggler/tenant sections come from the
+        # metrics plane too; the step-trace pillar is on by default.
+        env["HOROVOD_COCKPIT"] = "1"
+        env["HOROVOD_METRICS"] = "1"
     return env
 
 
@@ -370,6 +381,11 @@ def _run(args: argparse.Namespace) -> int:
         from ..utils.env import get_bool
 
         args.autopilot = get_bool("HOROVOD_AUTOPILOT", False)
+    if not getattr(args, "cockpit", False):
+        # Env-var spelling of --cockpit, same rationale as --autopilot.
+        from ..utils.env import get_bool
+
+        args.cockpit = get_bool("HOROVOD_COCKPIT", False)
     if not args.command:
         print("error: no command given", file=sys.stderr)
         return 2

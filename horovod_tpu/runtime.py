@@ -43,7 +43,7 @@ log = get_logger()
 # Mirror of kProtocolVersion in cpp/socket_controller.cc — the two MUST move
 # together (tools/hvd_lint.py enforces it).  Exposed so launcher diagnostics
 # and rendezvous error messages can name the wire generation they speak.
-PROTOCOL_VERSION = 9
+PROTOCOL_VERSION = 10
 
 
 def compute_ctrl_tree(host_keys, mode: str = "auto") -> dict:
@@ -281,6 +281,12 @@ class CoreBackend:
     def flight_record(self) -> dict:
         """Snapshot of the flight-recorder event ring (always-on black
         box); empty for backends without the native recorder."""
+        return {}
+
+    def step_trace(self) -> dict:
+        """Snapshot of the causal step-trace ring (per-step phase
+        breakdowns, fleet attribution on rank 0); empty for backends
+        without the native tracer."""
         return {}
 
     def migrate_note(self, phase: int, nbytes: int,

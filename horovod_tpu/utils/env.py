@@ -257,6 +257,23 @@ class Config:
     # processes or the native core.
     autopilot_port: int = 0
 
+    # HOROVOD_STEP_TRACE: causal step tracing — per-step phase breakdown
+    # (negotiation-wait / fusion / ring / fence / idle) recorded into a
+    # per-rank ring and aggregated fleet-wide on the coordinator.  On by
+    # default, same cost bar as the flight recorder.
+    # HOROVOD_STEP_TRACE_SLOTS sizes the ring (rounded up to a power of
+    # two).
+    step_trace_enabled: bool = True
+    step_trace_slots: int = 256
+    # HOROVOD_COCKPIT: the live cluster cockpit — a loopback HTTP endpoint
+    # on rank 0 serving /metrics, /state, and /events (SSE) for
+    # tools/hvd_top.py.  Off by default: disabled it binds nothing and
+    # costs nothing.  HOROVOD_COCKPIT_PORT is driver-internal (assigned
+    # per formation, like HOROVOD_AUTOPILOT_PORT); 0 with HOROVOD_COCKPIT
+    # on means "pick a free loopback port".
+    cockpit_enabled: bool = False
+    cockpit_port: int = 0
+
     # Native core selection (TPU-build specific).
     force_pure_python: bool = False
 
@@ -326,5 +343,9 @@ class Config:
             migrate_interval_steps=max(
                 1, get_int("HOROVOD_MIGRATE_INTERVAL_STEPS", 1)),
             autopilot_port=get_int("HOROVOD_AUTOPILOT_PORT", 0),
+            step_trace_enabled=get_bool("HOROVOD_STEP_TRACE", True),
+            step_trace_slots=get_int("HOROVOD_STEP_TRACE_SLOTS", 256),
+            cockpit_enabled=get_bool("HOROVOD_COCKPIT", False),
+            cockpit_port=get_int("HOROVOD_COCKPIT_PORT", 0),
             force_pure_python=get_bool("HVD_TPU_PURE_PY", False),
         )

@@ -27,6 +27,18 @@ def _counter_name(name: str) -> str:
     return f"hvd_{base}_total"
 
 
+def _escape_label(value) -> str:
+    """Escape a label value per the Prometheus text exposition format.
+
+    Backslash, double-quote, and newline are the three characters the
+    format reserves inside a quoted label value.  Ranks and bucket bounds
+    are numeric today, but psid comes from user-chosen process-set ids —
+    a hostile or merely creative name must not break the whole scrape.
+    """
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def render_prometheus(dump: Dict) -> str:
     """Render a ``hvd.metrics()`` dict as Prometheus exposition text.
 
@@ -36,7 +48,7 @@ def render_prometheus(dump: Dict) -> str:
     """
     if not dump:
         return ""
-    rank = dump.get("rank", 0)
+    rank = _escape_label(dump.get("rank", 0))
     lines: List[str] = []
     for name, value in sorted((dump.get("counters") or {}).items()):
         metric = _counter_name(name)
@@ -64,7 +76,7 @@ def render_prometheus(dump: Dict) -> str:
         lines.append(f'{metric}_sum{{rank="{rank}"}} {int(h.get("sum_us", 0))}')
         lines.append(f'{metric}_count{{rank="{rank}"}} {int(h.get("count", 0))}')
     for psid, t in sorted((dump.get("tenants") or {}).items()):
-        labels = f'rank="{rank}",psid="{psid}"'
+        labels = f'rank="{rank}",psid="{_escape_label(psid)}"'
         for field in ("responses", "tensors", "bytes"):
             metric = f"hvd_tenant_{field}_total"
             lines.append(f"# TYPE {metric} counter")

@@ -1,0 +1,159 @@
+"""Causal step tracing end-to-end: the per-rank step ring and the
+coordinator's fleet attribution at np=2, and the headline acceptance run
+at np=4 — a coordinator-recv delay injected against rank 3 must be
+attributed to rank 3 / negotiation_wait by BOTH surfaces: the live
+cockpit's /state snapshot queried mid-run, and tools/critical_path.py
+over the shutdown step-trace dumps.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+
+import pytest
+
+from horovod_tpu.runner import run
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BASE_ENV = {"JAX_PLATFORMS": "cpu"}
+
+PHASES = ["negotiation_wait", "fusion", "ring", "fence", "idle"]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trace_worker(steps):
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    for i in range(steps):
+        out = hvd.allreduce(np.full(64, float(r), np.float32), op=hvd.Sum,
+                            name=f"t.{i}")
+        np.testing.assert_allclose(out, s * (s - 1) / 2.0)
+    hvd.barrier()
+    trace = hvd.step_trace()
+    cockpit = HorovodContext.instance().cockpit
+    hvd.shutdown()
+    return {"rank": r, "trace": trace, "cockpit_bound": cockpit is not None}
+
+
+def test_step_ring_and_fleet_attribution_np2(tmp_path):
+    env = dict(BASE_ENV, HOROVOD_POSTMORTEM_DIR=str(tmp_path))
+    res = run(_trace_worker, args=(12,), np=2, env=env)
+    assert [r["rank"] for r in res] == [0, 1]
+    # Cockpit is off by default: no listener without HOROVOD_COCKPIT=1.
+    assert not any(r["cockpit_bound"] for r in res)
+    for r in res:
+        t = r["trace"]
+        assert t["phases"] == PHASES
+        assert t["completed"] >= 10
+        # Every completed step carries wall bounds and the phase sums.
+        for row in t["steps"]:
+            sid, start, end = row[0], row[1], row[2]
+            assert end >= start >= 1  # wall-clock us, not zero
+            assert len(row) == 3 + len(PHASES)
+            assert all(us >= 0 for us in row[3:])
+    # Only the coordinator holds fleet records; both ranks reported.
+    fleet0 = res[0]["trace"]["fleet"]
+    assert fleet0, "coordinator recorded no fleet attribution"
+    assert not res[1]["trace"]["fleet"]
+    for f in fleet0:
+        assert 1 <= f["reported"] <= 2
+        assert len(f["lag_us"]) == 2
+        assert f["dominant_phase"] in PHASES
+        assert f["dominant_rank"] in (-1, 0, 1)
+    # Workers report a step's phase snapshot on a LATER cycle (they learn
+    # the step id from the RESPONSES trailer), so the trailing steps may
+    # only carry the coordinator's own report — but the bulk must have
+    # both ranks in.
+    full = sum(1 for f in fleet0 if f["reported"] == 2)
+    assert full >= len(fleet0) / 2, [f["reported"] for f in fleet0]
+    # Shutdown dumps one steptrace.<rank>.json per rank.
+    dumps = sorted(glob.glob(str(tmp_path / "steptrace.*.json")))
+    assert [os.path.basename(p) for p in dumps] == [
+        "steptrace.0.json", "steptrace.1.json"]
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "steptrace-v1"
+    assert doc["rank"] == 0 and doc["world"] == 2
+
+
+def _delayed_rank_worker(steps):
+    import json as _json
+    import urllib.request
+
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.context import HorovodContext
+
+    hvd.init(build_mesh=False)
+    r, s = hvd.rank(), hvd.size()
+    for i in range(steps):
+        hvd.allreduce(np.full(32, float(r), np.float32), op=hvd.Sum,
+                      name=f"d.{i}")
+    hvd.barrier()
+    state = None
+    if r == 0:
+        cockpit = HorovodContext.instance().cockpit
+        assert cockpit is not None, "HOROVOD_COCKPIT=1 but no server"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{cockpit.port}/state", timeout=10) as rsp:
+            state = _json.loads(rsp.read())
+    hvd.shutdown()
+    return {"rank": r, "state": state}
+
+
+def test_np4_delayed_rank_attributed_live_and_offline(tmp_path):
+    # Every coordinator-side recv from peer rank 3 sleeps 25ms: rank 3's
+    # announcements land late, the other ranks stall in negotiation, and
+    # both surfaces must say so.
+    env = dict(BASE_ENV,
+               HOROVOD_COCKPIT="1",
+               HOROVOD_METRICS="1",
+               HOROVOD_POSTMORTEM_DIR=str(tmp_path),
+               HOROVOD_FAULT_INJECT="coordinator-recv:*:3:delay:25")
+    res = run(_delayed_rank_worker, args=(25,), np=4, env=env)
+    assert [r["rank"] for r in res] == [0, 1, 2, 3]
+
+    # Live surface: the /state snapshot taken DURING the run.
+    state = res[0]["state"]
+    assert state["schema"] == "cockpit-state-v1"
+    assert (state["rank"], state["world"]) == (0, 4)
+    assert state["phases"] == PHASES
+    steps = state["steps"]
+    assert len(steps) >= 10, f"too few live fleet steps: {len(steps)}"
+    live_hits = sum(1 for f in steps
+                    if f["dominant_rank"] == 3
+                    and f["dominant_phase"] == "negotiation_wait")
+    assert live_hits > len(steps) / 2, (
+        f"live cockpit blamed rank 3/negotiation_wait on only "
+        f"{live_hits}/{len(steps)} steps: {steps[:5]}")
+
+    # Offline surface: the analyzer over the shutdown dumps agrees.
+    cp = _load_tool("critical_path")
+    dumps = sorted(glob.glob(str(tmp_path / "steptrace.*.json")))
+    assert len(dumps) == 4
+    result = cp.analyze(dumps)
+    s = result["summary"]
+    assert s["ranks"] == [0, 1, 2, 3]
+    assert s["steps"] >= 10
+    assert (s["dominant_rank"], s["dominant_phase"]) == (
+        3, "negotiation_wait"), s
+    assert s["dominant_steps"] > s["steps"] / 2
+    # The injected stall is pure bubble: the fleet spent most of its
+    # traced time waiting, and the analyzer's summary shows it.
+    assert s["bubble_fraction"] > 0.5

@@ -1,0 +1,265 @@
+"""CockpitServer lifecycle with a stub coordinator: route contents on an
+ephemeral loopback port, crash-proof metrics/state callables, the SSE
+stream (hello, step diffing, instant publication, drop-don't-block), the
+re-formation story (a new server generation rebinding the same port so a
+live SSE client can reconnect), maybe_start_cockpit gating (never binds
+when disabled or off rank 0), and the elastic driver's sticky cockpit
+port across generations.
+"""
+
+import http.client
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import cockpit as ck
+
+
+def _stub_metrics():
+    return 'hvd_steps_total{rank="0"} 7\n'
+
+
+def _get(port, path, timeout=5):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.getheader("Content-Type"), resp.read()
+    finally:
+        conn.close()
+
+
+def _sse_connect(port):
+    """Open /events and consume the hello comment; returns (conn, resp)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", "/events")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert "text/event-stream" in resp.getheader("Content-Type")
+    assert resp.fp.readline().startswith(b": cockpit stream open")
+    return conn, resp
+
+
+def _next_data(resp, deadline=5.0):
+    """Next `data:` payload, skipping keep-alive comments and blanks."""
+    end = time.monotonic() + deadline
+    while time.monotonic() < end:
+        line = resp.fp.readline()
+        if line.startswith(b"data: "):
+            return json.loads(line[len(b"data: "):])
+    raise AssertionError("no SSE data line before deadline")
+
+
+def test_routes_on_ephemeral_port():
+    state = {"schema": "cockpit-state-v1", "steps": [{"step": 0}]}
+    srv = ck.CockpitServer(_stub_metrics, lambda: state, port=0)
+    try:
+        port = srv.start()
+        assert port > 0 and srv.port == port
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert b'hvd_steps_total{rank="0"} 7' in body
+        status, ctype, body = _get(port, "/state")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body) == state
+        status, _, _ = _get(port, "/nope")
+        assert status == 404
+        # Idempotent start: same port, no second bind.
+        assert srv.start() == port
+    finally:
+        srv.stop()
+    # Stopped server no longer answers.
+    with pytest.raises(OSError):
+        _get(port, "/state", timeout=0.5)
+
+
+def test_crashing_callables_surface_instead_of_500():
+    def bad_metrics():
+        raise RuntimeError("scrape exploded")
+
+    def bad_state():
+        raise RuntimeError("snapshot exploded")
+
+    srv = ck.CockpitServer(bad_metrics, bad_state, port=0)
+    try:
+        port = srv.start()
+        status, _, body = _get(port, "/metrics")
+        assert status == 200 and b"cockpit metrics error" in body
+        status, _, body = _get(port, "/state")
+        assert status == 200
+        assert json.loads(body) == {"error": "snapshot exploded"}
+    finally:
+        srv.stop()
+
+
+def test_sse_step_diff_and_instant_publication():
+    steps = []
+    srv = ck.CockpitServer(_stub_metrics, lambda: {"steps": list(steps)},
+                           port=0, poll_interval_s=0.02)
+    try:
+        port = srv.start()
+        conn, resp = _sse_connect(port)
+        try:
+            # The poll loop diffs the fleet list by step id: appending two
+            # steps publishes each exactly once, in order.
+            steps.append({"step": 0, "dominant_rank": 1})
+            ev = _next_data(resp)
+            assert (ev["step"], ev["type"]) == (0, "step")
+            steps.append({"step": 1, "dominant_rank": 3})
+            assert _next_data(resp)["step"] == 1
+            # Re-serving the same list publishes nothing new; a direct
+            # publish() (autopilot/migrate instants) comes through instead.
+            srv.publish({"type": "migrate", "source": 2})
+            ev = _next_data(resp)
+            assert (ev["type"], ev["source"]) == ("migrate", 2)
+        finally:
+            conn.close()
+    finally:
+        srv.stop()
+
+
+def test_sse_client_survives_reformation_on_same_port():
+    # Generation g's rank 0 dies; the elastic driver hands the SAME port
+    # to the next generation's rank 0.  A live client's read fails, it
+    # reconnects to the address it knows, and keeps streaming.
+    srv1 = ck.CockpitServer(_stub_metrics,
+                            lambda: {"steps": [{"step": 5}]},
+                            port=0, poll_interval_s=0.02)
+    port = srv1.start()
+    conn, resp = _sse_connect(port)
+    assert _next_data(resp)["step"] == 5
+    srv1.stop()  # re-formation tears down the old coordinator
+    conn.close()
+    srv2 = ck.CockpitServer(_stub_metrics,
+                            lambda: {"steps": [{"step": 6}]},
+                            port=port, poll_interval_s=0.02)
+    try:
+        assert srv2.start() == port  # sticky port rebinds
+        conn, resp = _sse_connect(port)
+        try:
+            assert _next_data(resp)["step"] == 6
+        finally:
+            conn.close()
+    finally:
+        srv2.stop()
+
+
+def test_publish_drops_for_full_client_only():
+    srv = ck.CockpitServer(_stub_metrics, lambda: {"steps": []}, port=0)
+    full = queue.Queue(maxsize=1)
+    full.put_nowait("occupied")
+    ok = queue.Queue(maxsize=4)
+    with srv._clients_mu:
+        srv._clients[:] = [full, ok]
+    srv.publish({"type": "abort"})  # must not raise or block
+    assert full.qsize() == 1  # dropped for the laggard...
+    assert json.loads(ok.get_nowait())["type"] == "abort"  # ...not others
+
+
+class _StubCore:
+    def metrics(self):
+        return {"rank": 0, "counters": {"steps_total": 3},
+                "tenants": {"default": {"responses": 3, "tensors": 6,
+                                        "bytes": 1024}},
+                "migrate_events_total": 2}
+
+    def step_trace(self):
+        return {"phases": ["negotiation_wait", "fusion", "ring", "fence",
+                           "idle"],
+                "fleet": [{"step": 0, "dominant_phase": "ring",
+                           "dominant_rank": 1}]}
+
+
+class _StubCtx:
+    def __init__(self, rank=0, enabled=True, port=0):
+        self.core = _StubCore()
+        self.cfg = type("Cfg", (), {
+            "rank": rank, "size": 4, "cockpit_enabled": enabled,
+            "cockpit_port": port})()
+
+
+def test_maybe_start_cockpit_never_binds_when_disabled(monkeypatch):
+    def explode(*a, **k):
+        raise AssertionError("CockpitServer constructed while disabled")
+
+    monkeypatch.setattr(ck, "CockpitServer", explode)
+    assert ck.maybe_start_cockpit(_StubCtx(enabled=False)) is None
+    assert ck.maybe_start_cockpit(_StubCtx(rank=2)) is None  # rank 0 only
+
+
+def test_maybe_start_cockpit_serves_production_state():
+    srv = ck.maybe_start_cockpit(_StubCtx())
+    assert srv is not None
+    try:
+        status, _, body = _get(srv.port, "/state")
+        assert status == 200
+        state = json.loads(body)
+        assert state["schema"] == "cockpit-state-v1"
+        assert (state["rank"], state["world"]) == (0, 4)
+        assert state["steps"][0]["dominant_phase"] == "ring"
+        assert state["tenants"]["default"]["bytes"] == 1024
+        assert state["migration"]["migrate_events_total"] == 2
+        _, _, body = _get(srv.port, "/metrics")
+        assert b'hvd_steps_total_total{rank="0"} 3' not in body  # no doubling
+        assert b'hvd_steps_total{rank="0"} 3' in body
+    finally:
+        srv.stop()
+
+
+def test_maybe_start_cockpit_bind_failure_is_nonfatal():
+    # Another live listener already owns the port (SO_REUSEADDR does not
+    # allow two concurrent listeners): the cockpit logs and stands down
+    # instead of taking the job with it.
+    blocker = ck.CockpitServer(_stub_metrics, lambda: {}, port=0)
+    port = blocker.start()
+    try:
+        assert ck.maybe_start_cockpit(_StubCtx(port=port)) is None
+    finally:
+        blocker.stop()
+
+
+def _fake_worker(host, slot):
+    class W:
+        pass
+
+    w = W()
+    w.host, w.slot = host, slot
+    w.worker_id = f"{host}:{slot}"
+    w.dead = False
+    w.rank = None
+    w.spawn_gen = 0
+    w.ready = threading.Event()
+    w.ready.set()
+    w.free_ports = []
+    w.sent = []
+    w.send = w.sent.append
+    return w
+
+
+def test_elastic_driver_cockpit_port_sticky_across_generations():
+    from horovod_tpu.runner import elastic_driver as ed
+
+    drv = ed.ElasticDriver(ed.FixedHosts({"127.0.0.1": 2}), ["true"],
+                           min_np=2, max_np=2, cockpit=True)
+    workers = [_fake_worker("127.0.0.1", i) for i in range(2)]
+    drv._workers = {w.worker_id: w for w in workers}
+    assert drv.cockpit_endpoint() == (-1, None)
+
+    assert drv._form_generation()
+    gen0, port0 = drv.cockpit_endpoint()
+    assert gen0 == 0 and port0 is not None
+    # Every assignment message carried the port (rank 0 binds, the rest
+    # export it so launch-time env fallbacks agree).
+    for w in workers:
+        assert w.sent[-1]["cockpit_port"] == port0
+
+    # Workers tear down (ready again) and the next generation forms: the
+    # port choice is sticky, not re-probed.
+    for w in workers:
+        w.ready.set()
+    assert drv._form_generation()
+    gen1, port1 = drv.cockpit_endpoint()
+    assert (gen1, port1) == (1, port0)

@@ -1,0 +1,156 @@
+"""tools/critical_path.py on synthetic step-trace dumps: fleet records
+win over wall-clock fallback, the fallback picks the longest rank and its
+largest busy phase, bubble fraction arithmetic, abort context from flight
+dumps, the merged-timeline reconstruction path producing the same
+analysis as the raw dumps, and CLI exit codes.
+"""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+cp = _load_tool("critical_path")
+mt = _load_tool("merge_timeline")
+
+PHASES = ["negotiation_wait", "fusion", "ring", "fence", "idle"]
+
+
+def _dump(rank, steps, fleet=None, world=2):
+    return {"schema": "steptrace-v1", "rank": rank, "world": world,
+            "slots": 256, "completed": len(steps), "phases": PHASES,
+            "steps": steps, "fleet": fleet or []}
+
+
+def _write(tmp_path, name, doc):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def test_fleet_records_are_authoritative(tmp_path):
+    # The coordinator attributes both steps to rank 3's negotiation wait;
+    # rank 0's own wall extent is longer, but fleet attribution wins.
+    base = 1_000_000
+    steps = [[0, base, base + 2000, 100, 100, 300, 0, 0],
+             [1, base + 3000, base + 5000, 100, 100, 300, 0, 0]]
+    fleet = [{"step": s, "phase_us": [1500, 100, 300, 0, 0],
+              "lag_us": [0, 10, 20, 1400], "reported": 4,
+              "dominant_phase": "negotiation_wait", "dominant_rank": 3}
+             for s in (0, 1)]
+    p = _write(tmp_path, "steptrace.0.json", _dump(0, steps, fleet, world=4))
+    result = cp.analyze([p])
+    assert [r["step"] for r in result["rows"]] == [0, 1]
+    for r in result["rows"]:
+        assert (r["rank"], r["phase"], r["source"]) == (
+            3, "negotiation_wait", "fleet")
+    s = result["summary"]
+    assert (s["dominant_rank"], s["dominant_phase"], s["dominant_steps"]) \
+        == (3, "negotiation_wait", 2)
+    assert not result["skipped"]
+
+
+def test_wall_fallback_longest_rank_largest_busy_phase(tmp_path):
+    # No fleet records (worker-only dumps): the row goes to the rank with
+    # the longest wall extent and its largest phase excluding idle.
+    base = 2_000_000
+    p0 = _write(tmp_path, "steptrace.0.json", _dump(
+        0, [[0, base, base + 500, 100, 50, 300, 0, 50]]))
+    p1 = _write(tmp_path, "steptrace.1.json", _dump(
+        1, [[0, base, base + 900, 200, 50, 100, 0, 550]]))
+    result = cp.analyze([p0, p1])
+    (row,) = result["rows"]
+    # Rank 1 took 900us (vs 500); its largest busy phase is
+    # negotiation_wait (idle's 550us is excluded from the argmax).
+    assert (row["rank"], row["phase"], row["duration_us"],
+            row["source"]) == (1, "negotiation_wait", 900, "wall")
+
+
+def test_bubble_fraction_arithmetic(tmp_path):
+    # bubble = negotiation_wait + fence + idle; busy = fusion + ring.
+    p = _write(tmp_path, "steptrace.0.json", _dump(
+        0, [[0, 0, 1000, 100, 200, 300, 150, 250]]))
+    s = cp.analyze([p])["summary"]
+    assert (s["bubble_us"], s["busy_us"]) == (500, 500)
+    assert s["bubble_fraction"] == 0.5
+    assert s["ranks"] == [0]
+    assert s["aborted"] is False
+
+
+def test_fleet_dedup_keeps_most_reported(tmp_path):
+    # Two dumps carry a fleet record for the same step: the one with the
+    # higher reported count (the coordinator that saw more ranks) wins.
+    base = 3_000_000
+    row = [0, base, base + 100, 50, 0, 50, 0, 0]
+    f_lo = [{"step": 0, "phase_us": [50, 0, 50, 0, 0], "lag_us": [0, 0],
+             "reported": 1, "dominant_phase": "ring", "dominant_rank": 0}]
+    f_hi = [{"step": 0, "phase_us": [900, 0, 50, 0, 0], "lag_us": [0, 800],
+             "reported": 2, "dominant_phase": "negotiation_wait",
+             "dominant_rank": 1}]
+    p0 = _write(tmp_path, "a.json", _dump(0, [row], f_hi))
+    p1 = _write(tmp_path, "b.json", _dump(0, [row], f_lo))
+    (r,) = cp.analyze([p1, p0])["rows"]
+    assert (r["rank"], r["phase"]) == (1, "negotiation_wait")
+
+
+def test_flight_dump_marks_aborted(tmp_path):
+    p = _write(tmp_path, "steptrace.0.json", _dump(
+        0, [[0, 0, 100, 50, 0, 50, 0, 0]]))
+    flight = {"rank": 1, "slots": 16, "dropped": 0, "types": {},
+              "events": [[5000, 9, cp.FLIGHT_ABORT_TYPE, 0, 1, 0]]}
+    pf = _write(tmp_path, "flight.1.json", flight)
+    result = cp.analyze([p, pf])
+    assert result["summary"]["aborted"] is True
+    assert "ABORT" in cp.render(result, last=0)
+
+
+def test_merged_timeline_reproduces_dump_analysis(tmp_path):
+    # merge_timeline's step-trace tracks carry enough to re-run the
+    # attribution: a merged artifact alone yields the same rows and the
+    # same dominant attribution as the raw dumps.
+    base = 4_000_000
+    steps0 = [[0, base, base + 700, 400, 100, 200, 0, 0],
+              [1, base + 1000, base + 1600, 300, 100, 200, 0, 0]]
+    steps1 = [[0, base, base + 650, 350, 100, 200, 0, 0],
+              [1, base + 1000, base + 1500, 250, 100, 150, 0, 0]]
+    fleet = [{"step": s, "phase_us": [750, 200, 400, 0, 0],
+              "lag_us": [0, 600], "reported": 2,
+              "dominant_phase": "negotiation_wait", "dominant_rank": 1}
+             for s in (0, 1)]
+    p0 = _write(tmp_path, "steptrace.0.json", _dump(0, steps0, fleet))
+    p1 = _write(tmp_path, "steptrace.1.json", _dump(1, steps1))
+    direct = cp.analyze([p0, p1])
+    merged_path = _write(tmp_path, "merged.json", mt.merge([p0, p1]))
+    via_timeline = cp.analyze([merged_path])
+    assert via_timeline["rows"] == direct["rows"]
+    for key in ("dominant_rank", "dominant_phase", "dominant_steps",
+                "steps", "ranks"):
+        assert via_timeline["summary"][key] == direct["summary"][key]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    good = _write(tmp_path, "steptrace.0.json", _dump(
+        0, [[0, 0, 100, 50, 0, 50, 0, 0]]))
+    bad = str(tmp_path / "garbage.json")
+    with open(bad, "w") as f:
+        f.write("{not json")
+    assert cp.main([good]) == 0
+    out = capsys.readouterr().out
+    assert "bubble fraction" in out
+    assert cp.main(["--json", good]) == 0
+    json.loads(capsys.readouterr().out)
+    # Nothing usable at all -> non-zero.
+    assert cp.main([bad]) == 1
+    assert "skipped" in capsys.readouterr().out

@@ -271,6 +271,103 @@ def test_protocol_codec_mismatch_is_found():
 
 
 # ---------------------------------------------------------------------------
+# flight pass fixtures
+# ---------------------------------------------------------------------------
+
+FR_H_OK = """
+enum FlightType : uint16_t {
+  kFlightCtrlSend = 1,
+  kFlightRingHop = 2,
+  kFlightTreeAgg = 3,
+};
+"""
+
+FR_CC_OK = r"""
+static const char kFlightTypesLegend[] =
+    "{\"1\":\"ctrl_send\",\"2\":\"ring_hop\","
+    "\"3\":\"tree_aggregate\"}";
+"""
+
+PM_OK = """
+FLIGHT_TYPES = {
+    1: "ctrl_send", 2: "ring_hop", 3: "tree_aggregate",
+}
+"""
+
+DOC_FLIGHT_OK = """
+<!-- hvd_lint:flight-types -->
+| id | name | a | b |
+|---|---|---|---|
+| 1 | `ctrl_send` | 0 | bytes |
+| 2 | `ring_hop` | hop | bytes |
+| 3 | `tree_aggregate` | fan-in | bytes |
+
+prose after the table
+"""
+
+
+def _flight(h=FR_H_OK, cc=FR_CC_OK, pm=PM_OK, doc=DOC_FLIGHT_OK):
+    return hvd_lint.flight_pass(h, cc, pm,
+                                {"docs/observability.md": doc})
+
+
+def test_flight_clean_fixture():
+    assert _flight() == []
+
+
+def test_flight_parsers():
+    assert hvd_lint.parse_flight_enum(FR_H_OK) == {
+        1: "CtrlSend", 2: "RingHop", 3: "TreeAgg"}
+    assert hvd_lint.parse_flight_legend(FR_CC_OK) == {
+        1: "ctrl_send", 2: "ring_hop", 3: "tree_aggregate"}
+    assert hvd_lint.parse_flight_py(PM_OK) == {
+        1: "ctrl_send", 2: "ring_hop", 3: "tree_aggregate"}
+    assert hvd_lint.parse_flight_doc(DOC_FLIGHT_OK) == {
+        1: "ctrl_send", 2: "ring_hop", 3: "tree_aggregate"}
+    assert hvd_lint.parse_flight_doc("no marker here") is None
+
+
+def test_flight_clean_fixture_tolerates_abbreviated_enum_name():
+    # kFlightTreeAgg vs tree_aggregate passes the loose prefix check; a
+    # genuinely different name does not.
+    cc = FR_CC_OK.replace("tree_aggregate", "barrier_wait")
+    pm = PM_OK.replace("tree_aggregate", "barrier_wait")
+    doc = DOC_FLIGHT_OK.replace("tree_aggregate", "barrier_wait")
+    keys = {f.key for f in _flight(cc=cc, pm=pm, doc=doc)}
+    assert "FLIGHT-NAME:3" in keys
+
+
+def test_flight_new_enum_value_without_legend_row_is_found():
+    h = FR_H_OK.replace("};", "  kFlightShmFence = 4,\n};")
+    keys = {f.key for f in _flight(h=h)}
+    assert "FLIGHT-ENUM-LEGEND" in keys
+
+
+def test_flight_stale_py_mirror_is_found():
+    pm = PM_OK.replace('2: "ring_hop", ', "")
+    keys = {f.key for f in _flight(pm=pm)}
+    assert "FLIGHT-PY-MIRROR" in keys
+
+
+def test_flight_doc_drift_is_found():
+    # Missing row, renamed row, and a row for a type the legend lacks.
+    doc = DOC_FLIGHT_OK.replace("| 2 | `ring_hop` | hop | bytes |\n", "")
+    assert {f.key for f in _flight(doc=doc)} == {"FLIGHT-DOC-MISSING:2"}
+    doc = DOC_FLIGHT_OK.replace("`ring_hop`", "`ring_step`")
+    assert {f.key for f in _flight(doc=doc)} == {"FLIGHT-DOC-RENAMED:2"}
+    doc = DOC_FLIGHT_OK.replace(
+        "\nprose after", "| 9 | `ghost` | 0 | 0 |\n\nprose after")
+    assert {f.key for f in _flight(doc=doc)} == {"FLIGHT-DOC-STALE:9"}
+    keys = {f.key for f in _flight(doc="tableless doc")}
+    assert keys == {"FLIGHT-DOC-NO-TABLE"}
+
+
+def test_flight_unparseable_sources_are_findings_not_crashes():
+    keys = {f.key for f in _flight(h="", cc="", pm="")}
+    assert keys == {"FLIGHT-NO-ENUM", "FLIGHT-NO-LEGEND", "FLIGHT-NO-PY"}
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: a seeded mismatch makes the CLI exit non-zero
 # ---------------------------------------------------------------------------
 

@@ -155,6 +155,96 @@ def test_flight_dump_ingested_as_rank_track(tmp_path):
     assert flight[1]["tid"] == 2
 
 
+def _steptrace_dump(rank, steps, fleet=None):
+    """A step-trace dump as StepTraceDumpToFile writes it (steptrace-v1)."""
+    return {"schema": "steptrace-v1", "rank": rank, "world": 2,
+            "slots": 256, "completed": len(steps),
+            "phases": ["negotiation_wait", "fusion", "ring", "fence",
+                       "idle"],
+            "steps": steps, "fleet": fleet or []}
+
+
+def test_steptrace_dump_as_step_phase_tracks(tmp_path):
+    # Two steps on rank 1: each becomes a "step N" span on the steps track
+    # plus its phase sums laid back-to-back on the "step phases" track.
+    base = 1_000_000
+    steps = [[0, base, base + 800, 300, 100, 400, 0, 0],
+             [1, base + 1000, base + 1500, 100, 0, 300, 50, 50]]
+    p = str(tmp_path / "steptrace.1.json")
+    with open(p, "w") as f:
+        json.dump(_steptrace_dump(1, steps), f)
+    merged = mt.merge([p])
+    threads = {e["tid"]: e["args"]["name"] for e in merged
+               if e.get("name") == "thread_name"}
+    assert threads[mt.STEP_TID] == "steps"
+    assert threads[mt.PHASE_TID] == "step phases"
+    spans = [e for e in merged if e.get("ph") == "X"
+             and e["tid"] == mt.STEP_TID]
+    assert [(e["name"], e["ts"], e["dur"]) for e in spans] == [
+        ("step 0", 0, 800), ("step 1", 1000, 500)]
+    # Phases of step 0 stack from the step's start in declared order;
+    # zero-duration phases (fence, idle) are skipped.
+    ph0 = [e for e in merged if e.get("ph") == "X"
+           and e["tid"] == mt.PHASE_TID and e["args"]["step"] == 0]
+    assert [(e["name"], e["ts"], e["dur"]) for e in ph0] == [
+        ("negotiation_wait", 0, 300), ("fusion", 300, 100),
+        ("ring", 400, 400)]
+    # Everything landed on the dump's rank track.
+    assert all(e["pid"] == 1 for e in spans + ph0)
+
+
+def test_steptrace_fleet_counter_and_dominant_instants(tmp_path):
+    # Coordinator dump: fleet records become a stacked counter plus one
+    # "dominant <phase>" instant per step at the step's end, carrying the
+    # attributed rank.  Fleet rows for steps absent from the ring (already
+    # overwritten) are dropped.
+    base = 5_000_000
+    steps = [[3, base, base + 900, 600, 100, 200, 0, 0]]
+    fleet = [{"step": 3, "phase_us": [600, 100, 200, 0, 0],
+              "lag_us": [0, 450], "reported": 2,
+              "dominant_phase": "negotiation_wait", "dominant_rank": 1},
+             {"step": 99, "phase_us": [1, 0, 0, 0, 0], "lag_us": [0, 0],
+              "reported": 1, "dominant_phase": "ring",
+              "dominant_rank": 0}]
+    p = str(tmp_path / "steptrace.0.json")
+    with open(p, "w") as f:
+        json.dump(_steptrace_dump(0, steps, fleet), f)
+    merged = mt.merge([p])
+    counters = [e for e in merged if e.get("ph") == "C"]
+    assert [e["name"] for e in counters] == ["fleet phase us"]
+    assert counters[0]["ts"] == 900
+    assert counters[0]["args"] == {"negotiation_wait": 600, "fusion": 100,
+                                   "ring": 200, "fence": 0, "idle": 0}
+    doms = [e for e in merged if e.get("ph") == "i"
+            and e["name"].startswith("dominant ")]
+    assert [(e["name"], e["ts"], e["args"]) for e in doms] == [
+        ("dominant negotiation_wait", 900, {"step": 3, "rank": 1})]
+    threads = {e["tid"]: e["args"]["name"] for e in merged
+               if e.get("name") == "thread_name"}
+    assert threads[mt.DOMINANT_TID] == "dominant"
+
+
+def test_steptrace_aligns_with_ordinary_timeline(tmp_path):
+    # A step-trace dump (wall-clock microsecond rows) merged against a
+    # surviving rank's timeline lands on the shared axis via the
+    # synthesized CLOCK_SYNC, just like flight dumps do.
+    base_us = 9_000_000
+    p0 = _write(tmp_path, "t0.json",
+                _trace(0, 0, base_us, [(100, 50)],
+                       include_rendezvous=False))
+    steps = [[0, base_us + 4000, base_us + 4600, 200, 0, 400, 0, 0]]
+    p1 = str(tmp_path / "steptrace.1.json")
+    with open(p1, "w") as f:
+        json.dump(_steptrace_dump(1, steps), f)
+    merged = mt.merge([p0, p1])
+    names = {e["pid"]: e["args"]["name"] for e in merged
+             if e.get("name") == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    step = next(e for e in merged if e.get("name") == "step 0")
+    # Rank 1's step started 4000us after rank 0's t0.
+    assert (step["pid"], step["ts"], step["dur"]) == (1, 4000, 600)
+
+
 def test_flight_dump_unknown_type_and_empty(tmp_path):
     # Unknown event types render as flight:<n> instead of crashing, and an
     # empty dump contributes nothing (no stray CLOCK_SYNC track).

@@ -1,0 +1,86 @@
+"""Prometheus rendering of the metrics dump: naming scheme, histogram
+bucket cumulation, and — the part a fuzzer finds first — label-value
+escaping.  psid comes from user-chosen process-set ids, so a hostile or
+merely creative name (quotes, backslashes, newlines) must produce a
+well-formed exposition, not a scrape-breaking line.
+"""
+
+import re
+
+from horovod_tpu.utils.metrics import _escape_label, render_prometheus
+
+# One exposition line: name{labels} value.  Label values are quoted
+# strings where \\, \" and \n are the only escapes (the text format spec).
+_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*")*\})?'
+    r' -?[0-9.eE+Inf]+$')
+
+
+def _assert_scrapeable(text):
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert _LINE.match(line), f"malformed exposition line: {line!r}"
+
+
+def test_escape_label_reserved_characters():
+    assert _escape_label('a"b') == 'a\\"b'
+    assert _escape_label("a\\b") == "a\\\\b"
+    assert _escape_label("a\nb") == "a\\nb"
+    assert _escape_label("plain") == "plain"
+    assert _escape_label(3) == "3"  # non-strings coerced, not crashed
+
+
+def test_counter_gauge_histogram_shapes():
+    text = render_prometheus({
+        "rank": 2,
+        "counters": {"steps_total": 5, "bytes_reduced": 7},
+        "gauges": {"elastic_generation": 3},
+        "histograms": {"negotiation_us": {
+            "buckets": [1, 2, 0, 4], "sum_us": 99, "count": 7}},
+    })
+    _assert_scrapeable(text)
+    lines = text.splitlines()
+    # _total not doubled, gauges keep the bare name.
+    assert 'hvd_steps_total{rank="2"} 5' in lines
+    assert 'hvd_bytes_reduced_total{rank="2"} 7' in lines
+    assert 'hvd_elastic_generation{rank="2"} 3' in lines
+    # Buckets are cumulative with the last native bucket mapped to +Inf.
+    assert 'hvd_negotiation_us_bucket{rank="2",le="1"} 1' in lines
+    assert 'hvd_negotiation_us_bucket{rank="2",le="2"} 3' in lines
+    assert 'hvd_negotiation_us_bucket{rank="2",le="4"} 3' in lines
+    assert 'hvd_negotiation_us_bucket{rank="2",le="+Inf"} 7' in lines
+    assert 'hvd_negotiation_us_sum{rank="2"} 99' in lines
+    assert 'hvd_negotiation_us_count{rank="2"} 7' in lines
+
+
+def test_hostile_psid_is_escaped_not_scrape_breaking():
+    hostile = 'team"a\\prod\nsecond_line'
+    text = render_prometheus({
+        "rank": 0,
+        "counters": {},
+        "tenants": {hostile: {"responses": 4, "tensors": 8, "bytes": 256,
+                              "negotiation_wait_us": {
+                                  "buckets": [2, 2], "sum_us": 10,
+                                  "count": 4}}},
+    })
+    _assert_scrapeable(text)
+    # The raw reserved characters never appear unescaped inside a line:
+    # no literal newline inside a sample, no bare quote ending the value
+    # early.
+    assert "\nsecond_line" not in text  # newline became the \n escape
+    escaped = 'psid="team\\"a\\\\prod\\nsecond_line"'
+    assert escaped in text
+    for family in ("hvd_tenant_responses_total",
+                   "hvd_tenant_tensors_total",
+                   "hvd_tenant_bytes_total",
+                   "hvd_tenant_negotiation_wait_us_bucket"):
+        assert any(line.startswith(family) and escaped in line
+                   for line in text.splitlines()), family
+
+
+def test_empty_and_disabled_dumps_render_empty():
+    assert render_prometheus({}) == ""
+    assert render_prometheus(None) == ""

@@ -39,6 +39,14 @@ effect — and reports the always-on event black box's
 negotiation-throughput overhead (the bar is <= 1%: a record is a handful
 of relaxed atomic stores into a per-thread ring).
 
+With --step-trace an additional section runs the cache_on configuration
+with HOROVOD_STEP_TRACE=0 vs 1 (plus a third leg stacking
+HOROVOD_METRICS=1 on top, the full CYCLE-trailer marker-2 payload) —
+interleaved, best-of-3 per config like the flight section — and reports
+the causal step tracer's negotiation-throughput overhead.  The bar is
+<= 1% with the cockpit disabled: span capture is relaxed atomic adds at
+already-instrumented sites, and the per-cycle trailer is 6 extra i64s.
+
 With --np-sweep N,N,... the tool instead sweeps job sizes over fake
 multi-host topologies (4 ranks per fake host) and prints the O(n)-vs-
 O(hosts) table behind the v9 leader tree: coordinator inbound control
@@ -337,6 +345,10 @@ def main():
                     help="also measure the metrics registry's negotiation "
                          "overhead: cache_on rerun with HOROVOD_METRICS=1, "
                          "steps/s ratio vs the metrics-off baseline")
+    ap.add_argument("--step-trace", action="store_true",
+                    help="also measure causal step tracing's negotiation "
+                         "overhead (off vs on vs on+metrics, interleaved "
+                         "best-of-3; cockpit stays disabled)")
     ap.add_argument("--flight-recorder", action="store_true",
                     help="also measure the flight recorder's negotiation "
                          "overhead: cache_on with the recorder off vs on, "
@@ -411,6 +423,36 @@ def main():
             "best_of": 3,
             "steps_ratio_on_vs_off": round(ratio, 3),
             "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
+        }), flush=True)
+
+    if args.step_trace:
+        # Same interleaved best-of-3 discipline as the flight section:
+        # the <= 1% bar is far below loopback scheduler noise.  The third
+        # leg stacks metrics on so the full marker-2 CYCLE trailer
+        # (7 metric + 6 step-trace i64s) is priced too.
+        best_off = best_on = best_both = 0.0
+        for i in range(3):
+            trace_off = run_config(
+                f"cache_on_trace_off_r{i}", {"HOROVOD_STEP_TRACE": "0"},
+                args.np, args.steps, args.tensors)
+            trace_on = run_config(
+                f"cache_on_trace_on_r{i}", {"HOROVOD_STEP_TRACE": "1"},
+                args.np, args.steps, args.tensors)
+            trace_both = run_config(
+                f"cache_on_trace_metrics_r{i}",
+                {"HOROVOD_STEP_TRACE": "1", "HOROVOD_METRICS": "1"},
+                args.np, args.steps, args.tensors)
+            best_off = max(best_off, trace_off["steps_per_s"])
+            best_on = max(best_on, trace_on["steps_per_s"])
+            best_both = max(best_both, trace_both["steps_per_s"])
+        ratio = best_on / max(best_off, 1e-9)
+        print(json.dumps({
+            "metric": "step_trace_overhead",
+            "best_of": 3,
+            "steps_ratio_on_vs_off": round(ratio, 3),
+            "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
+            "steps_ratio_with_metrics_vs_off": round(
+                best_both / max(best_off, 1e-9), 3),
         }), flush=True)
 
     if args.wire_compression:

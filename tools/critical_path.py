@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Per-step critical-path attribution from step-trace dumps.
+
+Walks the per-rank step-trace dumps (steptrace.<rank>.json, written on
+shutdown/abort or saved from ``hvd.step_trace()``), optionally together
+with flight-recorder dumps and a merged timeline from
+``tools/merge_timeline.py``, and answers the question a timeline makes you
+eyeball: *which rank, in which phase, set the pace of each step?*
+
+For every step the tool emits one critical-path row ``(rank, phase,
+duration)``:
+
+- the **coordinator's fleet records** are authoritative when present
+  (steptrace.0.json): the coordinator has seen every rank's CYCLE-frame
+  snapshot for the step plus each rank's announce lag, so its
+  ``dominant_rank`` / ``dominant_phase`` attribution already accounts for
+  waiting caused by *other* ranks — a straggler shows up as the dominant
+  rank even though the waiting happens elsewhere.
+- otherwise the row falls back to per-rank dumps: the rank whose step
+  wall-clock extent was longest, and that rank's largest phase (excluding
+  idle).
+
+The summary reports the **bubble fraction**: the share of traced time the
+fleet spent not moving bytes — negotiation-wait + fence + idle over the
+total of all phases.  A healthy ring run keeps this low; a straggler or a
+too-small fusion buffer pushes it up.
+
+A merged timeline produced by merge_timeline.py (step-trace tracks
+included) can stand in for the raw dumps — the "step N" spans and the
+phase spans carry the same numbers, re-keyed by pid/args.step — so a
+single merged artifact from a crash bundle is enough to run attribution.
+Flight-recorder dumps contribute context only: an abort event in one marks
+the run aborted in the summary.
+
+Usage:
+  python tools/critical_path.py steptrace.*.json [flight.*.json] [merged.json]
+  python tools/critical_path.py --json steptrace.0.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Mirrors kStepPhaseNames in cpp/step_trace.cc; used only when a merged
+# timeline (which carries phase names per event) is the sole input and for
+# the bubble split below.
+PHASES = ["negotiation_wait", "fusion", "ring", "fence", "idle"]
+
+# Phases that are "bubble" (the fleet waiting, not moving bytes) vs "busy".
+BUBBLE_PHASES = {"negotiation_wait", "fence", "idle"}
+
+# Flight-recorder event type for abort (kFlightTypesLegend in
+# cpp/flight_recorder.cc); used only to flag aborted runs in the summary.
+FLIGHT_ABORT_TYPE = 11
+
+
+class RankSteps:
+    """Per-rank view: step id -> (start_us, end_us, {phase: us})."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.steps: Dict[int, Tuple[int, int, Dict[str, int]]] = {}
+
+
+def _load(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def classify(doc) -> str:
+    if isinstance(doc, dict) and str(doc.get("schema", "")).startswith(
+            "steptrace"):
+        return "steptrace"
+    if isinstance(doc, dict) and "events" in doc:
+        return "flight"
+    if isinstance(doc, list):
+        return "timeline"
+    return "unknown"
+
+
+def ingest_steptrace(doc: dict, ranks: Dict[int, RankSteps],
+                     fleet: Dict[int, dict]) -> None:
+    rank = doc.get("rank", -1)
+    phases = doc.get("phases") or PHASES
+    rs = ranks.setdefault(rank, RankSteps(rank))
+    for row in doc.get("steps") or []:
+        if not (isinstance(row, list) and len(row) >= 3 + len(phases)):
+            continue
+        sid, start, end = row[0], row[1], row[2]
+        rs.steps[sid] = (start, end,
+                         {phases[i]: row[3 + i] for i in range(len(phases))})
+    for f in doc.get("fleet") or []:
+        if isinstance(f, dict) and isinstance(f.get("step"), int):
+            # Coordinator dumps are authoritative; keep the record with the
+            # most ranks reported if two inputs carry the same step.
+            prev = fleet.get(f["step"])
+            if prev is None or f.get("reported", 0) >= prev.get("reported", 0):
+                fleet[f["step"]] = f
+
+
+def ingest_timeline(events: List[dict], ranks: Dict[int, RankSteps],
+                    fleet: Dict[int, dict]) -> None:
+    """Reconstruct per-rank step data from merge_timeline.py output.
+
+    The merged timeline re-bases timestamps onto one axis, which is exactly
+    what cross-rank attribution wants; pid is the rank.
+    """
+    for e in events:
+        if e.get("ph") != "X" or not isinstance(e.get("args"), dict):
+            continue
+        sid = e["args"].get("step")
+        if not isinstance(sid, int):
+            continue
+        rank = e.get("pid", -1)
+        rs = ranks.setdefault(rank, RankSteps(rank))
+        name = e.get("name", "")
+        ts, dur = e.get("ts", 0), e.get("dur", 0)
+        start, end, phases = rs.steps.get(sid, (ts, ts, {}))
+        if name.startswith("step "):
+            start, end = ts, ts + dur
+        elif name in PHASES:
+            phases = dict(phases)
+            phases[name] = phases.get(name, 0) + dur
+        rs.steps[sid] = (start, end, phases)
+    for e in events:
+        if (e.get("ph") == "i" and str(e.get("name", "")).startswith(
+                "dominant ") and isinstance(e.get("args"), dict)
+                and isinstance(e["args"].get("step"), int)):
+            sid = e["args"]["step"]
+            fleet.setdefault(sid, {
+                "step": sid,
+                "dominant_phase": e["name"][len("dominant "):],
+                "dominant_rank": e["args"].get("rank", -1),
+                "reported": 0,
+            })
+
+
+def flight_aborted(doc: dict) -> bool:
+    return any(isinstance(r, list) and len(r) >= 3
+               and r[2] == FLIGHT_ABORT_TYPE
+               for r in doc.get("events") or [])
+
+
+def critical_rows(ranks: Dict[int, RankSteps],
+                  fleet: Dict[int, dict]) -> List[dict]:
+    """One attribution row per step id seen anywhere."""
+    sids = set(fleet)
+    for rs in ranks.values():
+        sids.update(rs.steps)
+    rows = []
+    for sid in sorted(sids):
+        # Longest wall-clock extent across ranks — the pace-setter's span.
+        wall_rank, wall_us = -1, -1
+        for rs in ranks.values():
+            if sid in rs.steps:
+                start, end, _ = rs.steps[sid]
+                if end - start > wall_us:
+                    wall_rank, wall_us = rs.rank, end - start
+        f = fleet.get(sid)
+        if f is not None and f.get("dominant_rank", -1) is not None:
+            rank = f.get("dominant_rank", -1)
+            phase = f.get("dominant_phase", "?")
+            source = "fleet"
+        else:
+            rank, phase, source = wall_rank, "?", "wall"
+            if rank in ranks and sid in ranks[rank].steps:
+                phases = ranks[rank].steps[sid][2]
+                busy = {p: us for p, us in phases.items() if p != "idle"}
+                if busy and max(busy.values()) > 0:
+                    phase = max(busy, key=busy.get)
+        rows.append({"step": sid, "rank": rank, "phase": phase,
+                     "duration_us": max(wall_us, 0), "source": source})
+    return rows
+
+
+def bubble_summary(ranks: Dict[int, RankSteps]) -> dict:
+    bubble = busy = 0
+    for rs in ranks.values():
+        for _, (_, _, phases) in rs.steps.items():
+            for p, us in phases.items():
+                if p in BUBBLE_PHASES:
+                    bubble += us
+                else:
+                    busy += us
+    total = bubble + busy
+    return {"bubble_us": bubble, "busy_us": busy,
+            "bubble_fraction": (bubble / total) if total else 0.0}
+
+
+def analyze(paths: List[str]) -> dict:
+    ranks: Dict[int, RankSteps] = {}
+    fleet: Dict[int, dict] = {}
+    aborted = False
+    skipped = []
+    for p in paths:
+        try:
+            doc = _load(p)
+        except (OSError, json.JSONDecodeError) as e:
+            skipped.append(f"{p}: {e}")
+            continue
+        kind = classify(doc)
+        if kind == "steptrace":
+            ingest_steptrace(doc, ranks, fleet)
+        elif kind == "timeline":
+            ingest_timeline(doc, ranks, fleet)
+        elif kind == "flight":
+            aborted = aborted or flight_aborted(doc)
+        else:
+            skipped.append(f"{p}: unrecognized format")
+    rows = critical_rows(ranks, fleet)
+    summary = bubble_summary(ranks)
+    summary["steps"] = len(rows)
+    summary["ranks"] = sorted(ranks)
+    summary["aborted"] = aborted
+    # Which (rank, phase) pairs set the pace most often — the headline.
+    tally: Dict[Tuple[int, str], int] = {}
+    for r in rows:
+        key = (r["rank"], r["phase"])
+        tally[key] = tally.get(key, 0) + 1
+    if tally:
+        (rank, phase), n = max(tally.items(), key=lambda kv: kv[1])
+        summary["dominant_rank"] = rank
+        summary["dominant_phase"] = phase
+        summary["dominant_steps"] = n
+    return {"rows": rows, "summary": summary, "skipped": skipped}
+
+
+def render(result: dict, last: int) -> str:
+    rows, summary = result["rows"], result["summary"]
+    lines = []
+    shown = rows[-last:] if last > 0 else rows
+    if len(shown) < len(rows):
+        lines.append(f"(showing last {len(shown)} of {len(rows)} steps)")
+    lines.append(f"{'step':>6}  {'rank':>4}  {'phase':<18}"
+                 f"  {'duration':>10}  src")
+    for r in shown:
+        lines.append(f"{r['step']:>6}  {r['rank']:>4}  {r['phase']:<18}"
+                     f"  {r['duration_us']:>8}us  {r['source']}")
+    lines.append("")
+    frac = summary["bubble_fraction"]
+    lines.append(f"bubble fraction: {frac:.1%}  "
+                 f"(bubble {summary['bubble_us']}us / "
+                 f"busy {summary['busy_us']}us, "
+                 f"{summary['steps']} steps, ranks {summary['ranks']})")
+    if "dominant_rank" in summary:
+        lines.append(f"critical path: rank {summary['dominant_rank']} / "
+                     f"{summary['dominant_phase']} set the pace on "
+                     f"{summary['dominant_steps']}/{summary['steps']} steps")
+    if summary["aborted"]:
+        lines.append("note: a flight-recorder dump records an ABORT — the "
+                     "last steps may be partial")
+    for s in result["skipped"]:
+        lines.append(f"skipped {s}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("inputs", nargs="+",
+                   help="steptrace.*.json / flight.*.json / merged timeline")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full analysis as JSON")
+    p.add_argument("--last", type=int, default=20,
+                   help="show only the last N steps in the table (0 = all)")
+    args = p.parse_args(argv)
+    result = analyze(args.inputs)
+    if args.json:
+        json.dump(result, sys.stdout, indent=2)
+        print()
+    else:
+        print(render(result, args.last))
+    return 0 if result["rows"] or not result["skipped"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """hvd_lint: cross-layer ABI / env / protocol consistency checker.
 
-The framework's correctness hinges on three hand-mirrored seams, each of
+The framework's correctness hinges on four hand-mirrored seams, each of
 which drifts silently (a mismatch corrupts data or loses a knob, it does
 not crash):
 
@@ -13,6 +13,10 @@ not crash):
   protocol  kProtocolVersion / frame tags / wire-codec ids in C++  vs  the
             Python mirrors (runtime.PROTOCOL_VERSION, _core.py codec map,
             env.py codec names) and the docs
+  flight    the flight-recorder event-type table, kept in four places:
+            flight_recorder.h's FlightType enum, flight_recorder.cc's
+            kFlightTypesLegend JSON, tools/postmortem.py's FLIGHT_TYPES
+            fallback, and the marked table in docs/observability.md
 
 Each pass is a pure text analysis (no build, no import of horovod_tpu), so
 this runs in tier-1 CI on a bare checkout.  Output is a human report plus
@@ -52,7 +56,7 @@ OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_flight_record", "hvd_add_process_set2",
                     "hvd_device_plane_note", "hvd_device_plane_stats",
                     "hvd_autotune_qdev", "hvd_migrate_note",
-                    "hvd_elastic_generation_set"}
+                    "hvd_elastic_generation_set", "hvd_step_trace"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
@@ -114,12 +118,16 @@ INTERNAL_VARS = {
     # Assigned per generation by the elastic driver; the coordinator's
     # loopback policy listener binds it.  Operators never set it by hand.
     "HOROVOD_AUTOPILOT_PORT",
+    # Same contract for the live-cockpit endpoint: the driver hands rank 0
+    # one sticky port so SSE clients survive re-formations.  The user-facing
+    # switch is HOROVOD_COCKPIT; the port is driver plumbing.
+    "HOROVOD_COCKPIT_PORT",
 }
 
 
 @dataclasses.dataclass
 class Finding:
-    pass_name: str  # "abi" | "env" | "protocol"
+    pass_name: str  # "abi" | "env" | "protocol" | "flight"
     key: str        # stable id, e.g. "ABI-ARITY:hvd_init"
     message: str
 
@@ -582,6 +590,131 @@ def protocol_pass(sc_text: str, wire_codec_text: str, core_py_text: str,
 
 
 # ---------------------------------------------------------------------------
+# flight-recorder event-type pass
+# ---------------------------------------------------------------------------
+
+# The doc table is located by this marker comment so the parser never
+# confuses it with other numeric markdown tables (wire codecs, phases).
+FLIGHT_DOC_MARKER = "<!-- hvd_lint:flight-types -->"
+
+
+def parse_flight_enum(fr_h_text: str) -> Dict[int, str]:
+    """{id: CamelSuffix} from flight_recorder.h's FlightType enum."""
+    m = re.search(r"enum\s+FlightType[^{]*\{(.*?)\}", fr_h_text, re.S)
+    if not m:
+        return {}
+    return {int(em.group(2)): em.group(1)
+            for em in re.finditer(r"kFlight(\w+)\s*=\s*(\d+)", m.group(1))}
+
+
+def parse_flight_legend(fr_cc_text: str) -> Dict[int, str]:
+    """{id: snake_name} from flight_recorder.cc's kFlightTypesLegend."""
+    m = re.search(r"kFlightTypesLegend\[\]\s*=(.*?);", fr_cc_text, re.S)
+    if not m:
+        return {}
+    return {int(p.group(1)): p.group(2)
+            for p in re.finditer(r'\\"(\d+)\\":\\"(\w+)\\"', m.group(1))}
+
+
+def parse_flight_py(postmortem_text: str) -> Dict[int, str]:
+    """{id: snake_name} from tools/postmortem.py's FLIGHT_TYPES."""
+    m = re.search(r"FLIGHT_TYPES\s*=\s*\{(.*?)\}", postmortem_text, re.S)
+    if not m:
+        return {}
+    return {int(p.group(1)): p.group(2)
+            for p in re.finditer(r'(\d+)\s*:\s*"(\w+)"', m.group(1))}
+
+
+def parse_flight_doc(doc_text: str) -> Optional[Dict[int, str]]:
+    """{id: snake_name} from the marked table; None when no marker."""
+    idx = doc_text.find(FLIGHT_DOC_MARKER)
+    if idx < 0:
+        return None
+    # The table ends at the first blank line after the marker's table rows.
+    rows: Dict[int, str] = {}
+    for line in doc_text[idx:].splitlines()[1:]:
+        if rows and not line.lstrip().startswith("|"):
+            break
+        rm = re.match(r"\s*\|\s*(\d+)\s*\|\s*`(\w+)`\s*\|", line)
+        if rm:
+            rows[int(rm.group(1))] = rm.group(2)
+    return rows
+
+
+def flight_pass(fr_h_text: str, fr_cc_text: str, postmortem_text: str,
+                doc_files: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    enum = parse_flight_enum(fr_h_text)
+    legend = parse_flight_legend(fr_cc_text)
+    py_types = parse_flight_py(postmortem_text)
+    for what, table, key in (("flight_recorder.h FlightType enum", enum,
+                              "FLIGHT-NO-ENUM"),
+                             ("flight_recorder.cc kFlightTypesLegend", legend,
+                              "FLIGHT-NO-LEGEND"),
+                             ("tools/postmortem.py FLIGHT_TYPES", py_types,
+                              "FLIGHT-NO-PY")):
+        if not table:
+            findings.append(Finding(
+                "flight", key, f"could not parse {what}"))
+    if not (enum and legend and py_types):
+        return findings
+
+    if set(enum) != set(legend):
+        findings.append(Finding(
+            "flight", "FLIGHT-ENUM-LEGEND",
+            f"FlightType enum ids {sorted(enum)} != kFlightTypesLegend ids "
+            f"{sorted(legend)}"))
+    else:
+        for tid, camel in sorted(enum.items()):
+            # Loose name check: the legend's snake name sans underscores and
+            # the enum suffix must share a prefix (kFlightTreeAgg is the
+            # abbreviation of tree_aggregate).
+            a, b = camel.lower(), legend[tid].replace("_", "")
+            if not (a.startswith(b) or b.startswith(a)):
+                findings.append(Finding(
+                    "flight", f"FLIGHT-NAME:{tid}",
+                    f"type {tid}: enum kFlight{camel} does not match legend "
+                    f"name {legend[tid]!r}"))
+    if py_types != legend:
+        findings.append(Finding(
+            "flight", "FLIGHT-PY-MIRROR",
+            f"tools/postmortem.py FLIGHT_TYPES {py_types} != "
+            f"kFlightTypesLegend {legend}"))
+
+    doc_rows = None
+    doc_path = None
+    for path, text in sorted(doc_files.items()):
+        rows = parse_flight_doc(text)
+        if rows is not None:
+            doc_rows, doc_path = rows, path
+            break
+    if doc_rows is None:
+        findings.append(Finding(
+            "flight", "FLIGHT-DOC-NO-TABLE",
+            f"no doc carries the {FLIGHT_DOC_MARKER} marked event-type "
+            f"table"))
+    else:
+        for tid in sorted(set(legend) - set(doc_rows)):
+            findings.append(Finding(
+                "flight", f"FLIGHT-DOC-MISSING:{tid}",
+                f"{doc_path}: event type {tid} ({legend[tid]}) missing from "
+                f"the flight-types table"))
+        for tid in sorted(set(doc_rows) - set(legend)):
+            findings.append(Finding(
+                "flight", f"FLIGHT-DOC-STALE:{tid}",
+                f"{doc_path}: flight-types table row {tid} "
+                f"({doc_rows[tid]}) names a type the C legend lacks"))
+        for tid in sorted(set(doc_rows) & set(legend)):
+            if doc_rows[tid] != legend[tid]:
+                findings.append(Finding(
+                    "flight", f"FLIGHT-DOC-RENAMED:{tid}",
+                    f"{doc_path}: table calls type {tid} "
+                    f"{doc_rows[tid]!r} but the legend says "
+                    f"{legend[tid]!r}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -626,6 +759,15 @@ def run_repo(root: str = REPO) -> List[Finding]:
         py_files["horovod_tpu/utils/env.py"],
         doc_files,
         quantize_py_text=py_files.get("horovod_tpu/ops/quantize.py", ""))
+    pm_path = os.path.join(root, "tools", "postmortem.py")
+    pm_text = ""
+    if os.path.exists(pm_path):
+        with open(pm_path, encoding="utf-8", errors="replace") as f:
+            pm_text = f.read()
+    findings += flight_pass(
+        cc_files["horovod_tpu/cpp/flight_recorder.h"],
+        cc_files["horovod_tpu/cpp/flight_recorder.cc"],
+        pm_text, doc_files)
     return findings
 
 
@@ -648,7 +790,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline_keys = set(json.load(f).get("findings", []))
     new = [f for f in findings if f.key not in baseline_keys]
 
-    for pass_name in ("abi", "env", "protocol"):
+    for pass_name in ("abi", "env", "protocol", "flight"):
         hits = [f for f in findings if f.pass_name == pass_name]
         print(f"[{pass_name}] {len(hits)} finding(s)")
         for f in hits:

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""hvd_top — live terminal cockpit for a running horovod_tpu job.
+
+Polls the coordinator's loopback cockpit endpoint (HOROVOD_COCKPIT=1, rank
+0) and redraws a one-screen dashboard:
+
+- step time sparkline over the last-N completed steps (sum of the fleet's
+  phase microseconds per step),
+- a stacked phase bar showing where the fleet's time went
+  (negotiation-wait / fusion / ring / fence / idle) with the dominant phase
+  called out,
+- per-rank skew: each rank's announce lag on the latest step, so the
+  straggler is visible at a glance,
+- the per-tenant (process-set) QoS table and migration counters.
+
+Two tail modes ride the same endpoint: ``--events`` follows the /events
+SSE stream and prints one line per step / runtime instant (reconnecting
+across elastic re-formations — the driver keeps the port stable), and
+``--once``/``--json`` print a single snapshot for scripts and tests.
+
+The endpoint is loopback-only; run hvd_top on the coordinator host (or
+through an ssh tunnel: ``ssh -L 8787:127.0.0.1:<port> coord-host``).
+
+Usage:
+  python tools/hvd_top.py --port 8787
+  python tools/hvd_top.py --port 8787 --events
+  python tools/hvd_top.py --port 8787 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+SPARK = "▁▂▃▄▅▆▇█"
+# One glyph + ANSI color per phase, in the wire order of
+# cpp/step_trace.cc's kStepPhaseNames.
+PHASE_GLYPHS = {
+    "negotiation_wait": ("N", "\x1b[33m"),   # yellow — waiting on peers
+    "fusion": ("F", "\x1b[35m"),             # magenta — packing buffers
+    "ring": ("R", "\x1b[32m"),               # green — bytes moving
+    "fence": ("B", "\x1b[36m"),              # cyan — shm barrier
+    "idle": ("I", "\x1b[90m"),               # grey — nothing enqueued
+}
+RESET = "\x1b[0m"
+
+
+def fetch_json(host: str, port: int, path: str, timeout: float = 3.0):
+    url = f"http://{host}:{port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def sparkline(values: List[float]) -> str:
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK[int((v - lo) / span * (len(SPARK) - 1))]
+                   for v in values)
+
+
+def stacked_bar(totals: Dict[str, int], width: int,
+                color: bool) -> str:
+    """One horizontal bar, each phase's share in its glyph (and color)."""
+    total = sum(totals.values())
+    if total <= 0:
+        return "-" * width
+    out = []
+    used = 0
+    items = [(p, us) for p, us in totals.items() if us > 0]
+    for i, (phase, us) in enumerate(items):
+        n = (width - used if i == len(items) - 1
+             else max(1, round(us / total * width)))
+        n = min(n, width - used)
+        glyph, tint = PHASE_GLYPHS.get(phase, ("?", ""))
+        out.append((tint + glyph * n + RESET) if color else glyph * n)
+        used += n
+        if used >= width:
+            break
+    return "".join(out)
+
+
+def skew_lines(lag_us: List[int], width: int = 30) -> List[str]:
+    """One bar per rank, scaled to the worst lag on the latest step."""
+    if not lag_us:
+        return []
+    worst = max(lag_us) or 1
+    lines = []
+    for r, lag in enumerate(lag_us):
+        n = int(lag / worst * width)
+        mark = " <- straggler" if lag == worst and worst > 0 and \
+            len(lag_us) > 1 else ""
+        lines.append(f"  rank {r:>3} {'#' * n:<{width}} {lag:>9}us{mark}")
+    return lines
+
+
+def render(state: dict, width: int = 78, color: bool = False,
+           last: int = 40) -> List[str]:
+    """Pure renderer: /state snapshot -> list of screen lines.
+
+    Kept free of I/O so tests can drive it with a stub state dict.
+    """
+    lines = []
+    steps = state.get("steps") or []
+    phases = state.get("phases") or list(PHASE_GLYPHS)
+    lines.append(
+        f"hvd_top — world {state.get('world', '?')}  "
+        f"generation {state.get('elastic_generation', 0)}  "
+        f"steps seen {len(steps)}")
+    lines.append("")
+    shown = steps[-last:]
+    if shown:
+        times = [sum(s.get("phase_us") or []) for s in shown]
+        lines.append(f"step time ({shown[0].get('step')}"
+                     f"..{shown[-1].get('step')}):  "
+                     f"last {times[-1]}us  max {max(times)}us")
+        lines.append("  " + sparkline(times))
+        totals: Dict[str, int] = {p: 0 for p in phases}
+        for s in shown:
+            for i, us in enumerate(s.get("phase_us") or []):
+                if i < len(phases):
+                    totals[phases[i]] += us
+        lines.append("")
+        lines.append("phase breakdown "
+                     "(N=negotiation-wait F=fusion R=ring B=fence I=idle):")
+        lines.append("  " + stacked_bar(totals, min(width - 4, 60), color))
+        latest = shown[-1]
+        lines.append(
+            f"  dominant: {latest.get('dominant_phase', '?')}"
+            f" on rank {latest.get('dominant_rank', -1)}"
+            f"  (step {latest.get('step')},"
+            f" {latest.get('reported', 0)} ranks reported)")
+        lines.append("")
+        lines.append("per-rank announce lag (latest step):")
+        lines.extend(skew_lines(latest.get("lag_us") or []))
+    else:
+        lines.append("no completed steps yet "
+                     "(is HOROVOD_STEP_TRACE on and the job stepping?)")
+    tenants = state.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append(f"{'tenant':>8}  {'responses':>10}  {'tensors':>9}  "
+                     f"{'bytes':>12}")
+        for psid in sorted(tenants, key=str):
+            t = tenants[psid] or {}
+            lines.append(f"{psid:>8}  {t.get('responses', 0):>10}  "
+                         f"{t.get('tensors', 0):>9}  "
+                         f"{t.get('bytes', 0):>12}")
+    mig = state.get("migration") or {}
+    if any(mig.values()):
+        lines.append("")
+        lines.append("migration: "
+                     f"{mig.get('migrate_events_total', 0)} events, "
+                     f"{mig.get('migrate_bytes_total', 0)} bytes, "
+                     f"{mig.get('migrate_fallbacks_total', 0)} fallbacks")
+    sr = state.get("straggler_report")
+    if sr:
+        lines.append("")
+        lines.append(f"straggler report: {sr}")
+    if "error" in state:
+        lines.append(f"state error: {state['error']}")
+    return lines
+
+
+def follow_events(host: str, port: int) -> int:
+    """Tail the /events SSE stream; reconnect across re-formations."""
+    url = f"http://{host}:{port}/events"
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=None) as resp:
+                for raw in resp:
+                    line = raw.decode(errors="replace").rstrip("\n")
+                    if line.startswith("data: "):
+                        print(line[len("data: "):], flush=True)
+                    elif line.startswith(":") and "open" in line:
+                        print(f"# connected to {url}", file=sys.stderr)
+        except KeyboardInterrupt:
+            return 0
+        except OSError as exc:
+            # Re-formation in flight: the driver re-binds the SAME port for
+            # the next generation's rank 0, so just retry.
+            print(f"# stream dropped ({exc}); reconnecting", file=sys.stderr)
+            time.sleep(1.0)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("HOROVOD_COCKPIT_PORT", 0)))
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--last", type=int, default=40,
+                   help="steps in the sparkline window")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /state JSON and exit")
+    p.add_argument("--events", action="store_true",
+                   help="follow the /events SSE stream instead")
+    p.add_argument("--no-color", action="store_true")
+    args = p.parse_args(argv)
+    if not args.port:
+        p.error("--port required (or set HOROVOD_COCKPIT_PORT)")
+    if args.events:
+        return follow_events(args.host, args.port)
+    color = sys.stdout.isatty() and not args.no_color
+    try:
+        while True:
+            state = fetch_json(args.host, args.port, "/state")
+            if args.json:
+                json.dump(state, sys.stdout, indent=2)
+                print()
+                return 0
+            lines = render(state, color=color, last=args.last)
+            if not args.once:
+                sys.stdout.write("\x1b[H\x1b[2J")  # home + clear
+            print("\n".join(lines), flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    except OSError as exc:
+        print(f"hvd_top: cannot reach http://{args.host}:{args.port} "
+              f"({exc}) — is the job running with HOROVOD_COCKPIT=1?",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,8 +28,15 @@ This tool puts all ranks on one time axis and one trace:
   crash bundle merges onto the same axis as surviving ranks' timelines.
 - the ABORT instant (emitted with culprit metadata in args) is promoted to
   a global-scope instant so Perfetto draws it across every track.
+- step-trace dumps: an input that is a step-trace JSON object
+  (steptrace.<rank>.json, or hvd.step_trace() saved to disk) becomes a
+  per-rank "step phases" track — one complete event per step plus the
+  phase breakdown laid out in phase order inside it — and, for the
+  coordinator's dump, stacked "fleet phase us" counter tracks with a
+  "dominant <phase>" instant per step carrying the attributed rank.
 
-Usage:  python tools/merge_timeline.py rank*.json flight.*.json -o merged.json
+Usage:  python tools/merge_timeline.py rank*.json flight.*.json \
+            steptrace.*.json -o merged.json
 """
 
 from __future__ import annotations
@@ -64,11 +71,79 @@ def flight_to_events(dump: dict) -> List[dict]:
     return out
 
 
+# Synthetic thread ids for step-trace tracks, far above any real OS tid the
+# timeline writer records, so the tracks never collide with genuine threads
+# when a rank's timeline and its step-trace dump are merged together.
+STEP_TID = 900_000
+PHASE_TID = 900_001
+DOMINANT_TID = 900_002
+
+
+def steptrace_to_events(dump: dict) -> List[dict]:
+    """Convert a step-trace dump into a per-rank "step phases" track.
+
+    Step rows are [step, start_us, end_us, <phase us...>] with wall-clock
+    microsecond bounds; phases have only per-step sums (no individual
+    timestamps), so they are laid out back-to-back from the step's start in
+    the dump's declared phase order — the stack shows *proportion*, the
+    enclosing "step N" span shows true wall-clock extent.  Fleet records
+    (coordinator dump only) become a stacked counter track plus one
+    "dominant <phase>" instant per step with the attributed rank in args.
+    """
+    rank = dump.get("rank", -1)
+    phases = dump.get("phases") or []
+    rows = [r for r in dump.get("steps") or []
+            if isinstance(r, list) and len(r) >= 3 + len(phases)]
+    if not rows:
+        return []
+    rows.sort(key=lambda r: r[1])
+    t0 = rows[0][1]
+    out = [{"name": "CLOCK_SYNC", "ph": "i", "ts": 0, "pid": 0, "tid": 0,
+            "s": "p", "args": {"rank": rank, "unix_us": t0,
+                               "steptrace": True}},
+           {"name": "thread_name", "ph": "M", "pid": 0, "tid": STEP_TID,
+            "args": {"name": "steps"}},
+           {"name": "thread_name", "ph": "M", "pid": 0, "tid": PHASE_TID,
+            "args": {"name": "step phases"}}]
+    end_by_step = {}
+    for row in rows:
+        sid, start, end = row[0], row[1], row[2]
+        end_by_step[sid] = end
+        out.append({"name": f"step {sid}", "ph": "X", "ts": start - t0,
+                    "dur": max(end - start, 1), "pid": 0, "tid": STEP_TID,
+                    "args": {"step": sid}})
+        cursor = start
+        for i, pname in enumerate(phases):
+            us = row[3 + i]
+            if us > 0:
+                out.append({"name": pname, "ph": "X", "ts": cursor - t0,
+                            "dur": us, "pid": 0, "tid": PHASE_TID,
+                            "args": {"step": sid}})
+                cursor += us
+    fleet = [f for f in dump.get("fleet") or []
+             if isinstance(f, dict) and f.get("step") in end_by_step]
+    if fleet:
+        out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": DOMINANT_TID, "args": {"name": "dominant"}})
+    for f in fleet:
+        ts = end_by_step[f["step"]] - t0
+        counts = {phases[i]: v for i, v in enumerate(f.get("phase_us") or [])
+                  if i < len(phases)}
+        out.append({"name": "fleet phase us", "ph": "C", "ts": ts,
+                    "pid": 0, "tid": 0, "args": counts})
+        out.append({"name": f"dominant {f.get('dominant_phase', '?')}",
+                    "ph": "i", "ts": ts, "pid": 0, "tid": DOMINANT_TID,
+                    "s": "t", "args": {"step": f["step"],
+                                       "rank": f.get("dominant_rank", -1)}})
+    return out
+
+
 def load_trace(path: str) -> List[dict]:
     """Load one per-rank trace, repairing a truncated (crashed-rank) file.
 
     A flight-recorder dump (JSON object with an "events" array of compact
-    rows) is accepted too and converted into instants on its rank's track.
+    rows) or a step-trace dump (schema "steptrace-v1") is accepted too and
+    converted into events on its rank's track.
     """
     with open(path) as f:
         text = f.read()
@@ -82,6 +157,9 @@ def load_trace(path: str) -> List[dict]:
             body = body[1:]
         cut = body.rfind("}")
         events = json.loads("[" + body[: cut + 1] + "]") if cut >= 0 else []
+    if isinstance(events, dict) and str(
+            events.get("schema", "")).startswith("steptrace"):
+        return steptrace_to_events(events)
     if isinstance(events, dict) and "events" in events:
         return flight_to_events(events)
     if not isinstance(events, list):

@@ -80,11 +80,29 @@ def find_bundle(path: str) -> Dict[str, object]:
 _MIGRATE_PHASES = {1: "replicate", 2: "manifest", 3: "transfer",
                    4: "reassemble", 5: "fallback"}
 
+# The flight-recorder event-type table: the Python-side mirror of
+# cpp/flight_recorder.h FlightType and flight_recorder.cc
+# kFlightTypesLegend.  Dumps carry their own legend (the "types" object),
+# which wins when present — this table is the fallback for digests and
+# hand-built bundles that lost it.  tools/hvd_lint.py checks all four
+# copies (enum, C legend, this table, the docs/observability.md table)
+# stay identical, so add new types in all four places.
+FLIGHT_TYPES = {
+    1: "ctrl_send", 2: "ctrl_recv", 3: "rendezvous", 4: "verdict",
+    5: "ring_hop", 6: "wire_codec", 7: "shm_fence", 8: "shm_map",
+    9: "tree_aggregate", 10: "fault_trip", 11: "abort", 12: "digest",
+    13: "autopilot", 14: "migrate",
+}
+
+
+def _type_name(typ: int, types: Dict[str, str]) -> str:
+    return types.get(str(typ)) or FLIGHT_TYPES.get(typ) or f"type{typ}"
+
 
 def _fmt_event(row: List[int], types: Dict[str, str],
                abort_us: Optional[int]) -> str:
     ts_us, seq, typ, tid, a, b = row[:6]
-    name = types.get(str(typ), f"type{typ}")
+    name = _type_name(typ, types)
     rel = "" if abort_us is None else f"{(ts_us - abort_us) / 1e3:+10.1f}ms "
     if name == "migrate":
         # a = phase<<8 | source_rank+1 (0 = no source); b = payload bytes.
@@ -197,7 +215,7 @@ def report(bundle: Dict[str, object], n_events: int,
     merged.sort(key=lambda t: (t[0], t[2][1]))
     abort_us = None
     for ts_us, _, row in merged:
-        if types.get(str(row[2])) == "abort":
+        if _type_name(row[2], types) == "abort":
             abort_us = ts_us
             break
     tail = merged[-n_events:]
@@ -250,7 +268,8 @@ def write_trace(bundle: Dict[str, object], out_path: str) -> None:
             if int(rank_str) in flights:
                 continue  # the full dump supersedes the digest
             dump = {"rank": int(rank_str), "host": rec.get("host", ""),
-                    "types": pm.get("types") or {},
+                    "types": pm.get("types")
+                    or {str(k): v for k, v in FLIGHT_TYPES.items()},
                     "events": rec.get("events") or []}
             p = os.path.join(tmpdir, f"digest.{rank_str}.json")
             with open(p, "w") as f:

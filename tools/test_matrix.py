@@ -49,6 +49,12 @@ combination of:
            the committed snapshot bit-exact on the ring successors' shard
            stores (docs/elastic.md "Zero-downtime migration"); one
            on-combo in the quick set
+- trace:   def (ambient default: tracing on) / on / off
+           (HOROVOD_STEP_TRACE) — "on" combos assert the causal step ring
+           recorded the workload (completed steps with wall-clock bounds
+           and a non-zero 5-phase breakdown; fleet attribution on the
+           coordinator at np>1), "off" combos that hvd.step_trace()
+           reports {}; one on-combo in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -63,7 +69,10 @@ recovery, blacklist-expiry re-admission — zero human input), the np=4
 zero-downtime migration chaos pytest (`migration-np4`: rank death ->
 re-form np=3 resuming bit-identically from peer shards with zero
 checkpoint reads -> blacklist-expiry re-grow to np=4, plus the degraded
-checkpoint-fallback path), the np=256 control-plane soak (`ctrl-soak`:
+checkpoint-fallback path), the np=4 live-cockpit attribution pytest
+(`cockpit-np4`: injected coordinator-recv delay -> the live /state
+snapshot AND tools/critical_path.py both name the delayed rank /
+negotiation-wait), the np=256 control-plane soak (`ctrl-soak`:
 flat vs tree coordinator message counts, plus a migration-noting row),
 and the np=8 tree-vs-flat parity pytest (`ctrl-np8`).
 
@@ -244,6 +253,23 @@ WORKLOAD = textwrap.dedent("""
         np.testing.assert_array_equal(
             attrs["w"], np.full(4, float(pred), np.float32))
 
+    # trace axis: the causal step ring must carry the work done above —
+    # completed steps with wall-clock bounds and the 5-phase breakdown,
+    # plus the coordinator's fleet attribution at np>1.
+    tr = os.environ.get("HOROVOD_STEP_TRACE", "")
+    if tr == "1":
+        t = hvd.step_trace()
+        assert t.get("completed", 0) > 0, t
+        assert t["phases"] == ["negotiation_wait", "fusion", "ring",
+                               "fence", "idle"], t["phases"]
+        assert t["steps"] and all(len(row) == 8 and row[2] >= row[1] > 0
+                                  for row in t["steps"]), t["steps"][:3]
+        assert any(sum(row[3:]) > 0 for row in t["steps"]), t["steps"][:3]
+        if r == 0 and s > 1:
+            assert t["fleet"], "coordinator recorded no fleet attribution"
+    elif tr == "0":
+        assert hvd.step_trace() == {}, "tracing off but ring non-empty"
+
     # metrics axis: the registry must have seen the work done above.
     if os.environ.get("HOROVOD_METRICS") == "1":
         m = hvd.metrics()
@@ -359,6 +385,10 @@ def combos(quick: bool):
         # rides a committed elastic state over the shm data plane.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
                "def", "off", "off", "on")
+        # trace axis: the one quick on-combo — the step ring populated
+        # with fleet attribution on the coordinator.
+        yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
+               "def", "off", "off", "off", "on")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -434,6 +464,18 @@ def combos(quick: bool):
            "def", "off", "off", "on")
     yield ("jax", "native", 3, "on", "on", "hier", "none", "on", "auto",
            "def", "off", "off", "on")
+    # Trace axis: explicit on across controller shapes — local np=1, the
+    # socket controller, and the v9 tree over fake hosts — plus a
+    # metrics-on row (the CYCLE trailer carries both the metrics and the
+    # step-trace sections, marker 2) and explicit off (step_trace == {}).
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "off", "off", "on")
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "on", "auto",
+           "def", "off", "off", "off", "on")
+    yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "on",
+           "def", "off", "off", "off", "on")
+    yield ("jax", "native", 3, "off", "off", "tcp", "none", "off", "auto",
+           "def", "off", "off", "off", "off")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -507,6 +549,14 @@ def checks(quick: bool):
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_migration.py")]],
            REPO, 600.0)
+    # Live cockpit + critical path at np=4: an injected coordinator-recv
+    # delay against rank 3 must be attributed to rank 3 / negotiation_wait
+    # by BOTH surfaces — the live /state snapshot queried mid-run and
+    # tools/critical_path.py over the shutdown step-trace dumps.
+    yield ("cockpit-np4",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_step_trace.py")]],
+           REPO, 600.0)
     # np=256 in-process control-plane soak: flat vs v9 tree coordinator
     # message counts (>= 8x cut at 256 ranks / 16 fake hosts) plus the
     # sharded rendezvous acceptors under the full HELLO herd.
@@ -538,8 +588,8 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
-              autopilot: str, qdev: str, migrate: str, script: str,
-              timeout: float) -> tuple:
+              autopilot: str, qdev: str, migrate: str, trace: str,
+              script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -574,6 +624,12 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # would make every combo pay the replication alltoall per commit.
     env.pop("HOROVOD_MIGRATE_REPLICAS", None)
     env.pop("HOROVOD_MIGRATE_INTERVAL_STEPS", None)
+    # The trace axis owns the step-trace knobs, and the cockpit binds a
+    # listener — an ambient HOROVOD_COCKPIT would open a port per combo.
+    env.pop("HOROVOD_STEP_TRACE", None)
+    env.pop("HOROVOD_STEP_TRACE_SLOTS", None)
+    env.pop("HOROVOD_COCKPIT", None)
+    env.pop("HOROVOD_COCKPIT_PORT", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -623,6 +679,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HVD_MATRIX_MIGRATE"] = "on"
         env["HOROVOD_MIGRATE_REPLICAS"] = "2"
         env["HOROVOD_MIGRATE_INTERVAL_STEPS"] = "1"
+    if trace == "on":
+        env["HOROVOD_STEP_TRACE"] = "1"
+    elif trace == "off":
+        env["HOROVOD_STEP_TRACE"] = "0"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -674,16 +734,18 @@ def main() -> int:
                 combo = combo + ("off",)
             if len(combo) == 12:  # rows predating the migrate axis
                 combo = combo + ("off",)
+            if len(combo) == 13:  # rows predating the trace axis
+                combo = combo + ("def",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree, flight, autopilot, qdev, migrate) = combo
+             tree, flight, autopilot, qdev, migrate, trace) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
                      f"flight={flight:<4} ap={autopilot} qdev={qdev} "
-                     f"mig={migrate}")
+                     f"mig={migrate} trace={trace}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
-                                       autopilot, qdev, migrate,
+                                       autopilot, qdev, migrate, trace,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

@@ -14,7 +14,7 @@ from .basics import (  # noqa: F401
     rank, size, local_rank, local_size, cross_rank, cross_size,
     is_homogeneous, num_devices,
     start_timeline, stop_timeline, start_device_trace, stop_device_trace,
-    metrics, metrics_prometheus, flight_record, step_trace,
+    metrics, metrics_prometheus, flight_record, step_trace, fleet_history,
     mpi_threads_supported, mpi_enabled, mpi_built,
     gloo_enabled, gloo_built, nccl_built, ddl_built, ccl_built,
     cuda_built, rocm_built, tpu_built, native_core_built,

@@ -167,6 +167,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     except AttributeError:
         pass
     try:
+        # Old-ABI tolerance: a stale .so predating the fleet-telemetry
+        # plane degrades fleet_history() to {} instead of raising.
+        lib.hvd_fleet_history.restype = c.c_int
+        lib.hvd_fleet_history.argtypes = [c.c_char_p, c.c_int]
+    except AttributeError:
+        pass
+    try:
         # Old-ABI tolerance: a stale .so predating the fault-injection
         # plane simply loses `horovodrun --fault-inject` pre-validation.
         lib.hvd_fault_spec_check.restype = c.c_char_p
@@ -648,6 +655,34 @@ class NativeCore(CoreBackend):
             cap *= 4
             buf = ctypes.create_string_buffer(cap)
             n = self._lib.hvd_step_trace(buf, cap)
+        if n <= 0:
+            return {}
+        return json.loads(buf.raw[:n].decode())
+
+    _warned_no_fleet = False
+
+    def fleet_history(self) -> dict:
+        """The coordinator's multi-resolution fleet history + anomaly log
+        (fleethistory-v1): {"schema", "columns", "tiers", "anomalies"}
+        where tiers are {"period_s", "samples"} rings of
+        [ts_us, step_p99_us, neg_p99_us, goodput_ppm, wire_ratio_ppm,
+        steps] rows and anomalies is the sentinel's log, newest last.
+        {} when the plane is off (HOROVOD_FLEET_TELEMETRY=off), on
+        non-coordinator ranks before any tick, or on a .so predating it."""
+        if not hasattr(self._lib, "hvd_fleet_history"):
+            if not NativeCore._warned_no_fleet:
+                NativeCore._warned_no_fleet = True
+                log.warning("native core predates the fleet-telemetry plane "
+                            "(hvd_fleet_history missing); fleet_history() "
+                            "returns {}")
+            return {}
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.hvd_fleet_history(buf, cap)
+        while n == -2:  # buffer too small: grow and retry
+            cap *= 4
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.hvd_fleet_history(buf, cap)
         if n <= 0:
             return {}
         return json.loads(buf.raw[:n].decode())

@@ -237,6 +237,21 @@ def step_trace() -> dict:
     return HorovodContext.instance().core.step_trace()
 
 
+def fleet_history() -> dict:
+    """The coordinator's multi-resolution fleet history + anomaly log —
+    the sixth observability pillar (fleet telemetry, protocol v11).
+    Keys: ``schema`` (``fleethistory-v1``), ``columns`` (the sample row
+    legend: ``[ts_us, step_p99_us, neg_p99_us, goodput_ppm,
+    wire_ratio_ppm, steps]``), ``tiers`` (1 s / 10 s / 60 s downsampled
+    rings, each ``{"period_s", "samples"}``) and ``anomalies`` (the
+    streaming sentinel's log, newest last, each naming the series, the
+    dominant rank and the z-score).  Meaningful on rank 0 (the only rank
+    that ticks); empty when HOROVOD_FLEET_TELEMETRY=off or the backend
+    has no native plane.  Fleet HISTOGRAMS (true cross-rank merges) live
+    in ``metrics()["fleet"]``; this call serves their time axis."""
+    return HorovodContext.instance().core.fleet_history()
+
+
 # -- timeline ---------------------------------------------------------------
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

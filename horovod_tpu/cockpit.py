@@ -4,7 +4,7 @@ causal step attribution (docs/observability.md, fifth pillar).
 Rank 0 only, 127.0.0.1 only, off by default (HOROVOD_COCKPIT=1 enables) —
 the same trust boundary as the autopilot policy channel: anything that can
 reach the loopback interface of the coordinator host is already inside the
-job's security perimeter.  Three routes:
+job's security perimeter.  Four routes:
 
   /metrics   Prometheus text exposition (the ``hvd_*`` families
              ``hvd.metrics_prometheus()`` renders), scrape-ready.
@@ -12,6 +12,10 @@ job's security perimeter.  Three routes:
              accounting, straggler windows, migration counters, and the
              last-N per-step phase breakdowns with dominant-phase /
              dominant-rank attribution.
+  /history   The fleet-telemetry plane's long-horizon view
+             (fleethistory-v1): 1 s / 10 s / 60 s downsampled sample
+             rings plus the anomaly sentinel's log — what
+             ``hvd_top.py`` renders as sparklines.
   /events    Server-sent events: one ``data:`` line per completed step
              (summaries diffed from the fleet view) plus any instants
              published by the runtime (autopilot decisions, migrations,
@@ -55,9 +59,11 @@ class CockpitServer:
     def __init__(self, metrics_fn: Callable[[], str],
                  state_fn: Callable[[], dict],
                  port: int = 0, host: str = "127.0.0.1",
-                 poll_interval_s: float = 0.25):
+                 poll_interval_s: float = 0.25,
+                 history_fn: Optional[Callable[[], dict]] = None):
         self._metrics_fn = metrics_fn
         self._state_fn = state_fn
+        self._history_fn = history_fn
         self._host = host
         self._port = port
         self._poll_interval_s = poll_interval_s
@@ -86,6 +92,10 @@ class CockpitServer:
                 elif path == "/state":
                     server._respond_text(
                         self, json.dumps(server._safe_state()),
+                        "application/json")
+                elif path == "/history":
+                    server._respond_text(
+                        self, json.dumps(server._safe_history()),
                         "application/json")
                 elif path == "/events":
                     server._serve_sse(self)
@@ -157,6 +167,16 @@ class CockpitServer:
     def _safe_state(self) -> dict:
         try:
             return self._state_fn()
+        except Exception as exc:  # noqa: BLE001
+            return {"error": str(exc)}
+
+    def _safe_history(self) -> dict:
+        # No history_fn (stub coordinators, plane disabled) serves {} —
+        # hvd_top.py renders the dimmed panel, never an error page.
+        if self._history_fn is None:
+            return {}
+        try:
+            return self._history_fn() or {}
         except Exception as exc:  # noqa: BLE001
             return {"error": str(exc)}
 
@@ -270,8 +290,12 @@ def maybe_start_cockpit(ctx) -> Optional[CockpitServer]:
         from .utils.metrics import render_prometheus
         return render_prometheus(ctx.core.metrics() or {})
 
+    def history() -> dict:
+        return ctx.core.fleet_history() or {}
+
     server = CockpitServer(metrics_text, build_state_fn(ctx),
-                           port=getattr(cfg, "cockpit_port", 0) or 0)
+                           port=getattr(cfg, "cockpit_port", 0) or 0,
+                           history_fn=history)
     try:
         server.start()
     except OSError as exc:

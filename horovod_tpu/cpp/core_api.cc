@@ -26,6 +26,7 @@
 #include "common.h"
 #include "controller.h"
 #include "fault_injection.h"
+#include "fleet_telemetry.h"
 #include "flight_recorder.h"
 #include "logging.h"
 #include "metrics.h"
@@ -534,6 +535,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // steptrace.<rank>.json dump) so the first negotiated step is attributed.
   InitStepTrace(cfg.step_trace, cfg.step_trace_slots,
                 postmortem_dir ? postmortem_dir : "", cfg.rank, cfg.size);
+  // Fleet telemetry (v11) arms with them: HOROVOD_FLEET_TELEMETRY gates
+  // the sketch sections, history ring, goodput gauge and the sentinel;
+  // elastic re-init re-arms with fresh history/sentinel state.
+  InitFleetTelemetry();
 
   if (cfg.size > 1 || cfg.controller == "socket") {
     g->controller = std::make_unique<SocketController>(cfg);
@@ -978,6 +983,20 @@ int hvd_step_trace(char* buf, int cap) {
   if (g == nullptr) return -1;
   if (!StepTraceOn()) return 0;
   std::string json = StepTraceDumpJson();
+  if (static_cast<int>(json.size()) + 1 > cap) return -2;
+  std::memcpy(buf, json.data(), json.size());
+  buf[json.size()] = '\0';
+  return static_cast<int>(json.size());
+}
+
+// The coordinator's multi-resolution fleet history + anomaly log
+// (fleethistory-v1; fleet_telemetry.h).  Same contract as hvd_step_trace:
+// -1 not initialized, 0 plane off, -2 buffer too small (caller doubles
+// and retries), else JSON length.
+int hvd_fleet_history(char* buf, int cap) {
+  if (g == nullptr) return -1;
+  if (!FleetTelemetryOn()) return 0;
+  std::string json = FleetHistoryJson();
   if (static_cast<int>(json.size()) + 1 > cap) return -2;
   std::memcpy(buf, json.data(), json.size());
   buf[json.size()] = '\0';

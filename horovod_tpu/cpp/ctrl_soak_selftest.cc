@@ -27,6 +27,7 @@
 #include <thread>
 #include <vector>
 
+#include "fleet_telemetry.h"
 #include "metrics.h"
 #include "socket_controller.h"
 
@@ -140,8 +141,10 @@ void SoakRank(const char* phase_name, int rank, int size, int port,
 // Runs one negotiation phase at `size` ranks and returns the coordinator's
 // inbound control messages per cycle (measured between two full-quiescence
 // barriers, so rendezvous and farewell traffic never pollute the number).
+// `fleet_sources`, when non-null, receives the coordinator's stored
+// fleet-sketch source count at the same quiescent point.
 int64_t RunPhase(const char* name, const char* tree_mode, int size,
-                 int cycles) {
+                 int cycles, int* fleet_sources = nullptr) {
   ::setenv("HOROVOD_CONTROL_TREE", tree_mode, 1);
   const int port = FreePort();
   if (port < 0) {
@@ -164,6 +167,9 @@ int64_t RunPhase(const char* name, const char* tree_mode, int size,
   ph.done.Wait();
   int64_t ms1 = 0, mr1 = 0, bs1 = 0, br1 = 0;
   if (ctls[0]) ctls[0]->CtrlPlaneStats(&ms1, &mr1, &bs1, &br1);
+  if (fleet_sources != nullptr && ctls[0]) {
+    *fleet_sources = ctls[0]->FleetSourceCountForTest();
+  }
   ph.exit_.Wait();
   for (auto& t : threads) t.join();
   for (int r = 0; r < size; ++r) {
@@ -259,6 +265,45 @@ int main() {
            "replication noting perturbed the control plane: " +
                std::to_string(tree_mig) + " msgs/cycle, expected " +
                std::to_string(tree_expect));
+    }
+  }
+
+  // Fleet-telemetry row (protocol v11): the same tree geometry with the
+  // metrics registry + sketch sections live on all 256 in-process ranks.
+  // Asserts the sketch sections do not perturb the per-cycle control-
+  // message shape and that the coordinator stored exactly one cumulative
+  // sketch per direct source (local children + remote leaders) — the
+  // O(hosts) fleet-state claim made mechanically checkable.  (Bucket
+  // exactness is covered by the multi-process tests: all threads here
+  // share one global registry, so per-rank dumps are not meaningful.)
+  if (failures == 0) {
+    GlobalMetrics().enabled.store(true, std::memory_order_relaxed);
+    GlobalFleetTelemetry().enabled.store(true, std::memory_order_relaxed);
+    const int64_t merged0 = GlobalMetrics().fleet_sketches_merged_total.load(
+        std::memory_order_relaxed);
+    int fleet_sources = -1;
+    const int64_t tree_sk =
+        RunPhase("tree+sketch", "on", np, cycles, &fleet_sources);
+    const int64_t tree_expect = (np / hosts - 1) + (hosts - 1);
+    if (tree_sk != tree_expect) {
+      Fail("tree+sketch", 0,
+           "sketch sections perturbed the control plane: " +
+               std::to_string(tree_sk) + " msgs/cycle, expected " +
+               std::to_string(tree_expect));
+    }
+    if (fleet_sources != tree_expect) {
+      Fail("tree+sketch", 0,
+           "coordinator stored " + std::to_string(fleet_sources) +
+               " fleet sources, expected " + std::to_string(tree_expect));
+    }
+    const int64_t merged =
+        GlobalMetrics().fleet_sketches_merged_total.load(
+            std::memory_order_relaxed) -
+        merged0;
+    if (merged < tree_expect) {
+      Fail("tree+sketch", 0,
+           "fleet_sketches_merged_total advanced " + std::to_string(merged) +
+               ", expected >= " + std::to_string(tree_expect));
     }
   }
 

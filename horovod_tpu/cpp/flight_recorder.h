@@ -41,6 +41,8 @@ enum FlightType : int32_t {
   kFlightAutopilot = 13,  // a = action code,  b = target rank
   kFlightMigrate = 14,    // a = phase<<8 | source rank (+1; 0 = none),
                           // b = payload bytes
+  kFlightSentinel = 15,   // a = kind<<8 | rank (+1; 0 = fleet-wide),
+                          // b = observed value (us or ppm)
 };
 
 struct FlightEvent {

@@ -48,6 +48,12 @@ void MetricsRegistry::RecordTenantWaitUs(int psid, int64_t wait_us) {
   tenants_[psid].negotiation_wait_us.ObserveUs(wait_us);
 }
 
+void MetricsRegistry::ForEachTenantWait(
+    const std::function<void(int, const Histogram&)>& fn) const {
+  std::lock_guard<std::mutex> l(tenants_mu_);
+  for (const auto& kv : tenants_) fn(kv.first, kv.second.negotiation_wait_us);
+}
+
 void MetricsRegistry::Reset() {
   cycle_count.store(0, std::memory_order_relaxed);
   cycle_busy_us.store(0, std::memory_order_relaxed);
@@ -60,6 +66,8 @@ void MetricsRegistry::Reset() {
   aborts_total.store(0, std::memory_order_relaxed);
   faults_injected_total.store(0, std::memory_order_relaxed);
   autopilot_decisions_total.store(0, std::memory_order_relaxed);
+  fleet_sketches_merged_total.store(0, std::memory_order_relaxed);
+  sentinel_anomalies_total.store(0, std::memory_order_relaxed);
   device_raw_bytes.store(0, std::memory_order_relaxed);
   device_encoded_bytes.store(0, std::memory_order_relaxed);
   ctrl_msgs_sent.store(0, std::memory_order_relaxed);
@@ -70,10 +78,12 @@ void MetricsRegistry::Reset() {
   migrate_bytes_total.store(0, std::memory_order_relaxed);
   migrate_fallbacks_total.store(0, std::memory_order_relaxed);
   elastic_generation.store(0, std::memory_order_relaxed);
+  goodput_ratio_ppm.store(0, std::memory_order_relaxed);
   negotiation_wait_us.Reset();
   ring_hop_us.Reset();
   shm_fence_us.Reset();
   abort_propagation_us.Reset();
+  step_time_us.Reset();
   {
     std::lock_guard<std::mutex> l(tenants_mu_);
     tenants_.clear();
@@ -104,6 +114,10 @@ std::string MetricsRegistry::DumpJson(int rank,
      << faults_injected_total.load(std::memory_order_relaxed)
      << ",\"autopilot_decisions_total\":"
      << autopilot_decisions_total.load(std::memory_order_relaxed)
+     << ",\"fleet_sketches_merged_total\":"
+     << fleet_sketches_merged_total.load(std::memory_order_relaxed)
+     << ",\"sentinel_anomalies_total\":"
+     << sentinel_anomalies_total.load(std::memory_order_relaxed)
      << ",\"device_raw_bytes\":"
      << device_raw_bytes.load(std::memory_order_relaxed)
      << ",\"device_encoded_bytes\":"
@@ -125,11 +139,14 @@ std::string MetricsRegistry::DumpJson(int rank,
      << "},\"gauges\":{"
      << "\"elastic_generation\":"
      << elastic_generation.load(std::memory_order_relaxed)
+     << ",\"goodput_ratio_ppm\":"
+     << goodput_ratio_ppm.load(std::memory_order_relaxed)
      << "},\"histograms\":{"
      << "\"negotiation_wait_us\":" << negotiation_wait_us.Json()
      << ",\"ring_hop_us\":" << ring_hop_us.Json()
      << ",\"shm_fence_us\":" << shm_fence_us.Json()
-     << ",\"abort_propagation_us\":" << abort_propagation_us.Json() << "}";
+     << ",\"abort_propagation_us\":" << abort_propagation_us.Json()
+     << ",\"step_time_us\":" << step_time_us.Json() << "}";
   {
     // Per-tenant (process-set) accounting, keyed by psid.  Rendered even
     // when empty so consumers need no presence check.

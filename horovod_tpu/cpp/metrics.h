@@ -8,6 +8,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <string>
@@ -83,6 +84,12 @@ struct MetricsRegistry {
   // channel (evict / scale / readmit), regardless of driver outcome.
   std::atomic<int64_t> autopilot_decisions_total{0};
 
+  // Fleet telemetry plane (protocol v11; fleet_telemetry.h): child/leader
+  // sketches merged into the coordinator's fleet view, and anomalies the
+  // sentinel emitted.
+  std::atomic<int64_t> fleet_sketches_merged_total{0};
+  std::atomic<int64_t> sentinel_anomalies_total{0};
+
   // Device-plane (in-jit / eager-XLA) collective payload accounting,
   // reported by the Python side per quantized dispatch: raw fp32 ring
   // bytes the collective WOULD have moved vs the int8 block-scaled bytes
@@ -113,12 +120,17 @@ struct MetricsRegistry {
   // this rank most recently joined, so dashboards can correlate
   // migrate/abort counters with re-formations.
   std::atomic<int64_t> elastic_generation{0};
+  // Goodput as parts-per-million of fleet wall time spent in the ring
+  // phase (fleet_telemetry.cc recomputes it per tick; Prometheus renders
+  // it as the hvd_goodput_ratio fraction).
+  std::atomic<int64_t> goodput_ratio_ppm{0};
 
   // Latency distributions.
   Histogram negotiation_wait_us;  // enqueue -> fused response mapped back
   Histogram ring_hop_us;          // one pipelined chunk exchange step
   Histogram shm_fence_us;         // shm/hier dissemination-barrier fences
   Histogram abort_propagation_us;  // coordinator ABORT send -> worker observe
+  Histogram step_time_us;          // completed causal-step wall time
 
   // Per-tenant (process-set) fused-response accounting.  Tenants are a
   // cold, small map (one entry per registered process set), so a plain
@@ -133,6 +145,10 @@ struct MetricsRegistry {
 
   void RecordTenant(int psid, int64_t tensors, int64_t bytes);
   void RecordTenantWaitUs(int psid, int64_t wait_us);
+  // Visit each tenant's negotiation-wait histogram under the tenants
+  // lock (the fleet-sketch capture path; Histogram is non-copyable).
+  void ForEachTenantWait(
+      const std::function<void(int, const Histogram&)>& fn) const;
 
   void Reset();
 

@@ -29,18 +29,31 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-// v9: leader-tree control plane — the coordinator-authoritative ctrl_tree
+// v11: fleet-telemetry sketch section — a length-prefixed cumulative
+// histogram sketch between the cached pairs and the full requests of every
+// CYCLE frame, after the [-3] sentinel of leader aggregates (host-summed),
+// and trailing upward BYEs (the rank's FINAL sketch, so fleet histograms
+// stay bucket-exact across clean shutdown).  v10 added the step-id trailer
+// on RESPONSES + the marker-2 step snapshot on CYCLE frames; v9 the
+// leader-tree control plane — the coordinator-authoritative ctrl_tree
 // bit trailing the rendezvous book, the [-3] leader aggregate frame in the
 // cycle position, and the culprit rank trailing failure FINs (v8 added
 // ABORT control frames + the worker failure FIN sentinel, v7 the metrics
 // snapshot trailer on worker CYCLE frames, v6 the wire_comp codec byte in
 // responses, v5 the host key in the rendezvous HELLO/book + the hier bit
 // in responses)
-constexpr int32_t kProtocolVersion = 10;
+constexpr int32_t kProtocolVersion = 11;
 // Mesh-HELLO psid for child->leader ctrl-tree links: negative, so it can
 // never collide with a real process-set id (those start at 1) and always
 // lands in the pending-channel stash when it races a mesh establishment.
 constexpr int32_t kCtrlTreePsid = -7;
+// v11: worker/leader sketch sections are THROTTLED to this interval — the
+// coordinator only folds sketches at its 1 Hz tick, sketches are cumulative
+// (last-known is always a valid snapshot), and encoding 4 series x 28
+// buckets per negotiation cycle is pure waste at kHz cycle rates.  Frames
+// in between carry an empty section, which ReadFleetSketch ignores,
+// preserving the receiver's last-known.  BYE finals bypass the throttle.
+constexpr double kFleetEncodeIntervalS = 1.0;
 
 // Frame tags: catch mesh desync (a rank consuming a frame meant for another
 // op/step) immediately instead of corrupting buffers.
@@ -799,20 +812,42 @@ void SocketController::Farewell() {
   Writer w;
   w.PutI32(-1);  // BYE sentinel in the cycle-frame position
   if (is_coordinator()) {
+    // The farewell DOWN to workers stays a bare [-1]: it rides the
+    // RESPONSES position, where nothing parses past the sentinel.
     for (int rank = 1; rank < cfg_.size; ++rank) {
       if (ctrl_socks_[rank].valid() && !departed_ranks_.count(rank)) {
         ctrl_socks_[rank].SendFrame(w.data());
       }
     }
-  } else {
-    if (IsTreeLeader()) {
-      // Release this host's children first ([-1] in the responses
-      // position, same frame the coordinator's farewell would produce), so
-      // none of them blocks on a leader that is about to close its links.
-      FanDownToChildren(w.data(), nullptr);
-    }
-    UpLink().SendFrame(w.data());  // best effort; a leader forwards it up
+    return;
   }
+  if (IsTreeLeader()) {
+    // Release this host's children first ([-1] in the responses
+    // position, same frame the coordinator's farewell would produce), so
+    // none of them blocks on a leader that is about to close its links.
+    FanDownToChildren(w.data(), nullptr);
+  }
+  // v11: the BYE UP the gather topology carries this rank's FINAL
+  // cumulative sketch — captured here, after the last cycle's response
+  // handling observed its waits — so a coordinator still cycling folds in
+  // exactly what this rank's own metrics dump is about to record.  A
+  // leader ships the whole host's sum: its own fresh capture plus every
+  // child's last-known sketch (final, when the child BYEd through it).
+  if (MetricsOn() && FleetTelemetryOn()) {
+    FleetSketch own;
+    own.CaptureLocal();
+    if (IsTreeLeader()) {
+      tree_child_sketches_[cfg_.rank] = std::move(own);
+      FleetSketch host_sum;
+      for (const auto& kv : tree_child_sketches_) host_sum.Merge(kv.second);
+      w.PutString(host_sum.Encode());
+    } else {
+      w.PutString(own.Encode());
+    }
+  } else {
+    w.PutString("");
+  }
+  UpLink().SendFrame(w.data());  // best effort; a leader forwards it up
 }
 
 void SocketController::Shutdown() {
@@ -1634,6 +1669,10 @@ Status SocketController::CoordinatorCycle(
     Reader rd(frame);
     int32_t n_cached = rd.GetI32();
     if (n_cached == -1) {  // BYE: clean exit
+      // v11: the BYE carries the sender's FINAL cumulative sketch (a
+      // leader's: its whole host's sum).  Stored as the source's last
+      // word, it keeps the fleet histograms bucket-exact after departure.
+      ReadFleetSketch(rank, &rd);
       departed_ranks_.insert(rank);
       HVD_LOG(INFO) << "rank " << rank << " shut down cleanly";
       if (is_leader_src) {
@@ -1671,6 +1710,9 @@ Status SocketController::CoordinatorCycle(
       continue;
     }
     ParseCachedPairs(rank, n_cached, &rd, &errors);
+    // v11: the sender's cumulative telemetry sketch rides between the
+    // cached pairs and the full requests.
+    ReadFleetSketch(rank, &rd);
     ParseFullAndMetrics(rank, rd.GetI32(), &rd, &errors);
   }
 
@@ -1856,6 +1898,15 @@ Status SocketController::CoordinatorCycle(
     double now = MonotonicSeconds();
     FillSelfSnapshot(now);
     MaybeStragglerReport(now);
+    // v11 fleet tick (~1 Hz): history sample + goodput + the anomaly
+    // sentinel, fed the live fleet sum and the coordinator's data-plane
+    // byte totals (raw/wire ratio drift is a sentinel series).
+    if (FleetTelemetryOn() && now - last_fleet_tick_ >= 1.0) {
+      last_fleet_tick_ = now;
+      int64_t local = 0, xhost = 0, raw_local = 0, raw_xhost = 0;
+      DataPlaneStats(&local, &xhost, &raw_local, &raw_xhost);
+      FleetTelemetryTick(FleetSum(), local + xhost, raw_local + raw_xhost);
+    }
   }
   return Status::OK();
 }
@@ -1947,22 +1998,67 @@ void SocketController::MaybeStragglerReport(double now) {
 std::string SocketController::ClusterMetricsJson() {
   if (!is_coordinator()) return "";
   std::ostringstream os;
-  std::lock_guard<std::mutex> l(metrics_mu_);
-  os << "\"cluster\":{";
-  for (size_t r = 0; r < cluster_.size(); ++r) {
-    const auto& s = cluster_[r];
-    if (r) os << ',';
-    os << "\"" << r << "\":{\"neg_count\":" << s.neg_count
-       << ",\"neg_sum_us\":" << s.neg_sum_us
-       << ",\"neg_p50_us\":" << s.neg_p50_us
-       << ",\"neg_p99_us\":" << s.neg_p99_us
-       << ",\"cycle_busy_us\":" << s.cycle_busy_us
-       << ",\"cycle_idle_us\":" << s.cycle_idle_us
-       << ",\"cycle_count\":" << s.cycle_count
-       << ",\"updated_at\":" << s.updated_at << "}";
+  {
+    std::lock_guard<std::mutex> l(metrics_mu_);
+    os << "\"cluster\":{";
+    for (size_t r = 0; r < cluster_.size(); ++r) {
+      const auto& s = cluster_[r];
+      if (r) os << ',';
+      os << "\"" << r << "\":{\"neg_count\":" << s.neg_count
+         << ",\"neg_sum_us\":" << s.neg_sum_us
+         << ",\"neg_p50_us\":" << s.neg_p50_us
+         << ",\"neg_p99_us\":" << s.neg_p99_us
+         << ",\"cycle_busy_us\":" << s.cycle_busy_us
+         << ",\"cycle_idle_us\":" << s.cycle_idle_us
+         << ",\"cycle_count\":" << s.cycle_count
+         << ",\"updated_at\":" << s.updated_at << "}";
+    }
+    os << "},\"straggler_report\":\"" << JsonEscape(straggler_report_) << "\"";
   }
-  os << "},\"straggler_report\":\"" << JsonEscape(straggler_report_) << "\"";
+  // v11: the live fleet view — this registry's capture plus every stored
+  // source sketch — so hvd.metrics()["fleet"] and the Prometheus renderer
+  // see true fleet histograms, not rank 0's.
+  if (MetricsOn() && FleetTelemetryOn()) {
+    os << ",\"fleet\":" << FleetSum().Json();
+  }
   return os.str();
+}
+
+// ---------------------------------------------------------------------------
+// Fleet-telemetry sketch plumbing (protocol v11; fleet_telemetry.h)
+// ---------------------------------------------------------------------------
+
+void SocketController::ReadFleetSketch(int rank, Reader* rd) {
+  const std::string enc = rd->GetString();
+  if (!rd->ok() || enc.empty()) return;
+  FleetSketch s;
+  // A sketch that fails to decode is dropped on its own — never the frame:
+  // telemetry must not be able to abort a healthy job.
+  if (s.Decode(enc.data(), enc.size())) StoreFleetSource(rank, std::move(s));
+}
+
+void SocketController::StoreFleetSource(int rank, FleetSketch&& s) {
+  {
+    std::lock_guard<std::mutex> l(fleet_mu_);
+    fleet_sources_[rank] = std::move(s);
+  }
+  if (MetricsOn()) {
+    GlobalMetrics().fleet_sketches_merged_total.fetch_add(
+        1, std::memory_order_relaxed);
+  }
+}
+
+FleetSketch SocketController::FleetSum() {
+  FleetSketch fleet;
+  if (MetricsOn() && FleetTelemetryOn()) fleet.CaptureLocal();
+  std::lock_guard<std::mutex> l(fleet_mu_);
+  for (const auto& kv : fleet_sources_) fleet.Merge(kv.second);
+  return fleet;
+}
+
+int SocketController::FleetSourceCountForTest() {
+  std::lock_guard<std::mutex> l(fleet_mu_);
+  return static_cast<int>(fleet_sources_.size());
 }
 
 // ---------------------------------------------------------------------------
@@ -1990,7 +2086,11 @@ std::string SocketController::PolicyStatusJson() {
     os << "\"" << JsonEscape(host) << "\"";
   }
   os << "],\"report\":\"" << JsonEscape(straggler_report_)
-     << "\",\"size\":" << cfg_.size << "}";
+     // v11: the sentinel's anomaly log rides the same poll — an ADVISORY
+     // signal the driver-side engine journals and may act on ahead of the
+     // consecutive-window eviction rule.
+     << "\",\"anomalies\":" << FleetAnomaliesJson()
+     << ",\"size\":" << cfg_.size << "}";
   return os.str();
 }
 
@@ -2098,6 +2198,21 @@ std::string SocketController::BuildCycleFrame(
   for (auto& [id, handle] : cached) {
     w.PutI64(id);
     w.PutI64(handle);
+  }
+  // v11 sketch section: this rank's cumulative telemetry sketch, placed
+  // between the cached pairs and the full requests so a leader can peel it
+  // off cheaply while the rest of the tail forwards verbatim.  An empty
+  // string when the plane (or the registry feeding it) is off — the
+  // length prefix keeps the frame shape fixed either way.
+  const double sk_now = MonotonicSeconds();
+  if (MetricsOn() && FleetTelemetryOn() &&
+      sk_now - fleet_last_encode_ >= kFleetEncodeIntervalS) {
+    fleet_last_encode_ = sk_now;
+    FleetSketch sk;
+    sk.CaptureLocal();
+    w.PutString(sk.Encode());
+  } else {
+    w.PutString("");
   }
   w.PutI32(static_cast<int32_t>(full.size()));
   for (const auto* r : full) SerializeRequest(*r, &w);
@@ -2273,6 +2388,9 @@ bool SocketController::ParseAggregate(int leader, Reader* rd,
   // [i64 handle]) } [n_rest] { [i32 rank][string rest] } — the leader's
   // host-merged cached announcements, then each member's un-merged frame
   // tail (full requests + metrics trailer), or its whole BYE frame.
+  // v11 prepends the leader's host-summed sketch section, stored under the
+  // leader's rank so coordinator fleet state stays O(hosts).
+  ReadFleetSketch(leader, rd);
   const int32_t n_groups = rd->GetI32();
   if (!rd->ok() || n_groups < 0) return false;
   for (int32_t g = 0; g < n_groups; ++g) {
@@ -2307,6 +2425,9 @@ bool SocketController::ParseAggregate(int leader, Reader* rd,
     Reader rr(rest);
     const int32_t first = rr.GetI32();
     if (first == -1) {  // the member's BYE, forwarded by its leader
+      // v11: the forwarded BYE's trailing sketch is deliberately SKIPPED —
+      // the leader folded the child's final sketch into its own host sum,
+      // so reading it here would double-count the host.
       departed_ranks_.insert(rank);
       HVD_LOG(INFO) << "rank " << rank << " shut down cleanly (via leader "
                     << leader << ")";
@@ -2376,6 +2497,18 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
       groups[id].emplace_back(rank, handle);
     }
     if (!rd.ok()) return false;
+    // v11: peel the member's sketch out of the frame — the leader sums
+    // every member's into ONE aggregate sketch so coordinator inbound
+    // stays O(hosts) — leaving the rest (full requests + metrics
+    // trailer) to forward verbatim, sketch-free.
+    const std::string enc = rd.GetString();
+    if (!rd.ok()) return false;
+    if (!enc.empty()) {
+      FleetSketch s;
+      if (s.Decode(enc.data(), enc.size())) {
+        tree_child_sketches_[rank] = std::move(s);
+      }
+    }
     std::string rest(rd.cursor(), rd.remaining());
     if (rest != kEmptyTail) rests.emplace_back(rank, std::move(rest));
     return true;
@@ -2405,6 +2538,16 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
     Reader rd(frame);
     const int32_t first = rd.GetI32();
     if (first == -1) {  // child BYE: forward the whole frame as its tail
+      // v11: keep the child's FINAL sketch so the host sum stays exact
+      // after it departs.  The coordinator skips the sketch on the
+      // forwarded BYE — this host's aggregate already carries it.
+      const std::string enc = rd.GetString();
+      if (rd.ok() && !enc.empty()) {
+        FleetSketch s;
+        if (s.Decode(enc.data(), enc.size())) {
+          tree_child_sketches_[child] = std::move(s);
+        }
+      }
       tree_departed_children_.insert(child);
       rests.emplace_back(child, frame);
       continue;
@@ -2431,6 +2574,19 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
   const double agg_t0 = StepTraceOn() ? MonotonicSeconds() : 0.0;
   Writer w;
   w.PutI32(-3);  // leader aggregate sentinel in the cycle-frame position
+  // v11: ONE host-summed sketch per aggregate — own + every member's
+  // last-known (a map entry per member only exists once its frame carried
+  // a non-empty section, so an all-off host writes an empty string).
+  const double hs_now = MonotonicSeconds();
+  if (tree_child_sketches_.empty() ||
+      hs_now - fleet_leader_last_encode_ < kFleetEncodeIntervalS) {
+    w.PutString("");
+  } else {
+    fleet_leader_last_encode_ = hs_now;
+    FleetSketch host_sum;
+    for (const auto& kv : tree_child_sketches_) host_sum.Merge(kv.second);
+    w.PutString(host_sum.Encode());
+  }
   w.PutI32(static_cast<int32_t>(groups.size()));
   for (const auto& [id, members] : groups) {
     w.PutI64(id);

@@ -40,6 +40,7 @@
 
 #include "common.h"
 #include "controller.h"
+#include "fleet_telemetry.h"
 #include "flight_recorder.h"
 #include "metrics.h"
 #include "response_cache.h"
@@ -168,8 +169,13 @@ class SocketController : public Controller {
   // Coordinator-only JSON fragment for hvd_metrics_dump: the per-rank
   // cluster view built from the snapshots each worker piggybacks on its
   // CYCLE frame (protocol v7), plus the latest straggler attribution
-  // report.  Workers return "".
+  // report, plus the v11 fleet histogram view.  Workers return "".
   std::string ClusterMetricsJson();
+
+  // Coordinator-only: distinct fleet-sketch sources currently stored (the
+  // ctrl soak's tree+sketch arm asserts this equals local children +
+  // remote leaders, proving the tree kept coordinator inbound O(hosts)).
+  int FleetSourceCountForTest();
 
   // Fleet-autopilot policy channel (coordinator only, armed by
   // cfg_.autopilot_port > 0): a driver-facing JSON-lines endpoint serving
@@ -345,6 +351,19 @@ class SocketController : public Controller {
   // Parse a leader's [-3] aggregate frame; false = malformed (caller aborts
   // blaming the leader).
   bool ParseAggregate(int leader, Reader* rd, std::vector<Response>* errors);
+
+  // -- fleet telemetry (protocol v11; fleet_telemetry.h) --------------------
+  // Read the length-prefixed sketch section at the reader's cursor and
+  // store it as `rank`'s cumulative sketch.  A malformed sketch is dropped
+  // (never the frame); an empty section (sender's plane off) is a no-op.
+  void ReadFleetSketch(int rank, Reader* rd);
+  // Replace a source's last-known cumulative sketch (coordinator side).
+  void StoreFleetSource(int rank, FleetSketch&& s);
+  // The coordinator's live fleet view: its own registry capture plus every
+  // stored source sketch.  Bucket-exact vs an offline merge of per-rank
+  // dumps because each source's sketch is cumulative and replaced, never
+  // added twice.
+  FleetSketch FleetSum();
   // Leader lost its coordinator link: synthesize the ABORT the coordinator
   // can no longer deliver and fan it down so the subtree fails bounded.
   Status LeaderLostCoordinator(const std::string& what);
@@ -352,6 +371,26 @@ class SocketController : public Controller {
   // link (controller counters + the global metrics registry when enabled).
   void CountCtrlSend(int64_t bytes);
   void CountCtrlRecv(int64_t bytes);
+
+  // Coordinator: last-known cumulative sketch per direct source (a worker
+  // rank in flat mode; a local child or a remote leader's host sum in tree
+  // mode).  Guarded by fleet_mu_: the background thread replaces entries,
+  // hvd_metrics_dump sums them from the Python thread.
+  std::mutex fleet_mu_;
+  std::map<int, FleetSketch> fleet_sources_;
+  // Leader only (background thread): last-known sketch per host member —
+  // its own included — summed into the aggregate frame's sketch section.
+  // Entries survive a child's BYE (which carries the child's FINAL sketch)
+  // so the host sum stays exact after departures.
+  std::map<int, FleetSketch> tree_child_sketches_;
+  // Sender-side sketch throttles (kFleetEncodeIntervalS): a worker's
+  // cycle-frame section and a leader's aggregate host sum each re-encode
+  // at most once per interval; in-between frames carry an empty section.
+  double fleet_last_encode_ = 0;
+  double fleet_leader_last_encode_ = 0;
+  // Coordinator-side fleet tick limiter (the sum is cheap but per-cycle
+  // would still be 1000x more often than the 1 Hz history wants).
+  double last_fleet_tick_ = 0;
 
   CtrlTree tree_;
   // Leader (non-coordinator): accepted child ctrl links, by child rank.

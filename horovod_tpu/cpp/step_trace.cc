@@ -8,6 +8,8 @@
 #include <sstream>
 #include <vector>
 
+#include "metrics.h"
+
 namespace hvdtpu {
 
 namespace {
@@ -59,6 +61,11 @@ struct State {
   StepRec last;
   std::vector<FleetRec> fleet;
   int64_t fleet_seen = 0;  // fleet records ever touched (dump ordering)
+  // Cumulative fleet phase sums (every reported vector, never lapped) —
+  // the goodput denominator — and the newest fleet step id reported, for
+  // the sentinel's dominant-phase/rank attribution.
+  int64_t fleet_phase_cum[kStepPhases] = {0};
+  int64_t fleet_last_step = -1;
 };
 
 State& S() {
@@ -168,6 +175,8 @@ void InitStepTrace(bool enabled, int slots, const std::string& postmortem_dir,
   s.fleet.assign(p, FleetRec());
   s.completed = 0;
   s.fleet_seen = 0;
+  std::fill(s.fleet_phase_cum, s.fleet_phase_cum + kStepPhases, 0);
+  s.fleet_last_step = -1;
   s.last = StepRec();
   s.cur_step.store(0, std::memory_order_relaxed);
   for (auto& a : s.cur_phase_us) a.store(0, std::memory_order_relaxed);
@@ -206,6 +215,11 @@ void StepTraceAdvance(int64_t step_id) {
   }
   ++s.completed;
   s.last = rec;
+  if (MetricsOn()) {
+    // The step-time distribution every rank contributes to the fleet
+    // sketch (protocol v11): wall time of the step just closed.
+    GlobalMetrics().step_time_us.ObserveUs(rec.end_us - rec.start_us);
+  }
   s.cur_step.store(step_id, std::memory_order_relaxed);
   s.cur_start_us.store(rec.end_us, std::memory_order_relaxed);
 }
@@ -231,9 +245,54 @@ void StepTraceFleetPhases(int rank, int64_t step_id, const int64_t* phase_us) {
   FleetRec* f = FleetFor(s, step_id);
   if (f == nullptr || f->rank_reported[rank]) return;
   f->rank_reported[rank] = 1;
-  for (int p = 0; p < kStepPhases; ++p) f->phase_us[p] += phase_us[p];
+  for (int p = 0; p < kStepPhases; ++p) {
+    f->phase_us[p] += phase_us[p];
+    s.fleet_phase_cum[p] += phase_us[p];
+  }
   f->rank_neg_us[rank] += phase_us[kPhaseNegotiation];
   ++f->reported;
+  if (step_id > s.fleet_last_step) s.fleet_last_step = step_id;
+}
+
+void StepTraceFleetPhaseTotals(int64_t* out) {
+  State& s = S();
+  std::lock_guard<std::mutex> l(s.mu);
+  for (int p = 0; p < kStepPhases; ++p) out[p] = s.fleet_phase_cum[p];
+}
+
+bool StepTraceFleetDominant(int64_t* step_id, int* phase, int* rank) {
+  State& s = S();
+  std::lock_guard<std::mutex> l(s.mu);
+  if (s.fleet_last_step < 0 || s.fleet.empty()) return false;
+  const FleetRec& f =
+      s.fleet[static_cast<size_t>(s.fleet_last_step) % s.fleet.size()];
+  if (f.step_id != s.fleet_last_step) return false;  // lapped meanwhile
+  *step_id = f.step_id;
+  *phase = DominantPhase(f.phase_us);
+  *rank = DominantRank(f);
+  return true;
+}
+
+int StepTraceFleetDominantRecentRank(int window) {
+  State& s = S();
+  std::lock_guard<std::mutex> l(s.mu);
+  if (s.fleet_last_step < 0 || s.fleet.empty() || s.world <= 0) return -1;
+  std::vector<int> votes(static_cast<size_t>(s.world), 0);
+  const int64_t lo = std::max<int64_t>(0, s.fleet_last_step - window + 1);
+  for (int64_t sid = s.fleet_last_step; sid >= lo; --sid) {
+    const FleetRec& f = s.fleet[static_cast<size_t>(sid) % s.fleet.size()];
+    if (f.step_id != sid) continue;  // lapped
+    const int r = DominantRank(f);
+    if (r >= 0 && r < s.world) ++votes[static_cast<size_t>(r)];
+  }
+  int best = -1, best_votes = 0;
+  for (int r = 0; r < s.world; ++r) {
+    if (votes[static_cast<size_t>(r)] > best_votes) {
+      best_votes = votes[static_cast<size_t>(r)];
+      best = r;
+    }
+  }
+  return best;
 }
 
 void StepTraceFleetLagUs(int rank, int64_t lag_us) {
@@ -314,6 +373,8 @@ void ResetStepTraceForTest() {
   s.fleet.clear();
   s.completed = 0;
   s.fleet_seen = 0;
+  std::fill(s.fleet_phase_cum, s.fleet_phase_cum + kStepPhases, 0);
+  s.fleet_last_step = -1;
   s.last = StepRec();
   s.dump_path.clear();
   s.cur_step.store(0, std::memory_order_relaxed);

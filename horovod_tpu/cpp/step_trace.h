@@ -82,6 +82,24 @@ bool StepTraceLastCompleted(int64_t* step_id, int64_t* phase_us);
 void StepTraceFleetPhases(int rank, int64_t step_id, const int64_t* phase_us);
 void StepTraceFleetLagUs(int rank, int64_t lag_us);
 
+// Cumulative fleet phase totals since init (every phase vector ever fed
+// to StepTraceFleetPhases, summed) — the goodput denominator
+// (fleet_telemetry.cc).  `out` must hold kStepPhases; zeros when tracing
+// is off or nothing reported yet.
+void StepTraceFleetPhaseTotals(int64_t* out);
+
+// Attribution for the sentinel: the dominant phase / rank of the newest
+// fleet record any rank has reported into.  False when no fleet data
+// arrived (then outputs are untouched).
+bool StepTraceFleetDominant(int64_t* step_id, int* phase, int* rank);
+
+// Majority-vote attribution over the newest `window` complete fleet
+// records: per-step dominant-rank readings are noisy (an announce lag can
+// land on the neighbouring forming step and blame a victim waiting in
+// negotiation), so the sentinel votes across a short window instead of
+// trusting one step.  -1 when no fleet record carries an attribution.
+int StepTraceFleetDominantRecentRank(int window);
+
 // Full dump: {"schema":"steptrace-v1","rank","world","phases",
 // "steps":[[step,start_us,end_us,<5 phase us>],...],"fleet":[{...}]}.
 // The fleet array is non-empty only where fleet data arrived (rank 0).

@@ -118,6 +118,11 @@ class FleetAutopilot:
         # rank -> consecutive flagged report windows
         self._streaks: Dict[int, int] = {}
         self._last_windows = 0
+        # Highest sentinel anomaly seq already journaled (per generation):
+        # the fleet-telemetry sentinel is advisory — it names the suspect
+        # in the record *before* the eviction rule can fire, it never
+        # evicts by itself.
+        self._last_anomaly_seq = -1
         self._gen = -1
         self._last_evict_at: Optional[float] = None
         self._last_blacklist: Dict[str, float] = {}
@@ -186,8 +191,50 @@ class FleetAutopilot:
             self._gen = gen
             self._streaks.clear()
             self._last_windows = 0
+            self._last_anomaly_seq = -1
+
+    def note_anomalies(self, status: dict) -> int:
+        """Journal NEW sentinel anomalies from a POLL status (diffed by
+        ``seq``) as advisory ``"anomaly"`` rows in autopilot.jsonl.
+
+        Advisory only: the sentinel fires within ~1-2 ticks of an
+        inflection while the eviction rule needs ``evict_windows`` full
+        straggler report windows, so the journal names the suspect rank
+        strictly before any eviction decision.  Returns how many rows
+        were written (pure state + journal; no policy-channel traffic).
+        """
+        fresh = 0
+        for a in status.get("anomalies") or []:
+            a = a or {}
+            try:
+                seq = int(a.get("seq", -1))
+            except (TypeError, ValueError):
+                continue
+            if seq <= self._last_anomaly_seq:
+                continue
+            self._last_anomaly_seq = seq
+            fresh += 1
+            rank = int(a.get("rank", -1))
+            detail = (f"sentinel {a.get('kind', '?')} seq={seq} "
+                      f"value={a.get('value', 0)} "
+                      f"baseline={a.get('baseline', 0)} "
+                      f"score={a.get('score', 0)}")
+            self._journal({"ts": time.time(), "generation": self._gen,
+                           "action": "anomaly", "rank": rank,
+                           "detail": detail})
+            print(f"autopilot: anomaly rank={rank} {detail}",
+                  file=sys.stderr)
+        return fresh
 
     # -- recording -----------------------------------------------------------
+    def _journal(self, row: dict) -> None:
+        if self._log_path:
+            try:
+                with open(self._log_path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(row) + "\n")
+            except OSError:
+                pass
+
     def _record(self, client: Optional[PolicyClient], action: int,
                 rank: int, detail: str) -> None:
         name = ACTION_NAMES.get(action, "unknown")
@@ -195,14 +242,8 @@ class FleetAutopilot:
             # Record natively FIRST: the flight dump + timeline instant must
             # exist before an eviction tears the generation down.
             client.decision(action, rank, detail)
-        row = {"ts": time.time(), "generation": self._gen,
-               "action": name, "rank": rank, "detail": detail}
-        if self._log_path:
-            try:
-                with open(self._log_path, "a", encoding="utf-8") as f:
-                    f.write(json.dumps(row) + "\n")
-            except OSError:
-                pass
+        self._journal({"ts": time.time(), "generation": self._gen,
+                       "action": name, "rank": rank, "detail": detail})
         print(f"autopilot: {name} rank={rank} {detail}", file=sys.stderr)
 
     def _watch_fleet_changes(self, client: Optional[PolicyClient]) -> None:
@@ -235,6 +276,9 @@ class FleetAutopilot:
             status = client.poll()
             if not status:
                 continue
+            # Advisory sentinel anomalies journal first: the record names
+            # the suspect rank before any eviction decision below.
+            self.note_anomalies(status)
             decision = self.observe(status, self.clock())
             if decision is None:
                 continue

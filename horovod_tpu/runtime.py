@@ -43,7 +43,7 @@ log = get_logger()
 # Mirror of kProtocolVersion in cpp/socket_controller.cc — the two MUST move
 # together (tools/hvd_lint.py enforces it).  Exposed so launcher diagnostics
 # and rendezvous error messages can name the wire generation they speak.
-PROTOCOL_VERSION = 10
+PROTOCOL_VERSION = 11
 
 
 def compute_ctrl_tree(host_keys, mode: str = "auto") -> dict:
@@ -287,6 +287,12 @@ class CoreBackend:
         """Snapshot of the causal step-trace ring (per-step phase
         breakdowns, fleet attribution on rank 0); empty for backends
         without the native tracer."""
+        return {}
+
+    def fleet_history(self) -> dict:
+        """The coordinator's multi-resolution fleet history + anomaly log
+        (fleethistory-v1); empty for backends without the native
+        fleet-telemetry plane."""
         return {}
 
     def migrate_note(self, phase: int, nbytes: int,

@@ -200,6 +200,48 @@ def test_watch_fleet_changes_records_readmit_and_scale_up(
     assert "3 -> 4" in rows[1]["detail"]
 
 
+def test_note_anomalies_journals_new_rows_only(monkeypatch, tmp_path):
+    monkeypatch.setenv("HOROVOD_POSTMORTEM_DIR", str(tmp_path))
+    pilot = FleetAutopilot(FakeDriver(), clock=FakeClock())
+    pilot._gen = 1
+    status = dict(_status(1), anomalies=[
+        {"seq": 0, "kind": "step_p99", "rank": 3, "value": 900,
+         "baseline": 120, "score": 6.1},
+        {"seq": 1, "kind": "goodput", "rank": -1, "value": 400000,
+         "baseline": 900000, "score": 5.0},
+    ])
+    assert pilot.note_anomalies(status) == 2
+    # Re-polling the same status must not journal duplicates (seq diff).
+    assert pilot.note_anomalies(status) == 0
+    status["anomalies"].append({"seq": 2, "kind": "step_p99", "rank": 3,
+                                "value": 950, "baseline": 130, "score": 6.0})
+    assert pilot.note_anomalies(status) == 1
+    rows = [json.loads(line) for line in
+            (tmp_path / "autopilot.jsonl").read_text().splitlines()]
+    assert [r["action"] for r in rows] == ["anomaly"] * 3
+    assert rows[0]["rank"] == 3 and "step_p99" in rows[0]["detail"]
+    assert rows[1]["rank"] == -1 and "goodput" in rows[1]["detail"]
+    assert all(r["generation"] == 1 for r in rows)
+
+
+def test_note_anomalies_is_advisory_and_resilient(ap):
+    # Advisory: anomalies never produce an eviction decision by themselves.
+    status = dict(_status(1), anomalies=[
+        {"seq": 0, "kind": "step_p99", "rank": 3, "value": 900,
+         "baseline": 120, "score": 9.9}])
+    ap.note_anomalies(status)
+    assert ap.driver.evicted == []
+    # Malformed rows (missing seq, junk seq, None) are skipped, not fatal.
+    bad = dict(_status(1), anomalies=[None, {"kind": "x"},
+                                      {"seq": "junk"},
+                                      {"seq": 5, "kind": "wire_ratio"}])
+    assert ap.note_anomalies(bad) == 1
+    # Generation turnover resets the seq watermark: a fresh coordinator
+    # restarts at seq 0 and its anomalies must journal again.
+    ap.note_generation(99)
+    assert ap.note_anomalies(status) == 1
+
+
 def test_policy_client_handles_dead_port():
     # Nothing listens here: every call degrades to None/False, never raises.
     client = PolicyClient(port=1, timeout=0.2)

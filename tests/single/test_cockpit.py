@@ -172,6 +172,11 @@ class _StubCore:
                 "fleet": [{"step": 0, "dominant_phase": "ring",
                            "dominant_rank": 1}]}
 
+    def fleet_history(self):
+        return {"schema": "fleethistory-v1",
+                "tiers": [{"period_s": 1, "samples": [[1, 2, 3, 4, 5, 6]]}],
+                "anomalies": []}
+
 
 class _StubCtx:
     def __init__(self, rank=0, enabled=True, port=0):
@@ -205,8 +210,87 @@ def test_maybe_start_cockpit_serves_production_state():
         _, _, body = _get(srv.port, "/metrics")
         assert b'hvd_steps_total_total{rank="0"} 3' not in body  # no doubling
         assert b'hvd_steps_total{rank="0"} 3' in body
+        # /history is wired through ctx.core.fleet_history().
+        status, ctype, body = _get(srv.port, "/history")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body)["schema"] == "fleethistory-v1"
     finally:
         srv.stop()
+
+
+def test_history_route_degrades_without_history_fn():
+    # A stub coordinator (or a runtime predating the fleet plane) passes
+    # no history_fn: /history serves {}, not a 404/500, so hvd_top's
+    # long-horizon panel dims instead of erroring.
+    srv = ck.CockpitServer(_stub_metrics, lambda: {"steps": []}, port=0)
+    try:
+        port = srv.start()
+        status, ctype, body = _get(port, "/history")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body) == {}
+    finally:
+        srv.stop()
+
+
+def test_history_route_surfaces_crash_as_error_payload():
+    def bad_history():
+        raise RuntimeError("history exploded")
+
+    srv = ck.CockpitServer(_stub_metrics, lambda: {}, port=0,
+                           history_fn=bad_history)
+    try:
+        port = srv.start()
+        status, _, body = _get(port, "/history")
+        assert status == 200
+        assert json.loads(body) == {"error": "history exploded"}
+    finally:
+        srv.stop()
+
+
+def test_all_routes_survive_elastic_reformation_on_sticky_port():
+    # Shrink-then-regrow story on ONE sticky port: generation 0 serves,
+    # dies (shrink), generation 1's coordinator rebinds the same port and
+    # every route answers with the advanced generation — a polling
+    # hvd_top/Prometheus client never has to re-discover the address.
+    def mk_server(gen, port):
+        def metrics():
+            return (f'hvd_elastic_generation{{rank="0"}} {gen}\n'
+                    f'hvd_steps_total{{rank="0"}} {gen * 10}\n')
+
+        def state():
+            return {"schema": "cockpit-state-v1", "elastic_generation": gen,
+                    "world": 4 - gen, "steps": [{"step": gen}]}
+
+        def history():
+            return {"schema": "fleethistory-v1", "generation": gen,
+                    "tiers": [{"period_s": 1, "samples": []}],
+                    "anomalies": []}
+
+        return ck.CockpitServer(metrics, state, port=port,
+                                history_fn=history)
+
+    srv0 = mk_server(0, 0)
+    port = srv0.start()
+    for path in ("/metrics", "/state", "/history"):
+        status, _, _ = _get(port, path)
+        assert status == 200, path
+    _, _, body = _get(port, "/state")
+    assert json.loads(body)["elastic_generation"] == 0
+    srv0.stop()  # shrink: generation 0's rank 0 is gone
+
+    srv1 = mk_server(1, port)
+    try:
+        assert srv1.start() == port  # re-grow rebinds the sticky port
+        _, _, body = _get(port, "/metrics")
+        assert b'hvd_elastic_generation{rank="0"} 1' in body
+        _, _, body = _get(port, "/state")
+        assert json.loads(body)["elastic_generation"] == 1
+        _, _, body = _get(port, "/history")
+        history = json.loads(body)
+        assert (history["schema"], history["generation"]) == \
+            ("fleethistory-v1", 1)
+    finally:
+        srv1.stop()
 
 
 def test_maybe_start_cockpit_bind_failure_is_nonfatal():

@@ -1,0 +1,103 @@
+"""tools/hvd_top.py pure renderers: full /state snapshots, graceful
+degradation when the snapshot lacks step-trace fields (HOROVOD_STEP_TRACE
+off or an older-protocol peer), and the fleet-telemetry /history panel
+including its dimmed fallback for a missing/empty payload.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+top = _load_tool("hvd_top")
+
+
+def _full_state():
+    return {
+        "schema": "cockpit-state-v1", "world": 4, "elastic_generation": 2,
+        "phases": ["negotiation_wait", "fusion", "ring", "fence", "idle"],
+        "steps": [
+            {"step": 0, "phase_us": [10, 5, 80, 3, 2], "lag_us": [0, 4],
+             "dominant_phase": "ring", "dominant_rank": 1, "reported": 4},
+            {"step": 1, "phase_us": [50, 5, 30, 3, 2], "lag_us": [0, 40],
+             "dominant_phase": "negotiation_wait", "dominant_rank": 1,
+             "reported": 4},
+        ],
+        "tenants": {"default": {"responses": 2, "tensors": 4, "bytes": 64}},
+        "migration": {"migrate_events_total": 0},
+    }
+
+
+def test_render_full_state():
+    lines = top.render(_full_state())
+    text = "\n".join(lines)
+    assert "world 4" in text and "generation 2" in text
+    assert "dominant: negotiation_wait on rank 1" in text
+    assert "straggler" in text
+    assert "default" in text
+
+
+def test_render_degrades_without_step_trace_fields():
+    # A /state from HOROVOD_STEP_TRACE=0 (or an older peer) has no steps /
+    # phases keys at all: the panel dims, nothing raises.
+    for state in ({}, {"world": 2}, {"steps": None, "phases": None},
+                  {"steps": [], "tenants": None, "migration": None}):
+        lines = top.render(state)
+        assert any("step trace unavailable" in ln for ln in lines), state
+    # With color on, the degraded line is dimmed, not highlighted.
+    lines = top.render({}, color=True)
+    assert any(top.DIM in ln for ln in lines)
+
+
+def test_render_tolerates_partial_step_rows():
+    # Rows missing phase_us / lag_us (mid-write snapshot) must not crash.
+    state = {"steps": [{"step": 3}], "phases": ["a", "b"]}
+    text = "\n".join(top.render(state))
+    assert "step time" in text
+
+
+def test_render_history_sparklines_and_anomalies():
+    history = {
+        "schema": "fleethistory-v1",
+        "columns": ["ts_us", "step_p99_us", "neg_p99_us", "goodput_ppm",
+                    "wire_ratio_ppm", "steps"],
+        "tiers": [
+            {"period_s": 1,
+             "samples": [[1, 100, 50, 900000, 1000000, 5],
+                         [2, 900, 70, 400000, 1000000, 6]]},
+            {"period_s": 10, "samples": []},
+        ],
+        "anomalies": [{"seq": 0, "kind": "step_p99", "rank": 3,
+                       "value": 900, "baseline": 100, "score": 6.5}],
+    }
+    text = "\n".join(top.render_history(history))
+    assert "1s p99" in text and "last 900us" in text
+    assert "goodput" in text and "40.0%" in text
+    assert "10s tier: no samples yet" in text
+    assert "#0 step_p99" in text and "rank=3" in text and "z=6.5" in text
+
+
+def test_render_history_degrades_when_plane_off():
+    # {} (plane off), None (fetch failed), and junk all dim, never raise.
+    for history in ({}, None, {"tiers": "nonsense"}, {"error": "boom"}):
+        lines = top.render_history(history, color=True)
+        assert any("fleet telemetry unavailable" in ln for ln in lines), \
+            history
+        assert any(top.DIM in ln for ln in lines)
+
+
+def test_sparkline_shape():
+    assert top.sparkline([]) == ""
+    assert len(top.sparkline([1, 2, 3])) == 3
+    flat = top.sparkline([5, 5, 5])
+    assert len(set(flat)) == 1

@@ -84,3 +84,121 @@ def test_hostile_psid_is_escaped_not_scrape_breaking():
 def test_empty_and_disabled_dumps_render_empty():
     assert render_prometheus({}) == ""
     assert render_prometheus(None) == ""
+
+
+# -- exposition completeness (v11): HELP/TYPE metadata, fleet section,
+# -- derived goodput gauge ---------------------------------------------------
+
+_META = re.compile(r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*)( .*)?$")
+
+
+def _families(text):
+    """family -> list of (kind, count) seen in # TYPE/# HELP lines."""
+    help_seen, type_seen = {}, {}
+    for line in text.splitlines():
+        m = _META.match(line)
+        if not m:
+            assert not line.startswith("#"), f"malformed comment: {line!r}"
+            continue
+        which, family = m.group(1), m.group(2)
+        (help_seen if which == "HELP" else type_seen)[family] = \
+            (help_seen if which == "HELP" else type_seen).get(family, 0) + 1
+    return help_seen, type_seen
+
+
+_FULL_DUMP = {
+    "rank": 0,
+    "counters": {"steps_total": 5, "fleet_sketches_merged_total": 12},
+    "gauges": {"elastic_generation": 2, "goodput_ratio_ppm": 731250},
+    "histograms": {"negotiation_wait_us": {
+        "buckets": [1, 2, 0, 4], "sum_us": 99, "count": 7}},
+    "tenants": {"a": {"responses": 1, "tensors": 2, "bytes": 3,
+                      "negotiation_wait_us": {
+                          "buckets": [1, 1], "sum_us": 4, "count": 2}},
+                "b": {"responses": 9, "tensors": 9, "bytes": 9,
+                      "negotiation_wait_us": {
+                          "buckets": [2, 0], "sum_us": 1, "count": 2}}},
+    "fleet": {
+        "negotiation_wait_us": {"buckets": [4, 4], "sum_us": 40, "count": 8},
+        "ring_hop_us": {"buckets": [1, 0], "sum_us": 1, "count": 1},
+        "step_time_us": {"buckets": [0, 3], "sum_us": 90, "count": 3},
+        "shm_fence_us": {"buckets": [], "sum_us": 0, "count": 0},
+        "tenants": {"a": {"buckets": [2, 2], "sum_us": 20, "count": 4}},
+    },
+}
+
+
+def test_every_family_has_help_and_type_exactly_once():
+    text = render_prometheus(_FULL_DUMP)
+    _assert_scrapeable(text)
+    help_seen, type_seen = _families(text)
+    sample_families = set()
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    name[: -len(suffix)] in type_seen:
+                name = name[: -len(suffix)]
+                break
+        sample_families.add(name)
+    for family in sample_families:
+        assert help_seen.get(family) == 1, (family, help_seen.get(family))
+        assert type_seen.get(family) == 1, (family, type_seen.get(family))
+    # Metadata must precede the family's first sample line.
+    first_meta, first_sample = {}, {}
+    for i, line in enumerate(text.splitlines()):
+        m = _META.match(line)
+        if m:
+            first_meta.setdefault(m.group(2), i)
+        elif line:
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            first_sample.setdefault(name, i)
+    for family in sample_families:
+        assert first_meta[family] < first_sample.get(
+            family, first_sample.get(family + "_bucket", 1 << 30))
+
+
+def test_per_tenant_series_share_one_metadata_block():
+    text = render_prometheus(_FULL_DUMP)
+    # Two tenants -> two sample groups but exactly ONE # TYPE per family
+    # (repeated metadata fails promtool).
+    assert text.count("# TYPE hvd_tenant_responses_total counter") == 1
+    assert text.count("# TYPE hvd_tenant_negotiation_wait_us histogram") == 1
+    assert sum(1 for line in text.splitlines()
+               if line.startswith("hvd_tenant_responses_total{")) == 2
+
+
+def test_fleet_section_renders_under_fleet_prefix():
+    text = render_prometheus(_FULL_DUMP)
+    _assert_scrapeable(text)
+    lines = text.splitlines()
+    assert 'hvd_fleet_negotiation_wait_us_bucket{rank="0",le="1"} 4' in lines
+    assert 'hvd_fleet_negotiation_wait_us_bucket{rank="0",le="+Inf"} 8' \
+        in lines
+    assert 'hvd_fleet_step_time_us_count{rank="0"} 3' in lines
+    assert "# TYPE hvd_fleet_negotiation_wait_us histogram" in lines
+    assert ('hvd_fleet_tenant_negotiation_wait_us_count'
+            '{rank="0",psid="a"} 4') in lines
+    # The counter the coordinator bumps per merged sketch renders too.
+    assert 'hvd_fleet_sketches_merged_total{rank="0"} 12' in lines
+
+
+def test_goodput_ratio_gauge_derived_from_ppm():
+    text = render_prometheus(_FULL_DUMP)
+    lines = text.splitlines()
+    assert 'hvd_goodput_ratio_ppm{rank="0"} 731250' in lines
+    assert 'hvd_goodput_ratio{rank="0"} 0.731250' in lines
+    assert "# TYPE hvd_goodput_ratio gauge" in lines
+    # Absent ppm gauge -> no derived series.
+    text2 = render_prometheus({"rank": 1, "gauges": {"x": 1}})
+    assert "hvd_goodput_ratio" not in text2
+
+
+def test_dump_without_fleet_section_renders_no_fleet_families():
+    dump = dict(_FULL_DUMP)
+    dump.pop("fleet")
+    text = render_prometheus(dump)
+    assert "hvd_fleet_negotiation_wait_us" not in text
+    _assert_scrapeable(text)

@@ -47,6 +47,15 @@ the causal step tracer's negotiation-throughput overhead.  The bar is
 <= 1% with the cockpit disabled: span capture is relaxed atomic adds at
 already-instrumented sites, and the per-cycle trailer is 6 extra i64s.
 
+With --fleet-telemetry an additional section runs the cache_on
+configuration with HOROVOD_METRICS=1 and HOROVOD_FLEET_TELEMETRY=0 vs 1 —
+interleaved, best-of-3 per config like the flight section — and reports
+the v11 fleet telemetry plane's negotiation-throughput overhead: the
+delta/varint sketch section every rank appends to its CYCLE frame, the
+coordinator-side sketch merge, and the ~1 Hz history/goodput/sentinel
+tick.  The bar is <= 1%; the metrics-on baseline isolates the plane's own
+cost from the registry's.
+
 With --np-sweep N,N,... the tool instead sweeps job sizes over fake
 multi-host topologies (4 ranks per fake host) and prints the O(n)-vs-
 O(hosts) table behind the v9 leader tree: coordinator inbound control
@@ -353,6 +362,11 @@ def main():
                     help="also measure the flight recorder's negotiation "
                          "overhead: cache_on with the recorder off vs on, "
                          "steps/s ratio (<= 1%% is the acceptance bar)")
+    ap.add_argument("--fleet-telemetry", action="store_true",
+                    help="also measure the v11 fleet telemetry plane's "
+                         "negotiation overhead: metrics-on with "
+                         "HOROVOD_FLEET_TELEMETRY=0 vs 1, interleaved "
+                         "best-of-3 (<= 1%% is the acceptance bar)")
     ap.add_argument("--np-sweep", default=None, metavar="N,N,...",
                     help="run ONLY the control-plane scaling sweep: "
                          "coordinator ctrl messages + bytes per cycle, "
@@ -453,6 +467,31 @@ def main():
             "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
             "steps_ratio_with_metrics_vs_off": round(
                 best_both / max(best_off, 1e-9), 3),
+        }), flush=True)
+
+    if args.fleet_telemetry:
+        # Interleaved best-of-3 against a metrics-ON baseline: the plane
+        # rides the metrics plumbing (sketches are captured from the
+        # registry's histograms), so the delta being priced is the v11
+        # sketch sections + coordinator merge + 1 Hz tick alone.
+        best_off = best_on = 0.0
+        for i in range(3):
+            fleet_off = run_config(
+                f"cache_on_fleet_off_r{i}",
+                {"HOROVOD_METRICS": "1", "HOROVOD_FLEET_TELEMETRY": "0"},
+                args.np, args.steps, args.tensors)
+            fleet_on = run_config(
+                f"cache_on_fleet_on_r{i}",
+                {"HOROVOD_METRICS": "1", "HOROVOD_FLEET_TELEMETRY": "1"},
+                args.np, args.steps, args.tensors)
+            best_off = max(best_off, fleet_off["steps_per_s"])
+            best_on = max(best_on, fleet_on["steps_per_s"])
+        ratio = best_on / max(best_off, 1e-9)
+        print(json.dumps({
+            "metric": "fleet_telemetry_overhead",
+            "best_of": 3,
+            "steps_ratio_on_vs_off": round(ratio, 3),
+            "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
         }), flush=True)
 
     if args.wire_compression:

@@ -56,7 +56,8 @@ OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_flight_record", "hvd_add_process_set2",
                     "hvd_device_plane_note", "hvd_device_plane_stats",
                     "hvd_autotune_qdev", "hvd_migrate_note",
-                    "hvd_elastic_generation_set", "hvd_step_trace"}
+                    "hvd_elastic_generation_set", "hvd_step_trace",
+                    "hvd_fleet_history"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where
@@ -78,6 +79,8 @@ NATIVE_READ_VARS = {
     "HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS",
     "HOROVOD_CONTROL_TREE",
     "HOROVOD_RENDEZVOUS_ACCEPTORS",
+    "HOROVOD_FLEET_TELEMETRY",
+    "HOROVOD_SENTINEL_ZSCORE",
 }
 
 # Public knobs read in Python outside utils/env.py (module-scope or

@@ -11,7 +11,13 @@ Polls the coordinator's loopback cockpit endpoint (HOROVOD_COCKPIT=1, rank
   called out,
 - per-rank skew: each rank's announce lag on the latest step, so the
   straggler is visible at a glance,
-- the per-tenant (process-set) QoS table and migration counters.
+- the per-tenant (process-set) QoS table and migration counters,
+- the fleet-telemetry long-horizon panel (/history): step-p99 and goodput
+  sparklines per downsampling tier plus the anomaly sentinel's recent log.
+
+Every panel degrades instead of crashing: a /state snapshot without
+step-trace fields (plane off, old runtime) dims the step panels, and a
+missing or empty /history dims the long-horizon panel.
 
 Two tail modes ride the same endpoint: ``--events`` follows the /events
 SSE stream and prints one line per step / runtime instant (reconnecting
@@ -48,6 +54,11 @@ PHASE_GLYPHS = {
     "idle": ("I", "\x1b[90m"),               # grey — nothing enqueued
 }
 RESET = "\x1b[0m"
+DIM = "\x1b[2m"
+
+
+def _dim(text: str, color: bool) -> str:
+    return (DIM + text + RESET) if color else text
 
 
 def fetch_json(host: str, port: int, path: str, timeout: float = 3.0):
@@ -140,8 +151,11 @@ def render(state: dict, width: int = 78, color: bool = False,
         lines.append("per-rank announce lag (latest step):")
         lines.extend(skew_lines(latest.get("lag_us") or []))
     else:
-        lines.append("no completed steps yet "
-                     "(is HOROVOD_STEP_TRACE on and the job stepping?)")
+        # Degraded panel: the snapshot has no step-trace fields (plane off,
+        # older runtime, or no step completed yet).  Dim, never crash.
+        lines.append(_dim("step trace unavailable "
+                          "(is HOROVOD_STEP_TRACE on and the job stepping?)",
+                          color))
     tenants = state.get("tenants") or {}
     if tenants:
         lines.append("")
@@ -165,6 +179,61 @@ def render(state: dict, width: int = 78, color: bool = False,
         lines.append(f"straggler report: {sr}")
     if "error" in state:
         lines.append(f"state error: {state['error']}")
+    return lines
+
+
+def render_history(history: Optional[dict], width: int = 78,
+                   color: bool = False) -> List[str]:
+    """Pure renderer: /history (fleethistory-v1) -> long-horizon panel lines.
+
+    A missing endpoint (older runtime), an empty payload (plane off), or a
+    malformed one renders a dimmed placeholder — the cockpit keeps working
+    against any coordinator generation.
+    """
+    lines = ["", "fleet history (step p99 / goodput per tier):"]
+    tiers = (history or {}).get("tiers") or []
+    columns = (history or {}).get("columns") or [
+        "ts_us", "step_p99_us", "neg_p99_us", "goodput_ppm",
+        "wire_ratio_ppm", "steps"]
+    if not isinstance(tiers, list) or not tiers:
+        lines.append(_dim("  fleet telemetry unavailable "
+                          "(HOROVOD_FLEET_TELEMETRY off or runtime < v11)",
+                          color))
+        return lines
+
+    def col(row: List, name: str) -> float:
+        try:
+            return float(row[columns.index(name)])
+        except (ValueError, IndexError, TypeError):
+            return 0.0
+
+    span = max(10, min(width - 26, 60))
+    for tier in tiers:
+        period = (tier or {}).get("period_s", "?")
+        samples = [(s or []) for s in (tier or {}).get("samples") or []]
+        samples = samples[-span:]
+        label = f"{period}s"
+        if not samples:
+            lines.append(_dim(f"  {label:>4} tier: no samples yet", color))
+            continue
+        p99 = [col(s, "step_p99_us") for s in samples]
+        goodput = [col(s, "goodput_ppm") / 1e4 for s in samples]  # -> %
+        lines.append(f"  {label:>4} p99     {sparkline(p99)}  "
+                     f"last {int(p99[-1])}us")
+        lines.append(f"  {label:>4} goodput {sparkline(goodput)}  "
+                     f"last {goodput[-1]:.1f}%")
+    anomalies = (history or {}).get("anomalies") or []
+    if anomalies:
+        lines.append("")
+        lines.append("sentinel anomalies (newest last):")
+        for a in anomalies[-5:]:
+            a = a or {}
+            lines.append(
+                f"  #{a.get('seq', '?')} {a.get('kind', '?')}"
+                f" z={float(a.get('score', 0)):.1f}"
+                f" value={a.get('value', 0)}"
+                f" baseline={a.get('baseline', 0)}"
+                f" rank={a.get('rank', -1)}")
     return lines
 
 
@@ -217,7 +286,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 json.dump(state, sys.stdout, indent=2)
                 print()
                 return 0
+            # /history is best-effort: an older coordinator (404) or a
+            # disabled plane must not take the whole dashboard down.
+            try:
+                history = fetch_json(args.host, args.port, "/history")
+            except Exception:  # noqa: BLE001 - degrade to the dimmed panel
+                history = {}
             lines = render(state, color=color, last=args.last)
+            lines.extend(render_history(history, color=color))
             if not args.once:
                 sys.stdout.write("\x1b[H\x1b[2J")  # home + clear
             print("\n".join(lines), flush=True)

@@ -80,6 +80,10 @@ def find_bundle(path: str) -> Dict[str, object]:
 _MIGRATE_PHASES = {1: "replicate", 2: "manifest", 3: "transfer",
                    4: "reassemble", 5: "fallback"}
 
+# Mirrors cpp/fleet_telemetry.cc SentinelKind (flight type-15 `a` upper
+# byte); the low byte is dominant_rank+1 (0 = no rank attribution).
+_SENTINEL_KINDS = {1: "step_p99", 2: "goodput", 3: "wire_ratio"}
+
 # The flight-recorder event-type table: the Python-side mirror of
 # cpp/flight_recorder.h FlightType and flight_recorder.cc
 # kFlightTypesLegend.  Dumps carry their own legend (the "types" object),
@@ -91,7 +95,7 @@ FLIGHT_TYPES = {
     1: "ctrl_send", 2: "ctrl_recv", 3: "rendezvous", 4: "verdict",
     5: "ring_hop", 6: "wire_codec", 7: "shm_fence", 8: "shm_map",
     9: "tree_aggregate", 10: "fault_trip", 11: "abort", 12: "digest",
-    13: "autopilot", 14: "migrate",
+    13: "autopilot", 14: "migrate", 15: "sentinel",
 }
 
 
@@ -111,6 +115,14 @@ def _fmt_event(row: List[int], types: Dict[str, str],
         src_s = str(src) if src >= 0 else "-"
         return (f"{rel}seq={seq:<8} {name:<14} tid={tid} "
                 f"phase={phase} src={src_s} bytes={b}")
+    if name == "sentinel":
+        # a = kind<<8 | dominant_rank+1 (0 = no attribution); b = the
+        # observed value (us for step_p99, ppm for goodput/wire_ratio).
+        kind = _SENTINEL_KINDS.get(a >> 8, f"kind{a >> 8}")
+        rank = (a & 0xFF) - 1
+        rank_s = str(rank) if rank >= 0 else "-"
+        return (f"{rel}seq={seq:<8} {name:<14} tid={tid} "
+                f"kind={kind} rank={rank_s} value={b}")
     return f"{rel}seq={seq:<8} {name:<14} tid={tid} a={a} b={b}"
 
 

@@ -55,6 +55,13 @@ combination of:
            and a non-zero 5-phase breakdown; fleet attribution on the
            coordinator at np>1), "off" combos that hvd.step_trace()
            reports {}; one on-combo in the quick set
+- fleet:   def (ambient default) / on / off (HOROVOD_FLEET_TELEMETRY,
+           the v11 sketch sections; rides the metrics plane, so "on"
+           combos force HOROVOD_METRICS=1) — "on" combos assert the
+           coordinator's true fleet histograms populated
+           (metrics()["fleet"]) and hvd.fleet_history() serves the
+           fleethistory-v1 payload, "off" combos that both stay empty;
+           one on-combo in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -72,7 +79,10 @@ checkpoint reads -> blacklist-expiry re-grow to np=4, plus the degraded
 checkpoint-fallback path), the np=4 live-cockpit attribution pytest
 (`cockpit-np4`: injected coordinator-recv delay -> the live /state
 snapshot AND tools/critical_path.py both name the delayed rank /
-negotiation-wait), the np=256 control-plane soak (`ctrl-soak`:
+negotiation-wait), the np=4 anomaly-sentinel chaos pytest
+(`sentinel-np4`: persistent injected delay on one rank -> sentinel
+anomaly naming that rank, journaled and flight-recorded strictly before
+the eviction rule can fire), the np=256 control-plane soak (`ctrl-soak`:
 flat vs tree coordinator message counts, plus a migration-noting row),
 and the np=8 tree-vs-flat parity pytest (`ctrl-np8`).
 
@@ -277,7 +287,25 @@ WORKLOAD = textwrap.dedent("""
         assert m["counters"]["cycle_count"] > 0, m["counters"]
         assert m["histograms"]["negotiation_wait_us"]["count"] > 0, \
             m["histograms"]
-        assert hvd.metrics_prometheus().startswith("# TYPE")
+        assert hvd.metrics_prometheus().startswith("# HELP")
+
+    # fleet axis: the v11 sketch sections must have landed true fleet
+    # histograms on the coordinator, and the history endpoint must serve
+    # the fleethistory-v1 payload; "off" keeps both surfaces empty.
+    ft = os.environ.get("HOROVOD_FLEET_TELEMETRY", "")
+    if ft == "1":
+        if r == 0:
+            fleet = hvd.metrics().get("fleet") or {}
+            assert fleet.get("negotiation_wait_us", {}).get("count", 0) > 0, \
+                fleet
+            fh = hvd.fleet_history()
+            assert fh.get("schema") == "fleethistory-v1", fh
+            assert fh.get("tiers"), fh
+    elif ft == "0":
+        assert "fleet" not in (hvd.metrics() or {}), \
+            "fleet telemetry off but metrics carries a fleet section"
+        assert hvd.fleet_history() == {}, \
+            "fleet telemetry off but history non-empty"
 
     hvd.barrier()
     hvd.shutdown()
@@ -389,6 +417,10 @@ def combos(quick: bool):
         # with fleet attribution on the coordinator.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
                "def", "off", "off", "off", "on")
+        # fleet axis: the one quick on-combo — v11 sketch sections summed
+        # into coordinator fleet histograms + the history payload served.
+        yield ("jax", "native", 3, "on", "on", "shm", "none", "on", "auto",
+               "def", "off", "off", "off", "def", "on")
         yield ("jax", "native", 1, "on", "off", "shm", "none", "off")
         yield ("jax", "purepy", 1, "off", "on", "shm", "none", "off")
         yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -476,6 +508,18 @@ def combos(quick: bool):
            "def", "off", "off", "off", "on")
     yield ("jax", "native", 3, "off", "off", "tcp", "none", "off", "auto",
            "def", "off", "off", "off", "off")
+    # Fleet-telemetry axis: v11 sketch sections across controller shapes —
+    # flat shm, the flat TCP ring, and the v9 leader tree (host-summed
+    # sketches up the tree) — plus explicit off (no fleet section in the
+    # metrics dump, empty history payload).
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "on", "auto",
+           "def", "off", "off", "off", "def", "on")
+    yield ("jax", "native", 3, "off", "off", "tcp", "none", "on", "auto",
+           "def", "off", "off", "off", "def", "on")
+    yield ("jax", "native", 3, "on", "on", "hier", "none", "on", "on",
+           "def", "off", "off", "off", "def", "on")
+    yield ("jax", "native", 3, "on", "on", "shm", "none", "on", "auto",
+           "def", "off", "off", "off", "def", "off")
     # Torch-binding covering subset (same core spine underneath; a full
     # product would double the wall time for little marginal coverage).
     yield ("torch", "native", 2, "on", "on", "shm", "none", "off")
@@ -557,6 +601,15 @@ def checks(quick: bool):
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_step_trace.py")]],
            REPO, 600.0)
+    # Anomaly sentinel end to end at np=4: a persistent injected delay on
+    # one rank must raise a sentinel anomaly (flight type 15 + the
+    # autopilot journal) naming that rank strictly BEFORE the
+    # eviction-windows rule can fire, with /history showing the
+    # inflection; includes the fleet bucket-exactness assertions.
+    yield ("sentinel-np4",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel", "test_fleet_telemetry.py")]],
+           REPO, 600.0)
     # np=256 in-process control-plane soak: flat vs v9 tree coordinator
     # message counts (>= 8x cut at 256 ranks / 16 fake hosts) plus the
     # sharded rendezvous acceptors under the full HELLO herd.
@@ -589,7 +642,7 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
               autopilot: str, qdev: str, migrate: str, trace: str,
-              script: str, timeout: float) -> tuple:
+              fleet: str, script: str, timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -630,6 +683,10 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     env.pop("HOROVOD_STEP_TRACE_SLOTS", None)
     env.pop("HOROVOD_COCKPIT", None)
     env.pop("HOROVOD_COCKPIT_PORT", None)
+    # The fleet axis owns the v11 telemetry knobs; an ambient sentinel
+    # threshold would skew the anomaly-free expectation of "on" combos.
+    env.pop("HOROVOD_FLEET_TELEMETRY", None)
+    env.pop("HOROVOD_SENTINEL_ZSCORE", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -683,6 +740,13 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_STEP_TRACE"] = "1"
     elif trace == "off":
         env["HOROVOD_STEP_TRACE"] = "0"
+    if fleet == "on":
+        # The fleet plane rides the metrics registry: sketches encode the
+        # local histograms, so the combo forces the metrics plane on.
+        env["HOROVOD_FLEET_TELEMETRY"] = "1"
+        env["HOROVOD_METRICS"] = "1"
+    elif fleet == "off":
+        env["HOROVOD_FLEET_TELEMETRY"] = "0"
     if np_ == 1:
         cmd = [sys.executable, script]
     else:
@@ -736,17 +800,19 @@ def main() -> int:
                 combo = combo + ("off",)
             if len(combo) == 13:  # rows predating the trace axis
                 combo = combo + ("def",)
+            if len(combo) == 14:  # rows predating the fleet axis
+                combo = combo + ("def",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree, flight, autopilot, qdev, migrate, trace) = combo
+             tree, flight, autopilot, qdev, migrate, trace, fleet) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
                      f"flight={flight:<4} ap={autopilot} qdev={qdev} "
-                     f"mig={migrate} trace={trace}")
+                     f"mig={migrate} trace={trace} fleet={fleet}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
                                        autopilot, qdev, migrate, trace,
-                                       script=scripts[binding],
+                                       fleet, script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
                   flush=True)

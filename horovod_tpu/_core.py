@@ -66,6 +66,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_double, c.c_longlong, c.c_int, c.c_int,  # cycle fusion cache autotune
         c.c_char_p, c.c_int, c.c_int,              # autotune_log hierarchical wire_comp
         c.c_int,                                   # qdev_comp (-1 = no device plane)
+        c.c_int,                                   # qdev_sched (-1 = ring-only plane)
         c.c_int, c.c_char_p, c.c_double,           # metrics metrics_file interval
         c.c_char_p, c.c_int,                       # timeline mark
         c.c_double, c.c_double, c.c_int,           # stall_warn stall_shutdown log
@@ -194,6 +195,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     except AttributeError:
         pass
     try:
+        # Old-ABI tolerance: a stale .so predating the schedule coordinate
+        # loses only the qdev-schedule autotune poll.
+        lib.hvd_autotune_qsched.restype = c.c_int
+        lib.hvd_autotune_qsched.argtypes = []
+    except AttributeError:
+        pass
+    try:
         # Old-ABI tolerance: a stale .so predating the elastic-migration
         # plane loses the type-14 forensics and the generation gauge; the
         # migration protocol itself is Python-side and keeps working.
@@ -248,14 +256,28 @@ class NativeCore(CoreBackend):
         controller = cfg.controller
         if controller in ("auto",):
             controller = "socket" if cfg.size > 1 else "local"
-        # Device-plane codec: 0=none, 1=int8 from config; -1 pins the
-        # autotuner's qdev arm when no jax device plane can exist here.
-        qdev = {"none": 0, "int8": 1}.get(
+        # Device-plane codec: 0=none, 1=int8, 2=int4, 3=int8g from config;
+        # -1 pins the autotuner's qdev arm when no jax device plane can
+        # exist here.
+        qdev = {"none": 0, "int8": 1, "int4": 2, "int8g": 3}.get(
             getattr(cfg, "wire_compression_device", "none"), 0)
+        # Device-ring schedule: 0=ring, 1=bidi, 2=torus ("auto" resolves
+        # from the world size); -1 pins the autotuner's schedule arm when
+        # only the unidirectional ring is feasible (or no device plane).
+        try:
+            from .ops.collectives import resolve_device_schedule
+            resolved = resolve_device_schedule(
+                cfg.size, getattr(cfg, "device_schedule", "auto"))
+        except Exception:
+            resolved = "ring"
+        qsched = {"ring": 0, "bidi": 1, "torus": 2}.get(resolved, 0)
+        if cfg.size < 4:
+            qsched = -1  # bidi needs chunks >= 2, torus needs factors
         try:
             import jax  # noqa: F401
         except Exception:
             qdev = -1
+            qsched = -1
         rc = self._lib.hvd_init(
             cfg.rank, cfg.size, cfg.local_rank, cfg.local_size,
             controller.encode(), cfg.rendezvous_addr.encode(),
@@ -264,8 +286,9 @@ class NativeCore(CoreBackend):
             1 if cfg.autotune else 0,
             (cfg.autotune_log or "").encode(),
             1 if cfg.hierarchical_allreduce else 0,
-            {"none": 0, "bf16": 1, "int8": 2}.get(cfg.wire_compression, 0),
-            qdev,
+            {"none": 0, "bf16": 1, "int8": 2, "int4": 3, "int8g": 4}.get(
+                cfg.wire_compression, 0),
+            qdev, qsched,
             1 if cfg.metrics_enabled else 0,
             (cfg.metrics_file or "").encode(),
             cfg.metrics_interval_s,

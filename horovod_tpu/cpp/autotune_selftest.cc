@@ -36,19 +36,21 @@ double Surface(double x0, double x1, unsigned* rng) {
 int main() {
   {
     BayesianOptimizer bo;
-    // With the hierarchical, wire-compression, and device-codec knobs
-    // pinned (no multi-host topology, no device plane), the EI search
-    // must not waste probes on the dead arms.
+    // With the hierarchical, wire-compression, device-codec, and
+    // device-schedule knobs pinned (no multi-host topology, no device
+    // plane), the EI search must not waste probes on the dead arms.
     bo.set_tune_x3(false);
     bo.set_tune_x4(false);
     bo.set_tune_x5(false);
+    bo.set_tune_x6(false);
     unsigned rng = 12345;
     // First probe: a deliberately bad corner (tiny fusion, huge cycle).
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0;
     double first_score = Surface(x0, x1, &rng);
-    bo.AddSample(x0, x1, x2, x3, x4, x5, first_score);
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, first_score);
     for (int round = 0; round < 30; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
       if (x3 >= 0.5) {
         std::printf("FAIL: pinned x3 knob was explored\n");
         return 1;
@@ -57,14 +59,18 @@ int main() {
         std::printf("FAIL: pinned x4 knob was explored\n");
         return 1;
       }
-      if (x5 >= 0.5) {
+      if (x5 >= 1.0 / 6.0) {
         std::printf("FAIL: pinned x5 knob was explored\n");
         return 1;
       }
-      bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
+      if (x6 >= 0.25) {
+        std::printf("FAIL: pinned x6 knob was explored\n");
+        return 1;
+      }
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng));
     }
-    double bx0, bx1, bx2, bx3, bx4, bx5, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &best);
     std::printf("first=%.3e best=%.3e at (%.2f, %.2f, %.0f)\n", first_score,
                 best, bx0, bx1, bx2);
     // The optimum value is ~1e9; the bad corner scores ~0.  Require the
@@ -87,16 +93,18 @@ int main() {
     bo.set_tune_x3(false);
     bo.set_tune_x4(false);
     bo.set_tune_x5(false);
+    bo.set_tune_x6(false);
     unsigned rng = 777;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng));
     for (int round = 0; round < 30; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
       double s = Surface(x0, x1, &rng) * (x2 >= 0.5 ? 1.25 : 1.0);
-      bo.AddSample(x0, x1, x2, x3, x4, x5, s);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, s);
     }
-    double bx0, bx1, bx2, bx3, bx4, bx5, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &best);
     std::printf("categorical best=%.3e at (%.2f, %.2f, cat=%.0f)\n", best,
                 bx0, bx1, bx2);
     if (bx2 < 0.5) {
@@ -116,16 +124,18 @@ int main() {
     BayesianOptimizer bo;
     bo.set_tune_x4(false);
     bo.set_tune_x5(false);
+    bo.set_tune_x6(false);
     unsigned rng = 4242;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng));
     for (int round = 0; round < 40; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
       double s = Surface(x0, x1, &rng) * (x3 >= 0.5 ? 1.3 : 1.0);
-      bo.AddSample(x0, x1, x2, x3, x4, x5, s);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, s);
     }
-    double bx0, bx1, bx2, bx3, bx4, bx5, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &best);
     std::printf("hier best=%.3e at (%.2f, %.2f, cat=%.0f, hier=%.0f)\n",
                 best, bx0, bx1, bx2, bx3);
     if (bx3 < 0.5) {
@@ -145,16 +155,18 @@ int main() {
     // interior level, which a binary knob could not express.
     BayesianOptimizer bo;
     bo.set_tune_x5(false);
+    bo.set_tune_x6(false);
     unsigned rng = 31337;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng));
     for (int round = 0; round < 40; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
       double mult = x4 < 0.25 ? 1.0 : (x4 < 0.75 ? 1.35 : 1.15);
-      bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng) * mult);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng) * mult);
     }
-    double bx0, bx1, bx2, bx3, bx4, bx5, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &best);
     std::printf("wire best=%.3e at (%.2f, %.2f, wire=%.2f)\n", best, bx0,
                 bx1, bx4);
     if (bx4 < 0.25 || bx4 >= 0.75) {
@@ -167,31 +179,75 @@ int main() {
     }
   }
   {
-    // Device-codec arm: the x5=1 arm (int8 device-plane ring — quarter
-    // the ICI bytes on bandwidth-bound steps) scores 20% higher
-    // everywhere.  With the knob tunable, the optimizer must converge
-    // onto it.
+    // Device-codec arm: a 4-level categorical {none, int8, int4, int8g}
+    // where the interior int4 level (x5=2/3 — the deepest wire cut) is
+    // best: 30% over none, ahead of int8's 15% and int8g's 20% on this
+    // synthetic surface.  The optimizer must land on the interior codec
+    // level, which the old binary knob could not express.
     BayesianOptimizer bo;
     bo.set_tune_x3(false);
     bo.set_tune_x4(false);
+    bo.set_tune_x6(false);
     unsigned rng = 90210;
-    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0;
-    bo.AddSample(x0, x1, x2, x3, x4, x5, Surface(x0, x1, &rng));
-    for (int round = 0; round < 40; ++round) {
-      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
-      double s = Surface(x0, x1, &rng) * (x5 >= 0.5 ? 1.2 : 1.0);
-      bo.AddSample(x0, x1, x2, x3, x4, x5, s);
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng));
+    for (int round = 0; round < 60; ++round) {
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
+      double mult = x5 < 1.0 / 6.0
+                        ? 1.0
+                        : (x5 < 0.5 ? 1.15 : (x5 < 5.0 / 6.0 ? 1.3 : 1.2));
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng) * mult);
     }
-    double bx0, bx1, bx2, bx3, bx4, bx5, best;
-    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &best);
-    std::printf("qdev best=%.3e at (%.2f, %.2f, qdev=%.0f)\n", best, bx0,
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &best);
+    std::printf("qdev best=%.3e at (%.2f, %.2f, qdev=%.2f)\n", best, bx0,
                 bx1, bx5);
-    if (bx5 < 0.5) {
-      std::printf("FAIL: qdev knob did not converge to the better arm\n");
+    if (bx5 < 0.5 || bx5 >= 5.0 / 6.0) {
+      std::printf("FAIL: qdev knob did not converge to the int4 level\n");
       return 1;
     }
-    if (best < 0.8 * 1.2e9) {
+    if (best < 0.8 * 1.3e9) {
       std::printf("FAIL: qdev surface peak not approached\n");
+      return 1;
+    }
+  }
+  {
+    // Device-schedule arm: a 3-level categorical {ring, bidi, torus} where
+    // the middle bidi level (x6=0.5) is best — both ICI directions without
+    // torus's second-axis latency on this synthetic surface.  Tuned
+    // jointly with an active 4-level codec knob to exercise the full
+    // qdev x schedule grid.
+    BayesianOptimizer bo;
+    bo.set_tune_x3(false);
+    bo.set_tune_x4(false);
+    unsigned rng = 60606;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, Surface(x0, x1, &rng));
+    for (int round = 0; round < 60; ++round) {
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
+      double cmult = x5 < 1.0 / 6.0 ? 1.0 : 1.2;
+      double smult = x6 < 0.25 ? 1.0 : (x6 < 0.75 ? 1.3 : 1.1);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6,
+                   Surface(x0, x1, &rng) * cmult * smult);
+    }
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &best);
+    std::printf("sched best=%.3e at (%.2f, %.2f, qdev=%.2f, sched=%.2f)\n",
+                best, bx0, bx1, bx5, bx6);
+    if (bx6 < 0.25 || bx6 >= 0.75) {
+      std::printf("FAIL: schedule knob did not converge to the bidi "
+                  "level\n");
+      return 1;
+    }
+    if (bx5 < 1.0 / 6.0) {
+      std::printf("FAIL: codec knob did not engage alongside the "
+                  "schedule\n");
+      return 1;
+    }
+    if (best < 0.8 * 1.2 * 1.3e9) {
+      std::printf("FAIL: schedule surface peak not approached\n");
       return 1;
     }
   }

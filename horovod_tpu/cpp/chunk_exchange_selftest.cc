@@ -318,6 +318,148 @@ void CodecRingAccumulationBound() {
   }
 }
 
+// int4 block scaling: |decode(encode(x)) - x| <= scale/2 per element with
+// scale = blockmax/kWireInt4Max, nibble pack/unpack exact, and the
+// incremental consume path agreeing with a full decode byte-for-byte.
+void CodecInt4ErrorBound() {
+  std::mt19937 rng(0xCAFE);
+  std::uniform_real_distribution<float> mag(-50.f, 50.f);
+  // Full blocks + an ODD-length partial block (a lone low nibble in the
+  // last packed byte), plus an all-zero block.
+  const int64_t n = 3 * kWireBlock + 77;
+  std::vector<float> src(static_cast<size_t>(n));
+  for (auto& v : src) v = mag(rng);
+  for (int64_t i = kWireBlock; i < 2 * kWireBlock; ++i) src[i] = 0.0f;
+  std::vector<char> enc(
+      static_cast<size_t>(WireEncodedBytes(WireCodec::kInt4, n)));
+  WireEncode(WireCodec::kInt4, src.data(), n, enc.data());
+  std::vector<float> dec(static_cast<size_t>(n));
+  WireDecodeRange(WireCodec::kInt4, enc.data(), n, 0, n, dec.data());
+  for (int64_t b0 = 0; b0 < n; b0 += kWireBlock) {
+    const int64_t bn = std::min(kWireBlock, n - b0);
+    float maxabs = 0.f;
+    for (int64_t i = 0; i < bn; ++i) {
+      maxabs = std::max(maxabs, std::fabs(src[b0 + i]));
+    }
+    const float scale = maxabs / static_cast<float>(kWireInt4Max);
+    for (int64_t i = 0; i < bn; ++i) {
+      if (std::fabs(dec[b0 + i] - src[b0 + i]) > scale * 0.5f + 1e-12f) {
+        Fail("int4 block-scale error exceeds scale/2", -4);
+        return;
+      }
+    }
+  }
+  // Incremental decode across byte-level prefixes (nibble-granular tail).
+  int64_t decoded = 0;
+  std::vector<float> inc(static_cast<size_t>(n));
+  for (int64_t bytes = 0; bytes <= WireEncodedBytes(WireCodec::kInt4, n);
+       bytes += 13) {
+    const int64_t avail = WireDecodableElems(WireCodec::kInt4, bytes, n);
+    if (avail < decoded) {
+      Fail("int4 WireDecodableElems not monotone", -4);
+      return;
+    }
+    if (avail > decoded) {
+      WireDecodeRange(WireCodec::kInt4, enc.data(), n, decoded, avail,
+                      inc.data() + decoded);
+      decoded = avail;
+    }
+  }
+  const int64_t tail = WireDecodableElems(
+      WireCodec::kInt4, WireEncodedBytes(WireCodec::kInt4, n), n);
+  if (tail > decoded) {
+    WireDecodeRange(WireCodec::kInt4, enc.data(), n, decoded, tail,
+                    inc.data() + decoded);
+    decoded = tail;
+  }
+  if (decoded != n ||
+      std::memcmp(inc.data(), dec.data(), static_cast<size_t>(4 * n)) != 0) {
+    Fail("incremental int4 decode diverges from full decode", -4);
+  }
+}
+
+// int8g two-level scaling: |decode(encode(x)) - x| <= eff/2 per element
+// where eff = gscale * sub/kWireSubDenom is the per-block effective scale
+// actually stored on the wire; a short last group and an all-zero block
+// inside a finite group must round-trip; incremental decode must agree
+// with the full decode.
+void CodecInt8gErrorBound() {
+  std::mt19937 rng(0xD00D);
+  std::uniform_real_distribution<float> mag(-50.f, 50.f);
+  // One full group + a short group with a partial block; zero out one
+  // block inside the full group (sub-scale byte 0 path).
+  const int64_t n = kWireGroup + 5 * kWireBlock + 77;
+  std::vector<float> src(static_cast<size_t>(n));
+  for (auto& v : src) v = mag(rng);
+  for (int64_t i = 3 * kWireBlock; i < 4 * kWireBlock; ++i) src[i] = 0.0f;
+  // Spread magnitudes so sub-scales actually vary within a group.
+  for (int64_t i = 0; i < n; ++i) {
+    if ((i / kWireBlock) % 3 == 1) src[i] *= 0.01f;
+  }
+  std::vector<char> enc(
+      static_cast<size_t>(WireEncodedBytes(WireCodec::kInt8g, n)));
+  WireEncode(WireCodec::kInt8g, src.data(), n, enc.data());
+  std::vector<float> dec(static_cast<size_t>(n));
+  WireDecodeRange(WireCodec::kInt8g, enc.data(), n, 0, n, dec.data());
+  for (int64_t g0 = 0; g0 < n; g0 += kWireGroup) {
+    const int64_t gn = std::min(kWireGroup, n - g0);
+    float gmax = 0.f;
+    for (int64_t i = 0; i < gn; ++i) {
+      gmax = std::max(gmax, std::fabs(src[g0 + i]));
+    }
+    const float gscale = gmax / 127.0f;
+    for (int64_t b0 = 0; b0 < gn; b0 += kWireBlock) {
+      const int64_t bn = std::min(kWireBlock, gn - b0);
+      float bmax = 0.f;
+      for (int64_t i = 0; i < bn; ++i) {
+        bmax = std::max(bmax, std::fabs(src[g0 + b0 + i]));
+      }
+      const float s = std::min(
+          255.0f,
+          std::nearbyintf(bmax / gmax * static_cast<float>(kWireSubDenom)));
+      const float eff = gscale * (s / static_cast<float>(kWireSubDenom));
+      // Sub-scale rounding can sit eff slightly under bmax/127; allow the
+      // corresponding clipping slack (<= gscale/kWireSubDenom per unit
+      // code, codes bounded by 127).
+      const float slack =
+          127.0f * std::max(0.0f, bmax / 127.0f - eff) + 1e-12f;
+      for (int64_t i = 0; i < bn; ++i) {
+        if (std::fabs(dec[g0 + b0 + i] - src[g0 + b0 + i]) >
+            eff * 0.5f + slack) {
+          Fail("int8g two-level error exceeds eff/2", -4);
+          return;
+        }
+      }
+    }
+  }
+  int64_t decoded = 0;
+  std::vector<float> inc(static_cast<size_t>(n));
+  for (int64_t bytes = 0; bytes <= WireEncodedBytes(WireCodec::kInt8g, n);
+       bytes += 97) {
+    const int64_t avail = WireDecodableElems(WireCodec::kInt8g, bytes, n);
+    if (avail < decoded) {
+      Fail("int8g WireDecodableElems not monotone", -4);
+      return;
+    }
+    if (avail > decoded) {
+      WireDecodeRange(WireCodec::kInt8g, enc.data(), n, decoded, avail,
+                      inc.data() + decoded);
+      decoded = avail;
+    }
+  }
+  const int64_t tail = WireDecodableElems(
+      WireCodec::kInt8g, WireEncodedBytes(WireCodec::kInt8g, n), n);
+  if (tail > decoded) {
+    WireDecodeRange(WireCodec::kInt8g, enc.data(), n, decoded, tail,
+                    inc.data() + decoded);
+    decoded = tail;
+  }
+  if (decoded != n ||
+      std::memcmp(inc.data(), dec.data(), static_cast<size_t>(4 * n)) != 0) {
+    Fail("incremental int8g decode diverges from full decode", -4);
+  }
+}
+
 }  // namespace
 
 int main() {
@@ -326,6 +468,8 @@ int main() {
   Cancellation();
   CodecBf16RoundTrip();
   CodecInt8ErrorBound();
+  CodecInt4ErrorBound();
+  CodecInt8gErrorBound();
   CodecRingAccumulationBound();
   if (failures.load() != 0) {
     std::fprintf(stderr, "%d failure(s)\n", failures.load());

@@ -171,13 +171,20 @@ struct CoreConfig {
   // rides in each response), so per-rank divergence is harmless.
   bool hierarchical = false;
   // HOROVOD_WIRE_COMPRESSION: codec for cross-host ring hops (0=none,
-  // 1=bf16, 2=int8).  Coordinator-authoritative like `hierarchical`.
+  // 1=bf16, 2=int8, 3=int4, 4=int8g — hvdtpu::WireCodec).
+  // Coordinator-authoritative like `hierarchical`.
   int wire_compression = 0;
   // HOROVOD_WIRE_COMPRESSION device= plane: codec for in-jit / eager-XLA
-  // device collectives (0=none, 1=int8; -1 = no device plane, autotune
-  // arm pinned).  Enforced on the Python side; stored here so the
-  // autotuner's qdev coordinate starts from the configured value.
+  // device collectives (0=none, 1=int8, 2=int4, 3=int8g; -1 = no device
+  // plane, autotune arm pinned).  Enforced on the Python side; stored
+  // here so the autotuner's qdev coordinate starts from the configured
+  // value.
   int qdev_compression = 0;
+  // HOROVOD_DEVICE_SCHEDULE: device-ring schedule (0=ring, 1=bidi,
+  // 2=torus; -1 = schedule arm pinned — no device plane or a member count
+  // that only admits the unidirectional ring).  Enforced on the Python
+  // side like qdev_compression.
+  int qdev_schedule = 0;
   // HOROVOD_METRICS / HOROVOD_METRICS_FILE: enable the native metrics
   // registry; when metrics_file is non-empty the background loop writes a
   // JSON snapshot there every metrics_interval_s (a `{rank}` placeholder

@@ -374,7 +374,8 @@ void BackgroundLoop() {
                      << " announce_cache=" << g->params.announce_cache()
                      << " hierarchical=" << g->params.hierarchical()
                      << " wire_compression=" << g->params.wire_compression()
-                     << " qdev=" << g->params.qdev();
+                     << " qdev=" << g->params.qdev()
+                     << " qdev_sched=" << g->params.qdev_sched();
     }
 
     double now = MonotonicSeconds();
@@ -450,7 +451,7 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              const char* controller, const char* addr, int port,
              double cycle_ms, long long fusion, int cache_cap, int autotune,
              const char* autotune_log, int hierarchical, int wire_compression,
-             int qdev_compression,
+             int qdev_compression, int qdev_schedule,
              int metrics_enabled, const char* metrics_file,
              double metrics_interval_s, const char* timeline_path,
              int timeline_mark_cycles, double stall_warn_s,
@@ -475,12 +476,17 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   cfg.autotune_log = autotune_log ? autotune_log : "";
   cfg.hierarchical = hierarchical != 0;
   cfg.wire_compression =
-      wire_compression >= 0 && wire_compression <= 2 ? wire_compression : 0;
-  // Device-plane codec (0=none, 1=int8).  -1 means the caller has no
-  // device plane at all (no jax mesh): the knob is then pinned for the
-  // autotuner, not merely off.
+      wire_compression >= 0 && wire_compression <= 4 ? wire_compression : 0;
+  // Device-plane codec (0=none, 1=int8, 2=int4, 3=int8g).  -1 means the
+  // caller has no device plane at all (no jax mesh): the knob is then
+  // pinned for the autotuner, not merely off.
   cfg.qdev_compression =
-      qdev_compression >= -1 && qdev_compression <= 1 ? qdev_compression : 0;
+      qdev_compression >= -1 && qdev_compression <= 3 ? qdev_compression : 0;
+  // Device-ring schedule (0=ring, 1=bidi, 2=torus).  -1 pins the autotune
+  // arm: no device plane, or a member count that only admits the
+  // unidirectional ring.
+  cfg.qdev_schedule =
+      qdev_schedule >= -1 && qdev_schedule <= 2 ? qdev_schedule : 0;
   cfg.metrics_file = metrics_file ? metrics_file : "";
   cfg.metrics = metrics_enabled != 0 || !cfg.metrics_file.empty();
   cfg.metrics_interval_s = metrics_interval_s > 0 ? metrics_interval_s : 10.0;
@@ -591,10 +597,15 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // reported a usable device plane (qdev >= 0); -1 pins the arm.
     bool qdev_tunable = cfg.qdev_compression >= 0;
     int qdev_comp = cfg.qdev_compression >= 0 ? cfg.qdev_compression : 0;
+    // Device-ring schedule coordinate: pinned alongside qdev, and also
+    // when the Python side reported only the unidirectional ring is
+    // feasible for the plane's member count (-1).
+    bool sched_tunable = qdev_tunable && cfg.qdev_schedule >= 0;
+    int qdev_sched = cfg.qdev_schedule >= 0 ? cfg.qdev_schedule : 0;
     g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log,
                          cfg.hierarchical, hier_tunable,
                          cfg.wire_compression, wire_tunable,
-                         qdev_comp, qdev_tunable);
+                         qdev_comp, qdev_tunable, qdev_sched, sched_tunable);
   }
   g->background = std::thread(BackgroundLoop);
   return 0;
@@ -936,13 +947,21 @@ void hvd_device_plane_stats(long long* raw_bytes, long long* encoded_bytes) {
   *encoded_bytes = m.device_encoded_bytes.load(std::memory_order_relaxed);
 }
 
-// The autotuner's current device-plane codec decision (0=none, 1=int8;
-// -1 = not initialized).  The Python side polls it between steps and
-// re-traces with the int8 ring when it flips — the device plane's analog
-// of SetWireCompression on the host ring.
+// The autotuner's current device-plane codec decision (0=none, 1=int8,
+// 2=int4, 3=int8g; -1 = not initialized).  The Python side polls it
+// between steps and re-traces with the quantized ring when it flips — the
+// device plane's analog of SetWireCompression on the host ring.
 int hvd_autotune_qdev() {
   if (g == nullptr) return -1;
   return g->params.qdev();
+}
+
+// The autotuner's current device-ring schedule decision (0=ring, 1=bidi,
+// 2=torus; -1 = not initialized).  Polled together with
+// hvd_autotune_qdev().
+int hvd_autotune_qsched() {
+  if (g == nullptr) return -1;
+  return g->params.qdev_sched();
 }
 
 // Full local metrics registry as one JSON object; on the coordinator the

@@ -59,14 +59,33 @@ double WireToX4(int wire) {
   return kWireLevels[std::min(2, std::max(0, wire))];
 }
 
+// x5 <-> device codec: {0, 1/3, 2/3, 1} for {none, int8, int4, int8g} —
+// ordinal in codec aggressiveness so adjacent codecs share GP shape.
+constexpr double kQdevLevels[4] = {0.0, 1.0 / 3.0, 2.0 / 3.0, 1.0};
+int X5ToQdev(double x5) {
+  return x5 < 1.0 / 6.0 ? 0 : (x5 < 0.5 ? 1 : (x5 < 5.0 / 6.0 ? 2 : 3));
+}
+double QdevToX5(int qdev) {
+  return kQdevLevels[std::min(3, std::max(0, qdev))];
+}
+
+// x6 <-> device-ring schedule: {0, 0.5, 1} for {ring, bidi, torus} —
+// ordinal in parallelism (one ICI direction, both, both axes of a torus).
+constexpr double kSchedLevels[3] = {0.0, 0.5, 1.0};
+int X6ToSched(double x6) { return x6 < 0.25 ? 0 : (x6 < 0.75 ? 1 : 2); }
+double SchedToX6(int sched) {
+  return kSchedLevels[std::min(2, std::max(0, sched))];
+}
+
 double Rbf(double ax, double ay, double az, double aw, double av, double au,
-           double bx, double by, double bz, double bw, double bv, double bu) {
+           double at, double bx, double by, double bz, double bw, double bv,
+           double bu, double bt) {
   double dx = ax - bx, dy = ay - by, dz = kCatScale * (az - bz),
          dw = kCatScale * (aw - bw), dv = kCatScale * (av - bv),
-         du = kCatScale * (au - bu);
-  return std::exp(
-      -(dx * dx + dy * dy + dz * dz + dw * dw + dv * dv + du * du) /
-      (2 * kLengthscale * kLengthscale));
+         du = kCatScale * (au - bu), dt = kCatScale * (at - bt);
+  return std::exp(-(dx * dx + dy * dy + dz * dz + dw * dw + dv * dv +
+                    du * du + dt * dt) /
+                  (2 * kLengthscale * kLengthscale));
 }
 
 // Standard normal pdf/cdf for Expected Improvement.
@@ -80,8 +99,9 @@ double phi(double z) {
 // ---- BayesianOptimizer -----------------------------------------------------
 
 void BayesianOptimizer::AddSample(double x0, double x1, double x2, double x3,
-                                  double x4, double x5, double score) {
-  xs_.push_back({x0, x1, x2, x3, x4, x5});
+                                  double x4, double x5, double x6,
+                                  double score) {
+  xs_.push_back({x0, x1, x2, x3, x4, x5, x6});
   ys_.push_back(score);
   y_max_ = std::max(y_max_, std::abs(score));
   FitGP();
@@ -96,8 +116,8 @@ void BayesianOptimizer::FitGP() {
   for (int i = 0; i < n; ++i) {
     for (int j = 0; j <= i; ++j) {
       double k = Rbf(xs_[i].x0, xs_[i].x1, xs_[i].x2, xs_[i].x3, xs_[i].x4,
-                     xs_[i].x5, xs_[j].x0, xs_[j].x1, xs_[j].x2, xs_[j].x3,
-                     xs_[j].x4, xs_[j].x5);
+                     xs_[i].x5, xs_[i].x6, xs_[j].x0, xs_[j].x1, xs_[j].x2,
+                     xs_[j].x3, xs_[j].x4, xs_[j].x5, xs_[j].x6);
       if (i == j) k += kNoise;
       chol_[i * n + j] = k;
     }
@@ -128,7 +148,7 @@ void BayesianOptimizer::FitGP() {
 }
 
 void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
-                                double x4, double x5, double* mean,
+                                double x4, double x5, double x6, double* mean,
                                 double* var) const {
   const int n = static_cast<int>(xs_.size());
   if (n == 0) {
@@ -138,8 +158,8 @@ void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
   }
   std::vector<double> kstar(n);
   for (int i = 0; i < n; ++i) {
-    kstar[i] = Rbf(x0, x1, x2, x3, x4, x5, xs_[i].x0, xs_[i].x1, xs_[i].x2,
-                   xs_[i].x3, xs_[i].x4, xs_[i].x5);
+    kstar[i] = Rbf(x0, x1, x2, x3, x4, x5, x6, xs_[i].x0, xs_[i].x1,
+                   xs_[i].x2, xs_[i].x3, xs_[i].x4, xs_[i].x5, xs_[i].x6);
   }
   double m = 0;
   for (int i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
@@ -157,15 +177,20 @@ void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
 }
 
 void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
-                                double* x3, double* x4, double* x5) {
+                                double* x3, double* x4, double* x5,
+                                double* x6) {
   // Seed phase: spread the first probes over the categories before
   // trusting the GP (the reference warms its GP with a fixed design too).
-  // When x3/x4/x5 are pinned, their seed columns collapse to 0 so no
-  // probe is wasted on a dead arm.
-  static const double kSeeds[][6] = {
-      {0.15, 0.15, 0, 0, 0, 0},    {0.85, 0.15, 1, 1, 1, 1},
-      {0.5, 0.5, 0, 1, 0.5, 0},    {0.5, 0.5, 1, 0, 1, 1},
-      {0.15, 0.85, 0, 1, 0.5, 1},  {0.85, 0.85, 1, 0, 0, 0}};
+  // When x3/x4/x5/x6 are pinned, their seed columns collapse to 0 so no
+  // probe is wasted on a dead arm.  The x5 column walks all four codec
+  // levels and the x6 column all three schedules.
+  static const double kSeeds[][7] = {
+      {0.15, 0.15, 0, 0, 0, 0, 0},
+      {0.85, 0.15, 1, 1, 1, 1, 1},
+      {0.5, 0.5, 0, 1, 0.5, 1.0 / 3.0, 0.5},
+      {0.5, 0.5, 1, 0, 1, 2.0 / 3.0, 1},
+      {0.15, 0.85, 0, 1, 0.5, 1, 0.5},
+      {0.85, 0.85, 1, 0, 0, 2.0 / 3.0, 0}};
   const int n = num_samples();
   if (n < 6) {
     *x0 = kSeeds[n][0];
@@ -174,42 +199,50 @@ void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
     *x3 = tune_x3_ ? kSeeds[n][3] : 0.0;
     *x4 = tune_x4_ ? kSeeds[n][4] : 0.0;
     *x5 = tune_x5_ ? kSeeds[n][5] : 0.0;
+    *x6 = tune_x6_ ? kSeeds[n][6] : 0.0;
     return;
   }
   const double denom = y_max_ > 0 ? y_max_ : 1.0;
   double best_y = *std::max_element(ys_.begin(), ys_.end()) / denom;
   double best_ei = -1, bx = 0.5, by = 0.5, bz = 1.0, bw = 0.0, bv = 0.0,
-         bu = 0.0;
+         bu = 0.0, bt = 0.0;
   const int cat3_max = tune_x3_ ? 1 : 0;
   const int cat4_max = tune_x4_ ? 2 : 0;
-  const int cat5_max = tune_x5_ ? 1 : 0;
-  for (int cat5 = 0; cat5 <= cat5_max; ++cat5) {
-    for (int cat4 = 0; cat4 <= cat4_max; ++cat4) {
-      for (int cat3 = 0; cat3 <= cat3_max; ++cat3) {
-        for (int cat = 0; cat <= 1; ++cat) {
-          for (int i = 0; i <= kGrid; ++i) {
-            for (int j = 0; j <= kGrid; ++j) {
-              // Deterministic jitter decorrelates the grid across rounds.
-              rng_ = rng_ * 1664525u + 1013904223u;
-              double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-              rng_ = rng_ * 1664525u + 1013904223u;
-              double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-              double cx = std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
-              double cy = std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
-              double mean, var;
-              Predict(cx, cy, cat, cat3, kWireLevels[cat4], cat5, &mean,
-                      &var);
-              double sd = std::sqrt(var);
-              double z = (mean - best_y - 0.01) / sd;
-              double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
-              if (ei > best_ei) {
-                best_ei = ei;
-                bx = cx;
-                by = cy;
-                bz = cat;
-                bw = cat3;
-                bv = kWireLevels[cat4];
-                bu = cat5;
+  const int cat5_max = tune_x5_ ? 3 : 0;
+  const int cat6_max = tune_x6_ ? 2 : 0;
+  for (int cat6 = 0; cat6 <= cat6_max; ++cat6) {
+    for (int cat5 = 0; cat5 <= cat5_max; ++cat5) {
+      for (int cat4 = 0; cat4 <= cat4_max; ++cat4) {
+        for (int cat3 = 0; cat3 <= cat3_max; ++cat3) {
+          for (int cat = 0; cat <= 1; ++cat) {
+            for (int i = 0; i <= kGrid; ++i) {
+              for (int j = 0; j <= kGrid; ++j) {
+                // Deterministic jitter decorrelates the grid across
+                // rounds.
+                rng_ = rng_ * 1664525u + 1013904223u;
+                double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+                rng_ = rng_ * 1664525u + 1013904223u;
+                double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+                double cx =
+                    std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
+                double cy =
+                    std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
+                double mean, var;
+                Predict(cx, cy, cat, cat3, kWireLevels[cat4],
+                        kQdevLevels[cat5], kSchedLevels[cat6], &mean, &var);
+                double sd = std::sqrt(var);
+                double z = (mean - best_y - 0.01) / sd;
+                double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
+                if (ei > best_ei) {
+                  best_ei = ei;
+                  bx = cx;
+                  by = cy;
+                  bz = cat;
+                  bw = cat3;
+                  bv = kWireLevels[cat4];
+                  bu = kQdevLevels[cat5];
+                  bt = kSchedLevels[cat6];
+                }
               }
             }
           }
@@ -223,16 +256,19 @@ void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
   *x3 = bw;
   *x4 = bv;
   *x5 = bu;
+  *x6 = bt;
 }
 
 void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
-                             double* x4, double* x5, double* score) const {
+                             double* x4, double* x5, double* x6,
+                             double* score) const {
   if (ys_.empty()) {
     *x0 = *x1 = 0.5;
     *x2 = 1.0;
     *x3 = 0.0;
     *x4 = 0.0;
     *x5 = 0.0;
+    *x6 = 0.0;
     *score = 0;
     return;
   }
@@ -243,6 +279,7 @@ void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
   *x3 = xs_[i].x3;
   *x4 = xs_[i].x4;
   *x5 = xs_[i].x5;
+  *x6 = xs_[i].x6;
   *score = ys_[i];
 }
 
@@ -253,7 +290,8 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
                                   const std::string& log_path,
                                   bool hierarchical, bool hier_tunable,
                                   int wire_comp, bool wire_tunable,
-                                  int qdev_comp, bool qdev_tunable) {
+                                  int qdev_comp, bool qdev_tunable,
+                                  int qdev_sched, bool sched_tunable) {
   fusion_ = best_fusion_ = fusion_threshold;
   cycle_ms_ = best_cycle_ = cycle_time_ms;
   hier_tunable_ = hier_tunable;
@@ -263,8 +301,13 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
   wire_use_ = best_wire_ = wire_tunable ? wire_comp : 0;
   bo_.set_tune_x4(wire_tunable);
   qdev_tunable_ = qdev_tunable;
-  qdev_use_ = best_qdev_ = qdev_tunable ? (qdev_comp != 0 ? 1 : 0) : 0;
+  qdev_use_ = best_qdev_ =
+      qdev_tunable ? std::min(3, std::max(0, qdev_comp)) : 0;
   bo_.set_tune_x5(qdev_tunable);
+  sched_tunable_ = sched_tunable;
+  qdev_sched_use_ = best_qdev_sched_ =
+      sched_tunable ? std::min(2, std::max(0, qdev_sched)) : 0;
+  bo_.set_tune_x6(sched_tunable);
   window_start_ = MonotonicSeconds();
   active_ = true;
   if (!log_path.empty()) {
@@ -272,7 +315,7 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
     if (log_) {
       std::fputs(
           "time_s,fusion_bytes,cycle_ms,cache_use,hier,wire_comp,qdev,"
-          "score_bytes_per_s\n",
+          "sched,score_bytes_per_s\n",
           log_);
     }
   }
@@ -286,10 +329,10 @@ void ParameterManager::RecordBytes(int64_t bytes) { bytes_ += bytes; }
 
 void ParameterManager::Log(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%d,%d,%d,%.1f\n", MonotonicSeconds(),
-               static_cast<long long>(fusion_), cycle_ms_,
+  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%d,%d,%d,%d,%.1f\n",
+               MonotonicSeconds(), static_cast<long long>(fusion_), cycle_ms_,
                cache_use_ ? 1 : 0, hier_use_ ? 1 : 0, wire_use_, qdev_use_,
-               score);
+               qdev_sched_use_, score);
   std::fflush(log_);
 }
 
@@ -303,7 +346,8 @@ void ParameterManager::Score(double score) {
   }
   bo_.AddSample(FusionToX(fusion_), CycleToX(cycle_ms_),
                 cache_use_ ? 1.0 : 0.0, hier_use_ ? 1.0 : 0.0,
-                WireToX4(wire_use_), qdev_use_ ? 1.0 : 0.0, score);
+                WireToX4(wire_use_), QdevToX5(qdev_use_),
+                SchedToX6(qdev_sched_use_), score);
   if (score > best_score_ * 1.02) {
     windows_since_best_ = 0;
   } else {
@@ -317,6 +361,7 @@ void ParameterManager::Score(double score) {
     best_hier_ = hier_use_;
     best_wire_ = wire_use_;
     best_qdev_ = qdev_use_;
+    best_qdev_sched_ = qdev_sched_use_;
   }
   // Converge (reference: ParameterManager stops tuning once samples stop
   // improving): lock in the best configuration instead of exploring
@@ -331,22 +376,25 @@ void ParameterManager::Score(double score) {
     hier_use_ = best_hier_;
     wire_use_ = best_wire_;
     qdev_use_ = best_qdev_;
+    qdev_sched_use_ = best_qdev_sched_;
     HVD_LOG(INFO) << "autotune converged: fusion=" << fusion_
                   << " cycle_ms=" << cycle_ms_
                   << " announce_cache=" << (cache_use_ ? 1 : 0)
                   << " hierarchical=" << (hier_use_ ? 1 : 0)
                   << " wire_compression=" << wire_use_
-                  << " qdev=" << qdev_use_;
+                  << " qdev=" << qdev_use_
+                  << " qdev_sched=" << qdev_sched_use_;
     return;
   }
-  double x0, x1, x2, x3, x4, x5;
-  bo_.Suggest(&x0, &x1, &x2, &x3, &x4, &x5);
+  double x0, x1, x2, x3, x4, x5, x6;
+  bo_.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
   fusion_ = XToFusion(x0);
   cycle_ms_ = XToCycle(x1);
   cache_use_ = x2 >= 0.5;
   hier_use_ = hier_tunable_ && x3 >= 0.5;
   wire_use_ = wire_tunable_ ? X4ToWire(x4) : 0;
-  qdev_use_ = qdev_tunable_ && x5 >= 0.5 ? 1 : 0;
+  qdev_use_ = qdev_tunable_ ? X5ToQdev(x5) : 0;
+  qdev_sched_use_ = sched_tunable_ ? X6ToSched(x6) : 0;
 }
 
 bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
@@ -362,16 +410,18 @@ bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
   bool old_hier = hier_use_;
   int old_wire = wire_use_;
   int old_qdev = qdev_use_;
+  int old_sched = qdev_sched_use_;
   Score(score);
   *fusion_threshold = fusion_;
   *cycle_time_ms = cycle_ms_;
-  // cache_use_/hier_use_/wire_use_/qdev_use_ participate: a categorical-
-  // only proposal must still be applied by the caller, or the next
-  // window's GP sample would be labeled with a setting that was never in
-  // effect.
+  // cache_use_/hier_use_/wire_use_/qdev_use_/qdev_sched_use_ participate:
+  // a categorical-only proposal must still be applied by the caller, or
+  // the next window's GP sample would be labeled with a setting that was
+  // never in effect.
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
          cache_use_ != old_cache || hier_use_ != old_hier ||
-         wire_use_ != old_wire || qdev_use_ != old_qdev;
+         wire_use_ != old_wire || qdev_use_ != old_qdev ||
+         qdev_sched_use_ != old_sched;
 }
 
 }  // namespace hvdtpu

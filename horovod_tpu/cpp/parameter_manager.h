@@ -19,28 +19,30 @@
 namespace hvdtpu {
 
 // Gaussian-process regression + Expected Improvement over two continuous
-// knobs on the unit square plus four CATEGORICAL knobs (reference:
+// knobs on the unit square plus five CATEGORICAL knobs (reference:
 // ParameterManager also tunes categorical flags like cache/hierarchical
 // allreduce — categorical coordinates in the same GP are the cheap
 // TPU-native form; x2 = announce-cache {0,1}, x3 = hierarchical allreduce
 // {0,1}, x4 = wire compression {0, 0.5, 1} for {none, bf16, int8},
-// x5 = device-plane int8 codec {0,1}).
+// x5 = device-plane codec {0, 1/3, 2/3, 1} for {none, int8, int4, int8g}
+// (ordinal in codec aggressiveness like x4), x6 = device-ring schedule
+// {0, 0.5, 1} for {ring, bidi, torus}).
 // Exposed for the synthetic-surface self-test (autotune_selftest.cc).
 class BayesianOptimizer {
  public:
-  // Observations are (x in [0,1]^2, x2/x3/x5 in {0,1}, x4 in {0,0.5,1},
-  // score); scores are internally max-normalized so the kernel scales
-  // stay dimensionless.
+  // Observations are (x in [0,1]^2, x2/x3 in {0,1}, x4/x6 in {0,0.5,1},
+  // x5 in {0,1/3,2/3,1}, score); scores are internally max-normalized so
+  // the kernel scales stay dimensionless.
   void AddSample(double x0, double x1, double x2, double x3, double x4,
-                 double x5, double score);
+                 double x5, double x6, double score);
   // Next point to try: argmax EI over a jittered grid x the categorical
   // levels.  Falls back to latin-square-ish seed points for the first few
   // calls.
   void Suggest(double* x0, double* x1, double* x2, double* x3, double* x4,
-               double* x5);
+               double* x5, double* x6);
   // Best observed sample.
   void Best(double* x0, double* x1, double* x2, double* x3, double* x4,
-            double* x5, double* score) const;
+            double* x5, double* x6, double* score) const;
   int num_samples() const { return static_cast<int>(xs_.size()); }
   // When the x3 knob cannot take effect (topology not hierarchical), pin
   // it to 0 so the EI search does not waste half its grid on a dead arm.
@@ -49,14 +51,17 @@ class BayesianOptimizer {
   void set_tune_x4(bool v) { tune_x4_ = v; }
   // Same pinning rule for x5 (device-plane codec: no usable device plane).
   void set_tune_x5(bool v) { tune_x5_ = v; }
+  // Same pinning rule for x6 (device-ring schedule: no device plane, or a
+  // member count that admits only the unidirectional ring).
+  void set_tune_x6(bool v) { tune_x6_ = v; }
 
  private:
   void FitGP();
   void Predict(double x0, double x1, double x2, double x3, double x4,
-               double x5, double* mean, double* var) const;
+               double x5, double x6, double* mean, double* var) const;
 
   struct Pt {
-    double x0, x1, x2, x3, x4, x5;
+    double x0, x1, x2, x3, x4, x5, x6;
   };
   std::vector<Pt> xs_;
   std::vector<double> ys_;      // raw scores
@@ -67,6 +72,7 @@ class BayesianOptimizer {
   bool tune_x3_ = true;
   bool tune_x4_ = true;
   bool tune_x5_ = true;
+  bool tune_x6_ = true;
 };
 
 class ParameterManager {
@@ -77,13 +83,17 @@ class ParameterManager {
   // the GP never explores that arm.  wire_comp / wire_tunable: same pair
   // for the wire-compression codec (0=none, 1=bf16, 2=int8), pinned when
   // no all-cross-host ring exists.  qdev_comp / qdev_tunable: same pair
-  // for the device-plane int8 codec (0=none, 1=int8), pinned when the
-  // process has no usable jax device plane.
+  // for the device-plane codec (0=none, 1=int8, 2=int4, 3=int8g), pinned
+  // when the process has no usable jax device plane.  qdev_sched /
+  // sched_tunable: same pair for the device-ring schedule (0=ring,
+  // 1=bidi, 2=torus), pinned alongside qdev or when the plane's member
+  // count admits only the unidirectional ring.
   void Initialize(int64_t fusion_threshold, double cycle_time_ms,
                   const std::string& log_path, bool hierarchical = false,
                   bool hier_tunable = false, int wire_comp = 0,
                   bool wire_tunable = false, int qdev_comp = 0,
-                  bool qdev_tunable = false);
+                  bool qdev_tunable = false, int qdev_sched = 0,
+                  bool sched_tunable = false);
   ~ParameterManager();
 
   // Record bytes covered by emitted responses.
@@ -110,11 +120,16 @@ class ParameterManager {
   // (0=none, 1=bf16, 2=int8 — hvdtpu::WireCodec).  Coordinator-only for
   // the same reason as hierarchical().
   int wire_compression() const { return wire_use_; }
-  // Categorical knob: device-plane int8 codec (0=none, 1=int8).  The
-  // Python side polls it and flips the in-jit/eager quantized ring on the
-  // next trace; per-rank consistent because config (and therefore the
-  // tunable bit) is rank-uniform.
+  // Categorical knob: device-plane codec (0=none, 1=int8, 2=int4,
+  // 3=int8g — ops/quantize.py's DEVICE_WIRE_CODECS order).  The Python
+  // side polls it and flips the in-jit/eager quantized ring on the next
+  // trace; per-rank consistent because config (and therefore the tunable
+  // bit) is rank-uniform.
   int qdev() const { return qdev_use_; }
+  // Categorical knob: device-ring schedule (0=ring, 1=bidi, 2=torus —
+  // ops/collectives.py's resolve_device_schedule codomain).  Polled by
+  // the Python side together with qdev().
+  int qdev_sched() const { return qdev_sched_use_; }
 
  private:
   void Score(double score);
@@ -134,6 +149,8 @@ class ParameterManager {
   bool wire_tunable_ = false;
   int qdev_use_ = 0;
   bool qdev_tunable_ = false;
+  int qdev_sched_use_ = 0;
+  bool sched_tunable_ = false;
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
@@ -141,6 +158,7 @@ class ParameterManager {
   bool best_hier_ = false;
   int best_wire_ = 0;
   int best_qdev_ = 0;
+  int best_qdev_sched_ = 0;
   int warmup_windows_ = 1;
   int windows_since_best_ = 0;
   bool converged_ = false;

@@ -243,12 +243,16 @@ class DevicePlane:
 
     def _device_codec(self, rop: ReduceOp, dtype, length: int,
                       k: int) -> str:
-        """``"int8"`` when this fused bucket should ride the quantized ring,
-        else ``"none"``.  Demotion rules mirror the traced path (fp32 Sum/
+        """The configured block-scaled codec (``int8``/``int4``/``int8g``)
+        when this fused bucket should ride the quantized ring, else
+        ``"none"``.  Demotion rules mirror the traced path (fp32 Sum/
         Average, payload >= HOROVOD_WIRE_COMPRESSION_MIN_BYTES, k > 1); the
         codec comes from config, which negotiation keeps rank-uniform, so
         every member picks the same program."""
-        if getattr(self._cfg, "wire_compression_device", "none") != "int8":
+        from . import quantize as _qz
+
+        codec = getattr(self._cfg, "wire_compression_device", "none")
+        if codec not in _qz.DEVICE_WIRE_CODECS or codec == "none":
             return "none"
         if k <= 1 or rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             return "none"
@@ -258,18 +262,29 @@ class DevicePlane:
                                 1 << 16))
         if length * 4 < min_bytes:
             return "none"
-        return "int8"
+        return codec
+
+    def _device_schedule(self, k: int) -> str:
+        """Resolved ring schedule (``ring``/``bidi``/``torus``) for a
+        ``k``-member plane — config's ``device_schedule`` (``auto`` picks
+        from the member count) with infeasible choices demoted, so the
+        value is a pure function of rank-uniform state."""
+        from .collectives import resolve_device_schedule
+
+        sched = getattr(self._cfg, "device_schedule", "auto")
+        return resolve_device_schedule(k, sched)
 
     def _collective(self, psid: int, mesh, rop: ReduceOp, dtype, length: int,
-                    codec: str = "none"):
+                    codec: str = "none", schedule: str = "ring"):
         """Cached jitted fused-allreduce program over (k, L) global arrays:
         every member's [1, L] shard in, every member's reduced [1, L] shard
         out (out_specs stay device-varying so one program shape serves all
-        reduce ops).  ``codec="int8"`` swaps the psum for the block-scaled
-        quantized ring (ops.quantize semantics; callers pre-filter via
-        _device_codec)."""
+        reduce ops).  A block-scaled ``codec`` swaps the psum for the
+        quantized ring under the resolved ``schedule`` (ops.quantize
+        semantics; callers pre-filter via _device_codec /
+        _device_schedule)."""
         key = (psid, "ar", int(rop), str(np.dtype(dtype)), length, codec,
-               tuple(d.id for d in mesh.devices.flat))
+               schedule, tuple(d.id for d in mesh.devices.flat))
 
         def build():
             import jax
@@ -282,10 +297,11 @@ class DevicePlane:
             k = int(mesh.devices.size)
 
             def inner(x):  # [1, L]: this member's shard
-                if codec == "int8":
+                if codec != "none":
                     from .collectives import _quantized_ring_allreduce_sum
 
-                    out = _quantized_ring_allreduce_sum(x[0], AXIS)[None]
+                    out = _quantized_ring_allreduce_sum(
+                        x[0], AXIS, None, codec, schedule)[None]
                     if rop == ReduceOp.AVERAGE:
                         out = out / k
                 elif rop == ReduceOp.SUM:
@@ -632,19 +648,22 @@ class DevicePlane:
             self._pack()(tuple(arrays), float(pre), length), my_dev)
         garr = self._to_global(mesh, [packed])
         codec = self._device_codec(rop, dtype, length, len(ranks))
-        out = self._collective(psid, mesh, rop, dtype, length, codec)(garr)
+        schedule = self._device_schedule(len(ranks))
+        out = self._collective(psid, mesh, rop, dtype, length, codec,
+                               schedule)(garr)
         row = self._shard_on(out, my_dev)
         shapes = tuple(tuple(e.device_array.shape) for e in entries)
         results = self._unpack()(row, float(post), shapes)
         for e, r in zip(entries, results):
             e.result = r
-        if codec == "int8":
+        if codec != "none":
             from . import quantize as _qz
 
-            _qz.note_device_bytes(*_qz.ring_bytes(length, len(ranks)))
+            _qz.note_device_bytes(
+                *_qz.ring_bytes(length, len(ranks), codec, schedule))
         with self._lock:
             self.stats["allreduce"] += 1
-            if codec == "int8":
+            if codec != "none":
                 self.stats["quantized"] += 1
 
     def _exec_reducescatter(self, resp, entry) -> None:

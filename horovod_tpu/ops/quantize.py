@@ -1,26 +1,46 @@
-"""Int8 block-scaled quantization for the device plane (traced/XLA path).
+"""Block-scaled quantization codecs for the device plane (traced/XLA path).
 
-This is the in-``jit`` mirror of the host ring's int8 wire codec
-(``cpp/wire_codec.h``): the same 256-element block geometry, the same
-``scale = max|x| / 127`` rule, and the same all-zero / non-finite-block
-handling, so a tensor quantized on the device plane decodes to exactly the
-values the host codec would have produced.  EQuARX (PAPERS.md) is the
-design reference: block-scaled int8 inside the XLA program keeps the
-compression on-chip — no host transfers — while fp32 accumulation between
-hops preserves reduction accuracy.
+This is the in-``jit`` mirror of the host ring's block-scaled wire codecs
+(``cpp/wire_codec.h``): the same block geometry, the same scale rules, and
+the same all-zero / non-finite-block handling, so a tensor quantized on the
+device plane decodes to exactly the values the host codec would have
+produced.  EQuARX (PAPERS.md) is the design reference: block-scaled codes
+inside the XLA program keep the compression on-chip — no host transfers —
+while fp32 accumulation between hops preserves reduction accuracy.
+
+Three device codecs:
+
+- ``int8``: one fp32 scale per 256-element block, ``scale = max|x| / 127``.
+- ``int4``: the same block scale with 4-bit codes packed two per byte
+  (``scale = max|x| / WIRE_INT4_MAX``); on the wire this is ~0.13x raw.
+- ``int8g``: EQuARX-style two-level scales — one fp32 scale per
+  4096-element group (``WIRE_GROUP``) plus one uint8 sub-scale per block:
+  ``group scale = max|group|/127``, ``sub = round(max|block|/max|group| *
+  WIRE_SUB_DENOM)`` clamped to 255, effective block scale ``= group_scale
+  * sub/WIRE_SUB_DENOM``.  The denominator is a power of two (256) so the
+  effective scale is bit-stable under any multiply association order —
+  every rank recomputing ``eff`` from the same wire bytes gets the same
+  bits regardless of how the compiler fuses the expression (a /127
+  denominator is 1-ulp sensitive to reassociation, which breaks cross-rank
+  bit-identity when encoded payloads are forwarded verbatim).  Per-block
+  granularity at ~1/4 of int8's scale overhead.
 
 Layout: a flat fp32 tensor is viewed as ``[nblocks, WIRE_BLOCK]`` (the last
 block zero-padded; zeros cannot raise ``max|x|``, so a short last block
 quantizes exactly as the byte-stream codec quantizes it).  Quantization
-yields an int8 code array plus one fp32 scale per block — together the
-traced analog of the wire stream's ``[scale][codes]`` block records, and
-what actually rides ``lax.ppermute`` between devices.
+yields a code array plus scales — for int8/int4 one fp32 per block, for
+int8g a ``(sub, group_scale)`` pair — together the traced analog of the
+wire stream's records, and what actually rides ``lax.ppermute`` between
+devices.
 
 The kernels are Pallas with the same dispatch rules as
 ``ops/flash_attention.py``: on TPU the Pallas kernel runs natively,
 off-TPU the public entry points fall back to an identical-math jnp
 implementation, and ``interpret=True`` forces the kernels through the
-Pallas interpreter (tests).
+Pallas interpreter (tests).  Scale/inv divides are computed OUTSIDE the
+kernels (XLA's fp32 divide is correctly rounded, matching the C++ side;
+the Pallas interpreter's is not), and the int4 nibble pack/unpack is exact
+integer math in plain jnp.
 
 Byte accounting: every quantized collective calls :func:`note_device_bytes`
 with the raw-vs-encoded wire byte counts so the realized compression ratio
@@ -30,6 +50,7 @@ is observable (``data_plane_stats()['device_raw'/'device_encoded']``,
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Callable, Optional, Tuple
 
@@ -40,13 +61,18 @@ from jax.experimental import pallas as pl
 # --- Block geometry and codec ids: MUST mirror cpp/wire_codec.h ----------
 # (tools/hvd_lint.py's wire-codec pass checks these against the header; a
 # drift fails lint.)
-WIRE_BLOCK = 256           # kWireBlock: elements per fp32 scale
+WIRE_BLOCK = 256           # kWireBlock: elements per scale record
 WIRE_SCALE_BYTES = 4       # kWireScaleBytes: little-endian fp32 scale
-WIRE_CODEC_IDS = {"none": 0, "bf16": 1, "int8": 2}   # enum class WireCodec
+WIRE_GROUP = 4096          # kWireGroup: elements per int8g group scale
+WIRE_INT4_MAX = 7          # kWireInt4Max: int4 code clamp bound
+WIRE_SUB_DENOM = 256       # kWireSubDenom: int8g sub-scale denominator (2^8)
+WIRE_CODEC_IDS = {"none": 0, "bf16": 1, "int8": 2, "int4": 3, "int8g": 4}
 # Codecs the device plane can engage.  bf16 stays host-only: on-chip the
 # bf16 cast is a plain convert_element_type XLA already fuses — only the
-# block-scaled int8 path needs a codec implementation here.
-DEVICE_WIRE_CODECS = ("none", "int8")
+# block-scaled codecs need an implementation here.
+DEVICE_WIRE_CODECS = ("none", "int8", "int4", "int8g")
+
+_BLOCKS_PER_GROUP = WIRE_GROUP // WIRE_BLOCK   # int8g sub-scales per group
 
 # Rows per Pallas grid step: 32 sublanes satisfies the int8 (32, 128) and
 # fp32 (8, 128) minimum tile constraints simultaneously (WIRE_BLOCK = 256
@@ -54,24 +80,78 @@ DEVICE_WIRE_CODECS = ("none", "int8")
 _QUANT_ROWS = 32
 
 
-def encoded_nbytes(count: int) -> int:
-    """Wire bytes for ``count`` fp32 elements under the int8 codec — the
-    same formula as WireEncodedBytes(kInt8, count)."""
-    blocks = -(-int(count) // WIRE_BLOCK)
-    return blocks * WIRE_SCALE_BYTES + int(count)
+def encoded_nbytes(count: int, codec: str = "int8") -> int:
+    """Wire bytes for ``count`` fp32 elements under ``codec`` — the same
+    formula as WireEncodedBytes."""
+    count = int(count)
+    blocks = -(-count // WIRE_BLOCK)
+    if codec == "none":
+        return 4 * count
+    if codec == "bf16":
+        return 2 * count
+    if codec == "int4":
+        return blocks * WIRE_SCALE_BYTES + (count + 1) // 2
+    if codec == "int8g":
+        groups = -(-count // WIRE_GROUP)
+        return groups * WIRE_SCALE_BYTES + blocks + count
+    return blocks * WIRE_SCALE_BYTES + count
 
 
-def ring_bytes(count: int, world: int) -> Tuple[int, int]:
-    """Per-rank (raw, encoded) wire bytes for one quantized ring allreduce
-    of ``count`` fp32 elements over ``world`` ranks: reduce-scatter plus
-    all-gather, world-1 hops each, one chunk of ``ceil(count/world)``
-    elements per hop."""
+def torus_factors(world: int) -> Optional[Tuple[int, int]]:
+    """Near-square 2-D factorization ``(a, b)`` of ``world`` with
+    ``2 <= a <= b`` and ``a`` maximal (a = major/outer axis, b =
+    minor/inner axis).  None when ``world`` is prime or < 4 — the torus
+    schedule then demotes to a 1-D ring."""
+    world = int(world)
+    if world < 4:
+        return None
+    a = int(math.isqrt(world))
+    while a >= 2:
+        if world % a == 0:
+            return (a, world // a)
+        a -= 1
+    return None
+
+
+def ring_bytes(count: int, world: int, codec: str = "int8",
+               schedule: str = "ring") -> Tuple[int, int]:
+    """Per-rank (raw, encoded) wire bytes for one quantized allreduce of
+    ``count`` fp32 elements over ``world`` ranks under ``schedule``:
+
+    - ``ring``: reduce-scatter plus all-gather, world-1 hops each, one
+      chunk of ``ceil(count/world)`` elements per hop.
+    - ``bidi``: same hop count but each hop carries two half chunks, one
+      per ICI direction, so per-link bytes per hop halve (totals per rank
+      are schedule-identical up to short-block scale overhead).
+    - ``torus`` (a x b factorization): 2(b-1) hops of ``ceil(count/b)``
+      along the minor axis plus 2(a-1) hops of ``ceil(ceil(count/b)/a)``
+      along the major axis — O(a+b) chunk-hops instead of O(ab).
+    """
     world = max(1, int(world))
+    count = int(count)
     if world == 1:
         return (0, 0)
-    chunk = -(-int(count) // world)
+    if schedule == "torus":
+        f = torus_factors(world)
+        if f is not None:
+            a, b = f
+            c1 = -(-count // b)
+            c2 = -(-c1 // a)
+            h1 = 2 * (b - 1)
+            h2 = 2 * (a - 1)
+            return (4 * (h1 * c1 + h2 * c2),
+                    h1 * encoded_nbytes(c1, codec) +
+                    h2 * encoded_nbytes(c2, codec))
+        schedule = "bidi"          # prime/small world: torus -> bidi
+    chunk = -(-count // world)
     hops = 2 * (world - 1)
-    return (hops * chunk * 4, hops * encoded_nbytes(chunk))
+    if schedule == "bidi" and chunk >= 2:
+        front = chunk // 2
+        back = chunk - front
+        return (hops * chunk * 4,
+                hops * (encoded_nbytes(front, codec) +
+                        encoded_nbytes(back, codec)))
+    return (hops * chunk * 4, hops * encoded_nbytes(chunk, codec))
 
 
 # --- Device-plane byte counters ------------------------------------------
@@ -117,8 +197,9 @@ def reset_device_byte_counters() -> None:
 
 # --- Block-form reference implementation (identical math to WireEncode) --
 
-def _block_scales(xb):
-    """Per-block (scale, inv) mirroring WireEncode(kInt8) bit-for-bit:
+def _block_scales(xb, qmax: float = 127.0):
+    """Per-block (scale, inv) mirroring WireEncode(kInt8/kInt4)
+    bit-for-bit:
 
     - max|x| scans with ``a > maxabs`` so NaN elements never win the max
       (an all-NaN block keeps scale 0 and encodes zeros);
@@ -135,21 +216,85 @@ def _block_scales(xb):
     absx = jnp.abs(xb)
     maxabs = jnp.max(jnp.where(jnp.isnan(absx), 0.0, absx),
                      axis=1, keepdims=True)
-    scale = maxabs / 127.0
+    scale = maxabs / qmax
     ok = (scale > 0.0) & jnp.isfinite(scale)
     inv = jnp.where(ok, 1.0 / jnp.where(ok, scale, 1.0), 0.0)
     return scale.astype(jnp.float32), inv.astype(jnp.float32)
 
 
-def _quantize_codes_ref(xb, inv):
-    """Elementwise half of WireEncode(kInt8): round, clamp, block gate.
+def _group_scales(xb):
+    """Two-level (int8g) scale derivation mirroring WireEncode(kInt8g):
+
+    - group max = max over the group's block maxes (fp32 max is exact, so
+      this equals the C++ single-pass group scan, NaN-excluded alike);
+    - ``gscale = gmax / 127``; a zero or non-finite group stores sub-scale
+      bytes 0 and codes 0 (non-finite keeps gscale inf, so decode flags
+      the group as NaN via inf * 0, exactly like the single-level codecs);
+    - per block ``sub = round(bmax/gmax * WIRE_SUB_DENOM)`` clamped to
+      [0, 255] (the block holding gmax rounds to 256 and clamps), effective
+      scale ``eff = gscale * (sub/WIRE_SUB_DENOM)``.  The power-of-two
+      denominator makes ``eff`` association-order-independent — multiplying
+      by 2^-8 commutes exactly with fp32 rounding — so the C++ decoder and
+      every XLA fusion of the traced decoder reproduce the encoder's eff
+      bit-for-bit.
+
+    Returns (sub [nb,1] uint8, gscale [ng,1] fp32, inv [nb,1] fp32) where
+    ``inv`` is 1/eff for ok blocks and 0 otherwise.
+    """
+    nb = xb.shape[0]
+    ng = -(-nb // _BLOCKS_PER_GROUP)
+    absx = jnp.abs(xb)
+    bmax = jnp.max(jnp.where(jnp.isnan(absx), 0.0, absx),
+                   axis=1, keepdims=True)
+    pad = ng * _BLOCKS_PER_GROUP - nb
+    bmax_p = jnp.pad(bmax, ((0, pad), (0, 0)))
+    gmax = jnp.max(bmax_p.reshape(ng, _BLOCKS_PER_GROUP), axis=1,
+                   keepdims=True)
+    gscale = (gmax / 127.0).astype(jnp.float32)
+    gok = (gscale > 0.0) & jnp.isfinite(gscale)
+
+    def rep(a):
+        return jnp.repeat(a, _BLOCKS_PER_GROUP, axis=0)[:nb]
+
+    gmax_b, gok_b, gscale_b = rep(gmax), rep(gok), rep(gscale)
+    ratio = bmax / jnp.where(gok_b, gmax_b, 1.0)
+    sub_f = jnp.where(gok_b,
+                      jnp.minimum(jnp.round(ratio * float(WIRE_SUB_DENOM)),
+                                  255.0),
+                      0.0)
+    eff = gscale_b * (sub_f / float(WIRE_SUB_DENOM))
+    ok = gok_b & (sub_f > 0.0)
+    inv = jnp.where(ok, 1.0 / jnp.where(ok, eff, 1.0), 0.0)
+    return (sub_f.astype(jnp.uint8), gscale.astype(jnp.float32),
+            inv.astype(jnp.float32))
+
+
+def _effective_scales(sub, gscale, nblocks: int):
+    """Per-block effective fp32 scale from int8g (sub, group) scales —
+    the decoder's ``gscale * (sub/WIRE_SUB_DENOM)``, bit-identical to the
+    encode-side ``eff``: sub is an exact small integer and the denominator
+    is a power of two, so whether the compiler evaluates
+    ``(gscale*sub)/256`` or ``gscale*(sub/256)`` the result carries the
+    same bits (scaling by 2^-8 commutes exactly with fp32 rounding).
+    Decode runs both on a rank's own fresh payload and on ppermute'd
+    copies of the same bytes; with a non-power-of-two denominator XLA's
+    per-fusion-context codegen produced 1-ulp drift between those two
+    sites, breaking the cross-rank bit-identity the verbatim-forwarding
+    gather relies on."""
+    gs_b = jnp.repeat(gscale.astype(jnp.float32), _BLOCKS_PER_GROUP,
+                      axis=0)[:nblocks]
+    return gs_b * (sub.astype(jnp.float32) / float(WIRE_SUB_DENOM))
+
+
+def _quantize_codes_ref(xb, inv, qmax: float = 127.0):
+    """Elementwise half of WireEncode: round, clamp, block gate.
 
     Clamping uses std::min/std::max operand order, under which a NaN
-    element inside an otherwise-finite block lands on +127 (exactly what
+    element inside an otherwise-finite block lands on +qmax (exactly what
     the C++ loop produces)."""
     v = jnp.round(xb * inv)
-    v = jnp.where(v < 127.0, v, 127.0)      # std::min(127, v): NaN -> 127
-    v = jnp.where(v > -127.0, v, -127.0)    # std::max(-127, v)
+    v = jnp.where(v < qmax, v, qmax)        # std::min(qmax, v): NaN -> qmax
+    v = jnp.where(v > -qmax, v, -qmax)      # std::max(-qmax, v)
     return jnp.where(inv > 0.0, v, 0.0).astype(jnp.int8)
 
 
@@ -160,8 +305,32 @@ def _quantize_blocks_ref(xb):
 
 
 def _dequantize_blocks_ref(qb, scales):
-    """jnp mirror of WireDecodeRange(kInt8): scale * code, in fp32."""
+    """jnp mirror of WireDecodeRange: scale * code, in fp32."""
     return scales.astype(jnp.float32) * qb.astype(jnp.float32)
+
+
+# --- int4 nibble packing (exact integer jnp, shared by every backend) -----
+
+def _pack_int4(codes):
+    """[nblocks, WIRE_BLOCK] int8 codes in [-7, 7] -> [nblocks,
+    WIRE_BLOCK/2] packed bytes: element 2i in the low nibble, 2i+1 in the
+    high nibble, all arithmetic on uint8 (mod-256, matching the C++
+    encoder's unsigned pack)."""
+    u = codes.astype(jnp.uint8)
+    lo = u[:, 0::2] & 0x0F
+    hi = u[:, 1::2] & 0x0F
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def _unpack_int4(packed):
+    """Inverse of :func:`_pack_int4`: sign-extend each nibble via the
+    ``(nib ^ 8) - 8`` trick (identical to WireDecodeRange(kInt4))."""
+    b = packed.astype(jnp.uint8).astype(jnp.int32)
+    lo = ((b & 0x0F) ^ 8) - 8
+    hi = (((b >> 4) & 0x0F) ^ 8) - 8
+    nb = packed.shape[0]
+    return jnp.stack([lo, hi], axis=-1).reshape(nb, WIRE_BLOCK).astype(
+        jnp.int8)
 
 
 # --- Pallas kernels -------------------------------------------------------
@@ -178,6 +347,16 @@ def _quant_kernel(x_ref, inv_ref, q_ref):
     q_ref[...] = jnp.where(inv > 0.0, v, 0.0).astype(jnp.int8)
 
 
+def _quant_kernel_int4(x_ref, inv_ref, q_ref):
+    qmax = float(WIRE_INT4_MAX)
+    x = x_ref[...]
+    inv = inv_ref[...]
+    v = jnp.round(x * inv)
+    v = jnp.where(v < qmax, v, qmax)
+    v = jnp.where(v > -qmax, v, -qmax)
+    q_ref[...] = jnp.where(inv > 0.0, v, 0.0).astype(jnp.int8)
+
+
 def _dequant_kernel(q_ref, s_ref, x_ref):
     x_ref[...] = s_ref[...] * q_ref[...].astype(jnp.float32)
 
@@ -190,13 +369,13 @@ def _pad_rows(xb, rows: int):
     return xb, nb
 
 
-def _quantize_blocks_pallas(xb, interpret: bool):
-    scale, inv = _block_scales(xb)
+def _quantize_codes_pallas(xb, inv, interpret: bool, qmax: float):
     xb, nb = _pad_rows(xb, _QUANT_ROWS)
     inv_p, _ = _pad_rows(inv, _QUANT_ROWS)
     grid = (xb.shape[0] // _QUANT_ROWS,)
+    kernel = _quant_kernel if qmax == 127.0 else _quant_kernel_int4
     q = pl.pallas_call(
-        _quant_kernel,
+        kernel,
         grid=grid,
         in_specs=[pl.BlockSpec((_QUANT_ROWS, WIRE_BLOCK), lambda i: (i, 0)),
                   pl.BlockSpec((_QUANT_ROWS, 1), lambda i: (i, 0))],
@@ -204,7 +383,12 @@ def _quantize_blocks_pallas(xb, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((xb.shape[0], WIRE_BLOCK), jnp.int8),
         interpret=interpret,
     )(xb, inv_p)
-    return q[:nb], scale
+    return q[:nb]
+
+
+def _quantize_blocks_pallas(xb, interpret: bool):
+    scale, inv = _block_scales(xb)
+    return _quantize_codes_pallas(xb, inv, interpret, 127.0), scale
 
 
 def _dequantize_blocks_pallas(qb, scales, interpret: bool):
@@ -260,22 +444,58 @@ def _to_blocks(flat):
     return flat.reshape(nblocks, WIRE_BLOCK)
 
 
-def quantize(flat, interpret: Optional[bool] = None):
-    """Flat fp32 [n] -> (codes [nblocks, WIRE_BLOCK] int8, scales
-    [nblocks, 1] fp32).  The short last block is zero-padded, which cannot
-    change its max|x| — identical to the byte codec's short-block rule."""
-    return quantize_blocks(_to_blocks(flat.astype(jnp.float32)), interpret)
+def quantize(flat, codec: str = "int8", interpret: Optional[bool] = None):
+    """Flat fp32 [n] -> (codes, scales) under ``codec``:
+
+    - ``int8``: codes [nblocks, WIRE_BLOCK] int8, scales [nblocks, 1] fp32.
+    - ``int4``: codes [nblocks, WIRE_BLOCK/2] int8 (packed nibbles),
+      scales [nblocks, 1] fp32.
+    - ``int8g``: codes [nblocks, WIRE_BLOCK] int8, scales = (sub
+      [nblocks, 1] uint8, group [ngroups, 1] fp32).
+
+    The short last block is zero-padded, which cannot change its max|x| —
+    identical to the byte codec's short-block rule.  The (codes, scales)
+    pair is a pytree of same-shape-per-rank arrays, so collectives move it
+    with ``tree_map``'d ``lax.ppermute``/``all_gather``.
+    """
+    xb = _to_blocks(flat.astype(jnp.float32))
+    mode = _dispatch(interpret)
+    if codec == "int4":
+        scale, inv = _block_scales(xb, float(WIRE_INT4_MAX))
+        if mode is None:
+            codes = _quantize_codes_ref(xb, inv, float(WIRE_INT4_MAX))
+        else:
+            codes = _quantize_codes_pallas(xb, inv, mode,
+                                           float(WIRE_INT4_MAX))
+        return _pack_int4(codes), scale
+    if codec == "int8g":
+        sub, gscale, inv = _group_scales(xb)
+        if mode is None:
+            codes = _quantize_codes_ref(xb, inv)
+        else:
+            codes = _quantize_codes_pallas(xb, inv, mode, 127.0)
+        return codes, (sub, gscale)
+    if mode is None:
+        return _quantize_blocks_ref(xb)
+    return _quantize_blocks_pallas(xb, mode)
 
 
-def dequantize(qb, scales, count: int, interpret: Optional[bool] = None):
+def dequantize(qb, scales, count: int, codec: str = "int8",
+               interpret: Optional[bool] = None):
     """Inverse of :func:`quantize`: back to flat fp32 [count]."""
+    if codec == "int4":
+        qb = _unpack_int4(qb)
+    elif codec == "int8g":
+        sub, gscale = scales
+        scales = _effective_scales(sub, gscale, qb.shape[0])
     xb = dequantize_blocks(qb, scales, interpret)
     return xb.reshape(-1)[:count]
 
 
-def fake_quantize(x, interpret: Optional[bool] = None):
+def fake_quantize(x, codec: str = "int8",
+                  interpret: Optional[bool] = None):
     """dequantize(quantize(x)) with x's shape — the local quantization
     image used by error feedback (residual = x - fake_quantize(x))."""
     flat = x.reshape(-1)
-    qb, s = quantize(flat, interpret)
-    return dequantize(qb, s, flat.shape[0], interpret).reshape(x.shape)
+    qb, s = quantize(flat, codec, interpret)
+    return dequantize(qb, s, flat.shape[0], codec, interpret).reshape(x.shape)

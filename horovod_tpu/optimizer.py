@@ -191,9 +191,10 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     incompatible with compression/backward_passes_per_step/predivide.
 
     ``device_compression`` selects the in-jit device-plane codec for the
-    traced gradient reduction: ``"int8"`` routes eligible leaves (fp32, at
-    least HOROVOD_WIRE_COMPRESSION_MIN_BYTES of payload) through the int8
-    block-scaled ring (``ops.collectives.quantized_allreduce``) with
+    traced gradient reduction: ``"int8"``/``"int4"``/``"int8g"`` routes
+    eligible leaves (fp32, at least HOROVOD_WIRE_COMPRESSION_MIN_BYTES of
+    payload) through the block-scaled ring of that codec
+    (``ops.collectives.quantized_allreduce``) with
     **error feedback**: the state carries a residual tree holding each
     leaf's local quantization error, added back into the next step's
     gradient before quantizing, so the codec's per-step bias cancels
@@ -205,29 +206,31 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    from .ops import quantize as _qz
     dev_codec = device_compression
     if dev_codec is None:
         dev_codec = _jit_ops._device_codec_defaults()[0]
     dev_codec = (dev_codec or "none").lower()
-    if dev_codec not in ("none", "int8"):
+    if dev_codec not in _qz.DEVICE_WIRE_CODECS:
         raise ValueError(
-            f"device_compression must be 'none' or 'int8', got {dev_codec!r}")
-    ef_active = dev_codec == "int8"
+            "device_compression must be one of "
+            f"{_qz.DEVICE_WIRE_CODECS}, got {dev_codec!r}")
+    ef_active = dev_codec != "none"
     if ef_active and shard_optimizer_states:
         if device_compression is not None:
             raise ValueError(
-                "device_compression='int8' is incompatible with "
+                f"device_compression={dev_codec!r} is incompatible with "
                 "shard_optimizer_states (the sharded path reduce-scatters "
                 "exactly once; quantizing it is future work)")
         ef_active = False  # env-driven codec: sharded path just opts out
     if ef_active:
         if compression is not Compression.none:
             raise ValueError(
-                "device_compression='int8' already quantizes the wire; "
-                "combine it with Compression.none")
+                f"device_compression={dev_codec!r} already quantizes the "
+                "wire; combine it with Compression.none")
         if backward_passes_per_step != 1:
             raise ValueError(
-                "device_compression='int8' requires "
+                f"device_compression={dev_codec!r} requires "
                 "backward_passes_per_step=1 (error feedback needs to see "
                 "every communicated gradient)")
         if process_set is not None:
@@ -311,8 +314,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                         leaf, world, min_bytes)):
                 corrected = leaf + res
                 out.append(_jit_ops.quantized_allreduce(
-                    corrected, axes[0], op=op))
-                new_res.append(corrected - _qz.fake_quantize(corrected))
+                    corrected, axes[0], op=op, codec=dev_codec))
+                new_res.append(
+                    corrected - _qz.fake_quantize(corrected, dev_codec))
             else:
                 out.append(_reduce_grad_leaf(leaf, axes, op, 1.0, 1.0,
                                              vma_tracked))

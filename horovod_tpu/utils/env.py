@@ -73,11 +73,33 @@ def get_int(name: str, default: int) -> int:
         return default
 
 
-WIRE_COMPRESSION_CODECS = ("none", "bf16", "int8")
+WIRE_COMPRESSION_CODECS = ("none", "bf16", "int8", "int4", "int8g")
 # Codecs the in-jit device plane implements (ops/quantize.py): bf16 stays a
 # host-ring-only codec — on-chip a bf16 cast is a plain convert XLA already
-# fuses, so only block-scaled int8 earns a device implementation.
-DEVICE_WIRE_COMPRESSION_CODECS = ("none", "int8")
+# fuses, so only the block-scaled codecs (int8, packed int4, two-level
+# int8g) earn a device implementation.
+DEVICE_WIRE_COMPRESSION_CODECS = ("none", "int8", "int4", "int8g")
+
+# Ring schedules the device plane's quantized collectives can run
+# (ops/collectives.py): 'auto' resolves from the axis size — torus for
+# factorizable pod-slice shapes, bidi for rings of 4+, ring otherwise.
+DEVICE_SCHEDULES = ("auto", "ring", "bidi", "torus")
+
+
+def get_device_schedule() -> str:
+    """Ring schedule request from HOROVOD_DEVICE_SCHEDULE (default
+    'auto').  Unrecognised values warn and fall back to 'auto' rather
+    than failing init — the resolution is deterministic in the axis size,
+    so all ranks fall the same way."""
+    raw = os.environ.get("HOROVOD_DEVICE_SCHEDULE", "auto")
+    val = raw.strip().lower() or "auto"
+    if val in DEVICE_SCHEDULES:
+        return val
+    from .logging import get_logger
+    get_logger().warning(
+        "HOROVOD_DEVICE_SCHEDULE=%r: not one of %s; using 'auto'",
+        raw, "/".join(DEVICE_SCHEDULES))
+    return "auto"
 
 
 def _warn_wire(raw: str, what: str, allowed) -> None:
@@ -196,8 +218,14 @@ class Config:
     # engages the in-jit device-plane codec (ops/quantize.py); a bare codec
     # keeps the historical host-only meaning.
     wire_compression: str = "none"
-    # Device-plane codec parsed from the same variable ("none" | "int8").
+    # Device-plane codec parsed from the same variable
+    # ("none" | "int8" | "int4" | "int8g").
     wire_compression_device: str = "none"
+    # HOROVOD_DEVICE_SCHEDULE: ring schedule for the device plane's
+    # quantized collectives ("auto" | "ring" | "bidi" | "torus"); 'auto'
+    # resolves from the axis size, torus demotes to bidi when the world
+    # has no 2-D factorization.
+    device_schedule: str = "auto"
     # HOROVOD_WIRE_COMPRESSION_MIN_BYTES: payload floor (bytes) below which
     # either plane's codec demotes to the uncompressed path — small tensors
     # are latency- not bandwidth-bound, and the scale overhead erodes the
@@ -318,6 +346,7 @@ class Config:
             wire_compression_device=get_wire_compression_planes()[1],
             wire_compression_min_bytes=get_int(
                 "HOROVOD_WIRE_COMPRESSION_MIN_BYTES", 1 << 16),
+            device_schedule=get_device_schedule(),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             metrics_enabled=get_bool(

@@ -18,13 +18,16 @@ the codec engages on the cross-host leader ring), reporting cross-host
 wire bytes/step against the fp32 baseline and the max abs error the codec
 introduced.
 
-With --device-codec int8 an additional device-plane section runs: a jitted
-shard_map allreduce over a forced 8-device CPU host platform with the
-HOROVOD_WIRE_COMPRESSION ``device=`` plane on vs off, reporting the int8
-block-scaled ring's encoded-vs-raw wire ratio (from the device-plane byte
-counters), the quantization error, and throughput against the
-uncompressed traced ring.  On CPU the ratio is the point — the hop count
-is identical and interpret-mode kernels are not a speed story.
+With --device-codec {int8,int4,int8g} an additional device-plane section
+runs: a jitted shard_map allreduce over a forced 8-device CPU host
+platform with the HOROVOD_WIRE_COMPRESSION ``device=`` plane on vs off,
+reporting the codec's encoded-vs-raw wire ratio (from the device-plane
+byte counters), the quantization error, and throughput against the
+uncompressed traced ring.  --device-schedule {auto,ring,bidi,torus}
+selects the ring topology (HOROVOD_DEVICE_SCHEDULE); pass it alone or
+with --device-codec to sweep schedules at a fixed codec.  On CPU the
+ratio is the point — the hop count is what the schedules change, and
+interpret-mode kernels are not a speed story.
 
 With --metrics an additional section reruns the cache_on configuration
 with HOROVOD_METRICS=1 and reports the registry's negotiation-throughput
@@ -249,7 +252,8 @@ def _device_worker(steps: int, elems: int):
             "device_encoded_bytes_per_step": enc}
 
 
-def run_device_config(codec: str, steps: int, elems: int):
+def run_device_config(codec: str, steps: int, elems: int,
+                      schedule: str | None = None):
     from horovod_tpu.runner import run
 
     env = {"JAX_PLATFORMS": "cpu",
@@ -257,10 +261,13 @@ def run_device_config(codec: str, steps: int, elems: int):
            "HOROVOD_WIRE_COMPRESSION_MIN_BYTES": "4096"}
     if codec != "none":
         env["HOROVOD_WIRE_COMPRESSION"] = f"device={codec}"
+    if schedule:
+        env["HOROVOD_DEVICE_SCHEDULE"] = schedule
     results = run(_device_worker, args=(steps, elems), np=1, env=env,
                   stream_prefix=False)
     agg = dict(results[0])
-    agg.update({"config": f"device_{codec}", "payload_bytes": elems * 4,
+    name = f"device_{codec}" + (f"_{schedule}" if schedule else "")
+    agg.update({"config": name, "payload_bytes": elems * 4,
                 "steps_per_s": round(agg["steps_per_s"], 2)})
     print(json.dumps(agg), flush=True)
     return agg
@@ -334,19 +341,26 @@ def main():
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--tensors", type=int, default=50)
     ap.add_argument("--wire-compression", default=None,
-                    choices=["bf16", "int8"],
+                    choices=["bf16", "int8", "int4", "int8g"],
                     help="also benchmark the wire codec on a cross-host "
                          "(fake two-host, hierarchical) topology against "
                          "the fp32 baseline: bytes/step + max abs error")
     ap.add_argument("--wire-mb", type=float, default=4.0,
                     help="fp32 payload size for the wire benchmark (MiB)")
     ap.add_argument("--wire-steps", type=int, default=10)
-    ap.add_argument("--device-codec", default=None, choices=["int8"],
+    ap.add_argument("--device-codec", default=None,
+                    choices=["int8", "int4", "int8g"],
                     help="also benchmark the in-jit device-plane codec "
                          "(HOROVOD_WIRE_COMPRESSION device= plane) over a "
                          "forced 8-device CPU host platform: encoded/raw "
                          "wire ratio, quantization error, steps/s vs the "
                          "uncompressed traced ring")
+    ap.add_argument("--device-schedule", default=None,
+                    choices=["auto", "ring", "bidi", "torus"],
+                    help="ring topology for the device benchmark "
+                         "(HOROVOD_DEVICE_SCHEDULE); implies the device "
+                         "section with codec int8 if --device-codec is "
+                         "not given")
     ap.add_argument("--device-mb", type=float, default=4.0,
                     help="fp32 payload size for the device benchmark (MiB)")
     ap.add_argument("--device-steps", type=int, default=20)
@@ -513,16 +527,18 @@ def main():
                 comp["steps_per_s"] / max(base["steps_per_s"], 1e-9), 3),
         }), flush=True)
 
-    if args.device_codec:
+    if args.device_codec or args.device_schedule:
+        codec = args.device_codec or "int8"
         elems = int(args.device_mb * (1 << 20)) // 4
         dbase = run_device_config("none", args.device_steps, elems)
-        dcomp = run_device_config(args.device_codec, args.device_steps,
-                                  elems)
+        dcomp = run_device_config(codec, args.device_steps, elems,
+                                  schedule=args.device_schedule)
         assert dbase["device_raw_bytes_per_step"] == 0, \
             "baseline must not touch the device codec"
         print(json.dumps({
             "metric": "device_codec",
-            "codec": args.device_codec,
+            "codec": codec,
+            "schedule": args.device_schedule or "auto",
             "device_encoded_vs_raw_ratio": round(
                 dcomp["device_encoded_bytes_per_step"]
                 / max(dcomp["device_raw_bytes_per_step"], 1.0), 3),

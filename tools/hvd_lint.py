@@ -55,7 +55,8 @@ OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_fault_spec_check", "hvd_ctrl_plane_stats",
                     "hvd_flight_record", "hvd_add_process_set2",
                     "hvd_device_plane_note", "hvd_device_plane_stats",
-                    "hvd_autotune_qdev", "hvd_migrate_note",
+                    "hvd_autotune_qdev", "hvd_autotune_qsched",
+                    "hvd_migrate_note",
                     "hvd_elastic_generation_set", "hvd_step_trace",
                     "hvd_fleet_history"}
 
@@ -546,7 +547,10 @@ def protocol_pass(sc_text: str, wire_codec_text: str, core_py_text: str,
     # a drift here desyncs the in-jit ring from the byte-stream semantics.
     if quantize_py_text:
         for py_name, cpp_name in (("WIRE_BLOCK", "kWireBlock"),
-                                  ("WIRE_SCALE_BYTES", "kWireScaleBytes")):
+                                  ("WIRE_SCALE_BYTES", "kWireScaleBytes"),
+                                  ("WIRE_GROUP", "kWireGroup"),
+                                  ("WIRE_INT4_MAX", "kWireInt4Max"),
+                                  ("WIRE_SUB_DENOM", "kWireSubDenom")):
             qm = re.search(r"^%s\s*=\s*(\d+)" % py_name, quantize_py_text,
                            re.M)
             cm = re.search(r"constexpr\s+int64_t\s+%s\s*=\s*(\d+)" % cpp_name,

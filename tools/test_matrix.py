@@ -36,14 +36,17 @@ combination of:
            polling the coordinator; a healthy fleet must produce zero
            decisions and an unchanged workload result; one on-combo in
            the quick set
-- qdev:    off / int8 / demote (the HOROVOD_WIRE_COMPRESSION ``device=``
-           plane) — the in-jit int8 block-scaled device ring, exercised
-           over a forced 4-device CPU host platform; "int8" asserts the
-           auto-dispatch engaged (byte counters moved, scale/2-bounded
-           error), "demote" that the min-bytes floor keeps the codec cold
-           and the result bit-identical to the plain collective; np=1
-           rows plus one cross-plane row (host bf16 x device int8); one
-           int8 combo in the quick set
+- qdev:    off / <codec>[:<schedule>] / demote (the
+           HOROVOD_WIRE_COMPRESSION ``device=`` plane) — the in-jit
+           block-scaled device ring, exercised over a forced 4-device CPU
+           host platform; codec is int8 / int4 / int8g, the optional
+           schedule suffix pins HOROVOD_DEVICE_SCHEDULE (ring/bidi/torus).
+           A codec value asserts the auto-dispatch engaged (byte counters
+           moved, scale/2-bounded error — int4's bound is 127/7 wider),
+           "demote" that the min-bytes floor keeps the codec cold and the
+           result bit-identical to the plain collective; np=1 rows plus
+           one cross-plane row (host bf16 x device int8); int8 and
+           int4:bidi combos in the quick set
 - migrate: off / on (HOROVOD_MIGRATE_REPLICAS) — "on" combos commit an
            elastic ObjectState and assert peer-shard replication landed
            the committed snapshot bit-exact on the ring successors' shard
@@ -186,8 +189,9 @@ WORKLOAD = textwrap.dedent("""
                                wexp, **wtol)
 
     # qdev axis: the in-jit device-plane ring (HOROVOD_WIRE_COMPRESSION
-    # device=int8) over the forced multi-device host platform.  "int8"
-    # must engage the auto-dispatch (byte counters move) within the codec's
+    # device=<codec>) over the forced multi-device host platform.  A codec
+    # value ("int8" / "int4" / "int8g", optional ":<schedule>" suffix) must
+    # engage the auto-dispatch (byte counters move) within the codec's
     # scale/2 error bound; "demote" pins the min-bytes floor: codec stays
     # cold and the result is bit-identical to the plain collective.
     qdev = os.environ.get("HVD_MATRIX_QDEV", "off")
@@ -220,10 +224,14 @@ WORKLOAD = textwrap.dedent("""
                 jnp.asarray(qx)))
         qraw, qenc = qz.device_byte_counters()
         qmean = np.broadcast_to(qx.mean(axis=0), qx.shape)
-        if qdev == "int8":
+        if qdev != "demote":
+            qcodec = qdev.split(":", 1)[0]
             assert qraw > 0 and qenc < qraw, (qraw, qenc)
+            # int4's scale/2 is 127/7 ≈ 18x the int8 one; 2.0 covers it
+            # with slack while staying far under the signal's magnitude.
+            qbound = {"int4": 2.0}.get(qcodec, 0.5) / len(devs)
             qerr = float(np.max(np.abs(qout - qmean)))
-            assert qerr < 0.5 / len(devs), qerr
+            assert qerr < qbound, (qcodec, qerr, qbound)
         else:  # demote
             assert (qraw, qenc) == (0, 0), (qraw, qenc)
             import jax.lax as lax
@@ -406,9 +414,12 @@ def combos(quick: bool):
         # thread over a healthy fleet; zero decisions, same results.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
                "def", "on")
-        # qdev axis: the one quick device-codec combo (forced 4-dev host).
+        # qdev axis: the quick device-codec combos (forced 4-dev host) —
+        # the int8 baseline plus one new-codec/new-schedule row.
         yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
                "def", "off", "int8")
+        yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+               "def", "off", "int4:bidi")
         # migrate axis: the one quick on-combo — peer-shard replication
         # rides a committed elastic state over the shm data plane.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
@@ -487,6 +498,20 @@ def combos(quick: bool):
            "def", "off", "int8")
     yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
            "def", "off", "demote")
+    # The new codecs and schedules: int4 (nibble-packed, coarser bound),
+    # int8g (two-level scales), and the schedule suffix pinning the bidi
+    # and torus rings — 4 forced devices factor as 2x2, exercising the
+    # torus demotion-to-bidi rule as well as the explicit bidi path.
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int4")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int8g")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int8:bidi")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int4:torus")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "int8g:ring")
     # Migrate axis: replication across the plane shapes the shards actually
     # ride in production — shm, the flat TCP ring, and the hier topology —
     # plus a metrics-on row so the hvd_migrate_* counters are scraped live.
@@ -705,12 +730,18 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
         env["HOROVOD_HIER_FAKE_HOSTS"] = "2"
     # The wire and qdev axes share one knob: bare codec = host plane only,
-    # per-plane syntax once the device ring is in play.
+    # per-plane syntax once the device ring is in play.  A qdev value is
+    # "<codec>[:<schedule>]" or "demote" (int8 under a prohibitive floor).
     wire_planes = []
     if wire != "none":
         wire_planes.append(f"host={wire}" if qdev != "off" else wire)
     if qdev != "off":
-        wire_planes.append("device=int8")
+        qcodec, _, qsched = qdev.partition(":")
+        if qcodec == "demote":
+            qcodec = "int8"
+        wire_planes.append(f"device={qcodec}")
+        if qsched:
+            env["HOROVOD_DEVICE_SCHEDULE"] = qsched
     if wire_planes:
         env["HOROVOD_WIRE_COMPRESSION"] = ",".join(wire_planes)
     if qdev != "off":

@@ -456,6 +456,10 @@ def _measure() -> None:
         "step_ms": round(dt / n_steps * 1e3, 2),
         "device_kind": devices[0].device_kind,
         "n_devices": n_dev,
+        # Which gradient-exchange plane produced these numbers (the
+        # headline rides shard_map + explicit psum; the gspmd plane is
+        # benchmarked separately in bench_negotiation --data-plane).
+        "plane": "eager",
     }
     if flops_per_step is not None:
         # cost_analysis() reports the per-partition SPMD module, i.e.
@@ -741,6 +745,9 @@ def main() -> None:
                 res["cached_source"] = str(cached.get("source") or "unknown")
                 res["cached_methodology"] = str(
                     cached.get("methodology") or "")
+                # Plane provenance for caches recorded before the knob
+                # existed: every historical headline was eager-plane.
+                res.setdefault("plane", "eager")
                 res["live_error"] = last_err[-400:]
                 res["note"] = ("live TPU run FAILED this invocation; values "
                                "are the last successful on-chip measurement "

@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .runtime import PROTOCOL_VERSION, CoreBackend, FusedResponse, TensorEntry
-from .utils.env import Config
+from .utils.env import Config, get_bool
 from .utils.logging import get_logger
 from .wire import DataType, OpType, ReduceOp, wire_dtype
 
@@ -73,6 +73,7 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_int, c.c_int, c.c_char_p,              # flight_on flight_slots postmortem_dir
         c.c_int,                                   # autopilot_port (0 = off)
         c.c_int, c.c_int,                          # step_trace_on step_trace_slots
+        c.c_int,                                   # data_plane (-1 = no gspmd mesh)
     ]
     lib.hvd_shutdown.restype = c.c_int
     lib.hvd_is_initialized.restype = c.c_int
@@ -202,6 +203,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     except AttributeError:
         pass
     try:
+        # Old-ABI tolerance: a stale .so predating the data-plane
+        # coordinate loses only the plane autotune poll (and ignores the
+        # trailing data_plane init argument — cdecl, caller-cleaned).
+        lib.hvd_autotune_plane.restype = c.c_int
+        lib.hvd_autotune_plane.argtypes = []
+    except AttributeError:
+        pass
+    try:
         # Old-ABI tolerance: a stale .so predating the elastic-migration
         # plane loses the type-14 forensics and the generation gauge; the
         # migration protocol itself is Python-side and keeps working.
@@ -273,11 +282,36 @@ class NativeCore(CoreBackend):
         qsched = {"ring": 0, "bidi": 1, "torus": 2}.get(resolved, 0)
         if cfg.size < 4:
             qsched = -1  # bidi needs chunks >= 2, torus needs factors
+        # In-jit data plane: 0=eager, 1=gspmd from config ("auto" starts
+        # eager and lets the tuner flip it); -1 pins the autotuner's plane
+        # arm when no gspmd mesh can exist (no jax, a single device) or the
+        # quantized device codec owns the traced reduction — the
+        # compose-or-demote rule of ops/gspmd_plane.py.
+        plane = {"auto": 0, "eager": 0, "gspmd": 1}.get(
+            getattr(cfg, "data_plane", "auto"), 0)
         try:
             import jax  # noqa: F401
         except Exception:
             qdev = -1
             qsched = -1
+            plane = -1
+        else:
+            if qdev > 0:
+                plane = -1
+            elif get_bool("HOROVOD_JAX_DISTRIBUTED", False):
+                # jax.device_count() would initialize the backend here,
+                # and basics.init() has not yet run
+                # jax.distributed.initialize() (which must come first on
+                # pods).  A distributed world's mesh spans >= 2 devices
+                # whenever the world does, so pin from the world size.
+                if cfg.size < 2:
+                    plane = -1
+            else:
+                try:
+                    if jax.device_count() < 2:
+                        plane = -1
+                except Exception:
+                    plane = -1
         rc = self._lib.hvd_init(
             cfg.rank, cfg.size, cfg.local_rank, cfg.local_size,
             controller.encode(), cfg.rendezvous_addr.encode(),
@@ -303,6 +337,7 @@ class NativeCore(CoreBackend):
             cfg.autopilot_port,
             1 if cfg.step_trace_enabled else 0,
             cfg.step_trace_slots,
+            plane,
         )
         if rc != 0:
             raise NativeCoreError(

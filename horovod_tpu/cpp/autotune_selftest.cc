@@ -251,6 +251,63 @@ int main() {
       return 1;
     }
   }
+  {
+    // Data-plane arm, pinned: x7 defaults off (the 7-coordinate
+    // compatibility overloads record every sample at x7 = 0), and stays
+    // off under set_tune_x7(false) even for 8-coordinate callers — the EI
+    // search must never leave the eager level.
+    BayesianOptimizer bo;
+    bo.set_tune_x3(false);
+    bo.set_tune_x4(false);
+    bo.set_tune_x5(false);
+    bo.set_tune_x6(false);
+    bo.set_tune_x7(false);
+    unsigned rng = 1122;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0, x7 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, x7, Surface(x0, x1, &rng));
+    for (int round = 0; round < 20; ++round) {
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6, &x7);
+      if (x7 >= 0.5) {
+        std::printf("FAIL: pinned x7 knob was explored\n");
+        return 1;
+      }
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, x7, Surface(x0, x1, &rng));
+    }
+  }
+  {
+    // Data-plane arm, active: the x7=1 arm (gspmd — collectives inserted
+    // and overlapped by the compiler) scores 20% higher everywhere on
+    // this synthetic surface.  With set_tune_x7(true) the optimizer must
+    // converge onto the gspmd level.
+    BayesianOptimizer bo;
+    bo.set_tune_x3(false);
+    bo.set_tune_x4(false);
+    bo.set_tune_x5(false);
+    bo.set_tune_x6(false);
+    bo.set_tune_x7(true);
+    unsigned rng = 20177;
+    double x0 = 0.05, x1 = 0.95, x2 = 0.0, x3 = 0.0, x4 = 0.0, x5 = 0.0,
+           x6 = 0.0, x7 = 0.0;
+    bo.AddSample(x0, x1, x2, x3, x4, x5, x6, x7, Surface(x0, x1, &rng));
+    for (int round = 0; round < 40; ++round) {
+      bo.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6, &x7);
+      double s = Surface(x0, x1, &rng) * (x7 >= 0.5 ? 1.2 : 1.0);
+      bo.AddSample(x0, x1, x2, x3, x4, x5, x6, x7, s);
+    }
+    double bx0, bx1, bx2, bx3, bx4, bx5, bx6, bx7, best;
+    bo.Best(&bx0, &bx1, &bx2, &bx3, &bx4, &bx5, &bx6, &bx7, &best);
+    std::printf("plane best=%.3e at (%.2f, %.2f, plane=%.0f)\n", best, bx0,
+                bx1, bx7);
+    if (bx7 < 0.5) {
+      std::printf("FAIL: plane knob did not converge to the gspmd arm\n");
+      return 1;
+    }
+    if (best < 0.8 * 1.2e9) {
+      std::printf("FAIL: plane surface peak not approached\n");
+      return 1;
+    }
+  }
   std::printf("PASS\n");
   return 0;
 }

@@ -185,6 +185,13 @@ struct CoreConfig {
   // that only admits the unidirectional ring).  Enforced on the Python
   // side like qdev_compression.
   int qdev_schedule = 0;
+  // HOROVOD_DATA_PLANE: in-jit gradient-exchange plane (0=eager explicit
+  // collectives, 1=gspmd compiler-inserted; -1 = plane arm pinned — no
+  // multi-device mesh, or the quantized device codec owns the traced
+  // reduction).  Enforced on the Python side (ops/gspmd_plane.py); stored
+  // here so the autotuner's plane coordinate starts from the configured
+  // value.
+  int data_plane = 0;
   // HOROVOD_METRICS / HOROVOD_METRICS_FILE: enable the native metrics
   // registry; when metrics_file is non-empty the background loop writes a
   // JSON snapshot there every metrics_interval_s (a `{rank}` placeholder

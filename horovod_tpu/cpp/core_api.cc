@@ -457,7 +457,8 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
              int timeline_mark_cycles, double stall_warn_s,
              double stall_shutdown_s, int log_level, int flight_enabled,
              int flight_slots, const char* postmortem_dir,
-             int autopilot_port, int step_trace_on, int step_trace_slots) {
+             int autopilot_port, int step_trace_on, int step_trace_slots,
+             int data_plane) {
   if (g != nullptr) return -1;
   SetInitError("");  // a fresh attempt must not inherit a stale reason
   g = new GlobalState();
@@ -487,6 +488,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   // unidirectional ring.
   cfg.qdev_schedule =
       qdev_schedule >= -1 && qdev_schedule <= 2 ? qdev_schedule : 0;
+  // In-jit gradient-exchange plane (0=eager, 1=gspmd).  -1 pins the
+  // autotune arm: no multi-device mesh, or the quantized codec owns the
+  // traced reduction (the compose-or-demote rule of ops/gspmd_plane.py).
+  cfg.data_plane = data_plane >= -1 && data_plane <= 1 ? data_plane : 0;
   cfg.metrics_file = metrics_file ? metrics_file : "";
   cfg.metrics = metrics_enabled != 0 || !cfg.metrics_file.empty();
   cfg.metrics_interval_s = metrics_interval_s > 0 ? metrics_interval_s : 10.0;
@@ -602,10 +607,15 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // feasible for the plane's member count (-1).
     bool sched_tunable = qdev_tunable && cfg.qdev_schedule >= 0;
     int qdev_sched = cfg.qdev_schedule >= 0 ? cfg.qdev_schedule : 0;
+    // Data-plane coordinate: tunable only when the Python side reported a
+    // usable gspmd mesh (data_plane >= 0); -1 pins the arm to eager.
+    bool plane_tunable = cfg.data_plane >= 0;
+    int plane0 = cfg.data_plane >= 0 ? cfg.data_plane : 0;
     g->params.Initialize(fusion, g->cycle_ms, cfg.autotune_log,
                          cfg.hierarchical, hier_tunable,
                          cfg.wire_compression, wire_tunable,
-                         qdev_comp, qdev_tunable, qdev_sched, sched_tunable);
+                         qdev_comp, qdev_tunable, qdev_sched, sched_tunable,
+                         plane0, plane_tunable);
   }
   g->background = std::thread(BackgroundLoop);
   return 0;
@@ -962,6 +972,14 @@ int hvd_autotune_qdev() {
 int hvd_autotune_qsched() {
   if (g == nullptr) return -1;
   return g->params.qdev_sched();
+}
+
+// The autotuner's current data-plane decision (0=eager, 1=gspmd; -1 = not
+// initialized).  Polled like hvd_autotune_qdev(): the flip takes effect
+// at the next DistributedOptimizer construction/trace, never mid-step.
+int hvd_autotune_plane() {
+  if (g == nullptr) return -1;
+  return g->params.plane();
 }
 
 // Full local metrics registry as one JSON object; on the coordinator the

@@ -77,14 +77,23 @@ double SchedToX6(int sched) {
   return kSchedLevels[std::min(2, std::max(0, sched))];
 }
 
+// x7 <-> data plane: {0, 1} for {eager explicit, gspmd compiler-inserted}
+// — binary like the cache and hierarchical knobs.
+constexpr double kPlaneLevels[2] = {0.0, 1.0};
+int X7ToPlane(double x7) { return x7 < 0.5 ? 0 : 1; }
+double PlaneToX7(int plane) {
+  return kPlaneLevels[std::min(1, std::max(0, plane))];
+}
+
 double Rbf(double ax, double ay, double az, double aw, double av, double au,
-           double at, double bx, double by, double bz, double bw, double bv,
-           double bu, double bt) {
+           double at, double as, double bx, double by, double bz, double bw,
+           double bv, double bu, double bt, double bs) {
   double dx = ax - bx, dy = ay - by, dz = kCatScale * (az - bz),
          dw = kCatScale * (aw - bw), dv = kCatScale * (av - bv),
-         du = kCatScale * (au - bu), dt = kCatScale * (at - bt);
+         du = kCatScale * (au - bu), dt = kCatScale * (at - bt),
+         ds = kCatScale * (as - bs);
   return std::exp(-(dx * dx + dy * dy + dz * dz + dw * dw + dv * dv +
-                    du * du + dt * dt) /
+                    du * du + dt * dt + ds * ds) /
                   (2 * kLengthscale * kLengthscale));
 }
 
@@ -99,9 +108,9 @@ double phi(double z) {
 // ---- BayesianOptimizer -----------------------------------------------------
 
 void BayesianOptimizer::AddSample(double x0, double x1, double x2, double x3,
-                                  double x4, double x5, double x6,
+                                  double x4, double x5, double x6, double x7,
                                   double score) {
-  xs_.push_back({x0, x1, x2, x3, x4, x5, x6});
+  xs_.push_back({x0, x1, x2, x3, x4, x5, x6, x7});
   ys_.push_back(score);
   y_max_ = std::max(y_max_, std::abs(score));
   FitGP();
@@ -116,8 +125,9 @@ void BayesianOptimizer::FitGP() {
   for (int i = 0; i < n; ++i) {
     for (int j = 0; j <= i; ++j) {
       double k = Rbf(xs_[i].x0, xs_[i].x1, xs_[i].x2, xs_[i].x3, xs_[i].x4,
-                     xs_[i].x5, xs_[i].x6, xs_[j].x0, xs_[j].x1, xs_[j].x2,
-                     xs_[j].x3, xs_[j].x4, xs_[j].x5, xs_[j].x6);
+                     xs_[i].x5, xs_[i].x6, xs_[i].x7, xs_[j].x0, xs_[j].x1,
+                     xs_[j].x2, xs_[j].x3, xs_[j].x4, xs_[j].x5, xs_[j].x6,
+                     xs_[j].x7);
       if (i == j) k += kNoise;
       chol_[i * n + j] = k;
     }
@@ -148,8 +158,8 @@ void BayesianOptimizer::FitGP() {
 }
 
 void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
-                                double x4, double x5, double x6, double* mean,
-                                double* var) const {
+                                double x4, double x5, double x6, double x7,
+                                double* mean, double* var) const {
   const int n = static_cast<int>(xs_.size());
   if (n == 0) {
     *mean = 0;
@@ -158,8 +168,9 @@ void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
   }
   std::vector<double> kstar(n);
   for (int i = 0; i < n; ++i) {
-    kstar[i] = Rbf(x0, x1, x2, x3, x4, x5, x6, xs_[i].x0, xs_[i].x1,
-                   xs_[i].x2, xs_[i].x3, xs_[i].x4, xs_[i].x5, xs_[i].x6);
+    kstar[i] = Rbf(x0, x1, x2, x3, x4, x5, x6, x7, xs_[i].x0, xs_[i].x1,
+                   xs_[i].x2, xs_[i].x3, xs_[i].x4, xs_[i].x5, xs_[i].x6,
+                   xs_[i].x7);
   }
   double m = 0;
   for (int i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
@@ -178,19 +189,20 @@ void BayesianOptimizer::Predict(double x0, double x1, double x2, double x3,
 
 void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
                                 double* x3, double* x4, double* x5,
-                                double* x6) {
+                                double* x6, double* x7) {
   // Seed phase: spread the first probes over the categories before
   // trusting the GP (the reference warms its GP with a fixed design too).
-  // When x3/x4/x5/x6 are pinned, their seed columns collapse to 0 so no
-  // probe is wasted on a dead arm.  The x5 column walks all four codec
-  // levels and the x6 column all three schedules.
-  static const double kSeeds[][7] = {
-      {0.15, 0.15, 0, 0, 0, 0, 0},
-      {0.85, 0.15, 1, 1, 1, 1, 1},
-      {0.5, 0.5, 0, 1, 0.5, 1.0 / 3.0, 0.5},
-      {0.5, 0.5, 1, 0, 1, 2.0 / 3.0, 1},
-      {0.15, 0.85, 0, 1, 0.5, 1, 0.5},
-      {0.85, 0.85, 1, 0, 0, 2.0 / 3.0, 0}};
+  // When x3/x4/x5/x6/x7 are pinned, their seed columns collapse to 0 so
+  // no probe is wasted on a dead arm.  The x5 column walks all four codec
+  // levels, the x6 column all three schedules, and the x7 column
+  // alternates the two planes.
+  static const double kSeeds[][8] = {
+      {0.15, 0.15, 0, 0, 0, 0, 0, 0},
+      {0.85, 0.15, 1, 1, 1, 1, 1, 1},
+      {0.5, 0.5, 0, 1, 0.5, 1.0 / 3.0, 0.5, 1},
+      {0.5, 0.5, 1, 0, 1, 2.0 / 3.0, 1, 0},
+      {0.15, 0.85, 0, 1, 0.5, 1, 0.5, 1},
+      {0.85, 0.85, 1, 0, 0, 2.0 / 3.0, 0, 0}};
   const int n = num_samples();
   if (n < 6) {
     *x0 = kSeeds[n][0];
@@ -200,48 +212,54 @@ void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
     *x4 = tune_x4_ ? kSeeds[n][4] : 0.0;
     *x5 = tune_x5_ ? kSeeds[n][5] : 0.0;
     *x6 = tune_x6_ ? kSeeds[n][6] : 0.0;
+    *x7 = tune_x7_ ? kSeeds[n][7] : 0.0;
     return;
   }
   const double denom = y_max_ > 0 ? y_max_ : 1.0;
   double best_y = *std::max_element(ys_.begin(), ys_.end()) / denom;
   double best_ei = -1, bx = 0.5, by = 0.5, bz = 1.0, bw = 0.0, bv = 0.0,
-         bu = 0.0, bt = 0.0;
+         bu = 0.0, bt = 0.0, bs = 0.0;
   const int cat3_max = tune_x3_ ? 1 : 0;
   const int cat4_max = tune_x4_ ? 2 : 0;
   const int cat5_max = tune_x5_ ? 3 : 0;
   const int cat6_max = tune_x6_ ? 2 : 0;
-  for (int cat6 = 0; cat6 <= cat6_max; ++cat6) {
-    for (int cat5 = 0; cat5 <= cat5_max; ++cat5) {
-      for (int cat4 = 0; cat4 <= cat4_max; ++cat4) {
-        for (int cat3 = 0; cat3 <= cat3_max; ++cat3) {
-          for (int cat = 0; cat <= 1; ++cat) {
-            for (int i = 0; i <= kGrid; ++i) {
-              for (int j = 0; j <= kGrid; ++j) {
-                // Deterministic jitter decorrelates the grid across
-                // rounds.
-                rng_ = rng_ * 1664525u + 1013904223u;
-                double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-                rng_ = rng_ * 1664525u + 1013904223u;
-                double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
-                double cx =
-                    std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
-                double cy =
-                    std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
-                double mean, var;
-                Predict(cx, cy, cat, cat3, kWireLevels[cat4],
-                        kQdevLevels[cat5], kSchedLevels[cat6], &mean, &var);
-                double sd = std::sqrt(var);
-                double z = (mean - best_y - 0.01) / sd;
-                double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
-                if (ei > best_ei) {
-                  best_ei = ei;
-                  bx = cx;
-                  by = cy;
-                  bz = cat;
-                  bw = cat3;
-                  bv = kWireLevels[cat4];
-                  bu = kQdevLevels[cat5];
-                  bt = kSchedLevels[cat6];
+  const int cat7_max = tune_x7_ ? 1 : 0;
+  for (int cat7 = 0; cat7 <= cat7_max; ++cat7) {
+    for (int cat6 = 0; cat6 <= cat6_max; ++cat6) {
+      for (int cat5 = 0; cat5 <= cat5_max; ++cat5) {
+        for (int cat4 = 0; cat4 <= cat4_max; ++cat4) {
+          for (int cat3 = 0; cat3 <= cat3_max; ++cat3) {
+            for (int cat = 0; cat <= 1; ++cat) {
+              for (int i = 0; i <= kGrid; ++i) {
+                for (int j = 0; j <= kGrid; ++j) {
+                  // Deterministic jitter decorrelates the grid across
+                  // rounds.
+                  rng_ = rng_ * 1664525u + 1013904223u;
+                  double jx = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+                  rng_ = rng_ * 1664525u + 1013904223u;
+                  double jy = ((rng_ >> 16) & 0xFF) / 255.0 - 0.5;
+                  double cx =
+                      std::min(1.0, std::max(0.0, (i + 0.5 * jx) / kGrid));
+                  double cy =
+                      std::min(1.0, std::max(0.0, (j + 0.5 * jy) / kGrid));
+                  double mean, var;
+                  Predict(cx, cy, cat, cat3, kWireLevels[cat4],
+                          kQdevLevels[cat5], kSchedLevels[cat6],
+                          kPlaneLevels[cat7], &mean, &var);
+                  double sd = std::sqrt(var);
+                  double z = (mean - best_y - 0.01) / sd;
+                  double ei = (mean - best_y - 0.01) * Phi(z) + sd * phi(z);
+                  if (ei > best_ei) {
+                    best_ei = ei;
+                    bx = cx;
+                    by = cy;
+                    bz = cat;
+                    bw = cat3;
+                    bv = kWireLevels[cat4];
+                    bu = kQdevLevels[cat5];
+                    bt = kSchedLevels[cat6];
+                    bs = kPlaneLevels[cat7];
+                  }
                 }
               }
             }
@@ -257,10 +275,11 @@ void BayesianOptimizer::Suggest(double* x0, double* x1, double* x2,
   *x4 = bv;
   *x5 = bu;
   *x6 = bt;
+  *x7 = bs;
 }
 
 void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
-                             double* x4, double* x5, double* x6,
+                             double* x4, double* x5, double* x6, double* x7,
                              double* score) const {
   if (ys_.empty()) {
     *x0 = *x1 = 0.5;
@@ -269,6 +288,7 @@ void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
     *x4 = 0.0;
     *x5 = 0.0;
     *x6 = 0.0;
+    *x7 = 0.0;
     *score = 0;
     return;
   }
@@ -280,6 +300,7 @@ void BayesianOptimizer::Best(double* x0, double* x1, double* x2, double* x3,
   *x4 = xs_[i].x4;
   *x5 = xs_[i].x5;
   *x6 = xs_[i].x6;
+  *x7 = xs_[i].x7;
   *score = ys_[i];
 }
 
@@ -291,7 +312,8 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
                                   bool hierarchical, bool hier_tunable,
                                   int wire_comp, bool wire_tunable,
                                   int qdev_comp, bool qdev_tunable,
-                                  int qdev_sched, bool sched_tunable) {
+                                  int qdev_sched, bool sched_tunable,
+                                  int data_plane, bool plane_tunable) {
   fusion_ = best_fusion_ = fusion_threshold;
   cycle_ms_ = best_cycle_ = cycle_time_ms;
   hier_tunable_ = hier_tunable;
@@ -308,6 +330,10 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
   qdev_sched_use_ = best_qdev_sched_ =
       sched_tunable ? std::min(2, std::max(0, qdev_sched)) : 0;
   bo_.set_tune_x6(sched_tunable);
+  plane_tunable_ = plane_tunable;
+  plane_use_ = best_plane_ =
+      plane_tunable ? std::min(1, std::max(0, data_plane)) : 0;
+  bo_.set_tune_x7(plane_tunable);
   window_start_ = MonotonicSeconds();
   active_ = true;
   if (!log_path.empty()) {
@@ -315,7 +341,7 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
     if (log_) {
       std::fputs(
           "time_s,fusion_bytes,cycle_ms,cache_use,hier,wire_comp,qdev,"
-          "sched,score_bytes_per_s\n",
+          "sched,plane,score_bytes_per_s\n",
           log_);
     }
   }
@@ -329,10 +355,10 @@ void ParameterManager::RecordBytes(int64_t bytes) { bytes_ += bytes; }
 
 void ParameterManager::Log(double score) {
   if (!log_) return;
-  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%d,%d,%d,%d,%.1f\n",
+  std::fprintf(log_, "%.3f,%lld,%.3f,%d,%d,%d,%d,%d,%d,%.1f\n",
                MonotonicSeconds(), static_cast<long long>(fusion_), cycle_ms_,
                cache_use_ ? 1 : 0, hier_use_ ? 1 : 0, wire_use_, qdev_use_,
-               qdev_sched_use_, score);
+               qdev_sched_use_, plane_use_, score);
   std::fflush(log_);
 }
 
@@ -347,7 +373,7 @@ void ParameterManager::Score(double score) {
   bo_.AddSample(FusionToX(fusion_), CycleToX(cycle_ms_),
                 cache_use_ ? 1.0 : 0.0, hier_use_ ? 1.0 : 0.0,
                 WireToX4(wire_use_), QdevToX5(qdev_use_),
-                SchedToX6(qdev_sched_use_), score);
+                SchedToX6(qdev_sched_use_), PlaneToX7(plane_use_), score);
   if (score > best_score_ * 1.02) {
     windows_since_best_ = 0;
   } else {
@@ -362,6 +388,7 @@ void ParameterManager::Score(double score) {
     best_wire_ = wire_use_;
     best_qdev_ = qdev_use_;
     best_qdev_sched_ = qdev_sched_use_;
+    best_plane_ = plane_use_;
   }
   // Converge (reference: ParameterManager stops tuning once samples stop
   // improving): lock in the best configuration instead of exploring
@@ -377,17 +404,19 @@ void ParameterManager::Score(double score) {
     wire_use_ = best_wire_;
     qdev_use_ = best_qdev_;
     qdev_sched_use_ = best_qdev_sched_;
+    plane_use_ = best_plane_;
     HVD_LOG(INFO) << "autotune converged: fusion=" << fusion_
                   << " cycle_ms=" << cycle_ms_
                   << " announce_cache=" << (cache_use_ ? 1 : 0)
                   << " hierarchical=" << (hier_use_ ? 1 : 0)
                   << " wire_compression=" << wire_use_
                   << " qdev=" << qdev_use_
-                  << " qdev_sched=" << qdev_sched_use_;
+                  << " qdev_sched=" << qdev_sched_use_
+                  << " plane=" << plane_use_;
     return;
   }
-  double x0, x1, x2, x3, x4, x5, x6;
-  bo_.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6);
+  double x0, x1, x2, x3, x4, x5, x6, x7;
+  bo_.Suggest(&x0, &x1, &x2, &x3, &x4, &x5, &x6, &x7);
   fusion_ = XToFusion(x0);
   cycle_ms_ = XToCycle(x1);
   cache_use_ = x2 >= 0.5;
@@ -395,6 +424,7 @@ void ParameterManager::Score(double score) {
   wire_use_ = wire_tunable_ ? X4ToWire(x4) : 0;
   qdev_use_ = qdev_tunable_ ? X5ToQdev(x5) : 0;
   qdev_sched_use_ = sched_tunable_ ? X6ToSched(x6) : 0;
+  plane_use_ = plane_tunable_ ? X7ToPlane(x7) : 0;
 }
 
 bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
@@ -411,17 +441,18 @@ bool ParameterManager::Tick(int64_t* fusion_threshold, double* cycle_time_ms) {
   int old_wire = wire_use_;
   int old_qdev = qdev_use_;
   int old_sched = qdev_sched_use_;
+  int old_plane = plane_use_;
   Score(score);
   *fusion_threshold = fusion_;
   *cycle_time_ms = cycle_ms_;
-  // cache_use_/hier_use_/wire_use_/qdev_use_/qdev_sched_use_ participate:
-  // a categorical-only proposal must still be applied by the caller, or
-  // the next window's GP sample would be labeled with a setting that was
-  // never in effect.
+  // cache_use_/hier_use_/wire_use_/qdev_use_/qdev_sched_use_/plane_use_
+  // participate: a categorical-only proposal must still be applied by the
+  // caller, or the next window's GP sample would be labeled with a
+  // setting that was never in effect.
   return fusion_ != old_fusion || cycle_ms_ != old_cycle ||
          cache_use_ != old_cache || hier_use_ != old_hier ||
          wire_use_ != old_wire || qdev_use_ != old_qdev ||
-         qdev_sched_use_ != old_sched;
+         qdev_sched_use_ != old_sched || plane_use_ != old_plane;
 }
 
 }  // namespace hvdtpu

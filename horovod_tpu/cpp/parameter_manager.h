@@ -19,30 +19,47 @@
 namespace hvdtpu {
 
 // Gaussian-process regression + Expected Improvement over two continuous
-// knobs on the unit square plus five CATEGORICAL knobs (reference:
+// knobs on the unit square plus six CATEGORICAL knobs (reference:
 // ParameterManager also tunes categorical flags like cache/hierarchical
 // allreduce — categorical coordinates in the same GP are the cheap
 // TPU-native form; x2 = announce-cache {0,1}, x3 = hierarchical allreduce
 // {0,1}, x4 = wire compression {0, 0.5, 1} for {none, bf16, int8},
 // x5 = device-plane codec {0, 1/3, 2/3, 1} for {none, int8, int4, int8g}
 // (ordinal in codec aggressiveness like x4), x6 = device-ring schedule
-// {0, 0.5, 1} for {ring, bidi, torus}).
+// {0, 0.5, 1} for {ring, bidi, torus}, x7 = data plane {0, 1} for
+// {eager explicit collectives, gspmd compiler-inserted}).
 // Exposed for the synthetic-surface self-test (autotune_selftest.cc).
 class BayesianOptimizer {
  public:
-  // Observations are (x in [0,1]^2, x2/x3 in {0,1}, x4/x6 in {0,0.5,1},
+  // Observations are (x in [0,1]^2, x2/x3/x7 in {0,1}, x4/x6 in {0,0.5,1},
   // x5 in {0,1/3,2/3,1}, score); scores are internally max-normalized so
   // the kernel scales stay dimensionless.
   void AddSample(double x0, double x1, double x2, double x3, double x4,
-                 double x5, double x6, double score);
+                 double x5, double x6, double x7, double score);
+  // Pre-plane-coordinate form (x7 = 0, the eager plane) — keeps the
+  // selftest's historical call sites and any 7-coordinate caller exact.
+  void AddSample(double x0, double x1, double x2, double x3, double x4,
+                 double x5, double x6, double score) {
+    AddSample(x0, x1, x2, x3, x4, x5, x6, 0.0, score);
+  }
   // Next point to try: argmax EI over a jittered grid x the categorical
   // levels.  Falls back to latin-square-ish seed points for the first few
   // calls.
   void Suggest(double* x0, double* x1, double* x2, double* x3, double* x4,
-               double* x5, double* x6);
+               double* x5, double* x6, double* x7);
+  void Suggest(double* x0, double* x1, double* x2, double* x3, double* x4,
+               double* x5, double* x6) {
+    double x7;
+    Suggest(x0, x1, x2, x3, x4, x5, x6, &x7);
+  }
   // Best observed sample.
   void Best(double* x0, double* x1, double* x2, double* x3, double* x4,
-            double* x5, double* x6, double* score) const;
+            double* x5, double* x6, double* x7, double* score) const;
+  void Best(double* x0, double* x1, double* x2, double* x3, double* x4,
+            double* x5, double* x6, double* score) const {
+    double x7;
+    Best(x0, x1, x2, x3, x4, x5, x6, &x7, score);
+  }
   int num_samples() const { return static_cast<int>(xs_.size()); }
   // When the x3 knob cannot take effect (topology not hierarchical), pin
   // it to 0 so the EI search does not waste half its grid on a dead arm.
@@ -54,14 +71,21 @@ class BayesianOptimizer {
   // Same pinning rule for x6 (device-ring schedule: no device plane, or a
   // member count that admits only the unidirectional ring).
   void set_tune_x6(bool v) { tune_x6_ = v; }
+  // Same pinning rule for x7 (data plane: no multi-device mesh, or the
+  // quantized device codec owns the traced reduction).  Unlike x3..x6 this
+  // knob defaults OFF: the 7-coordinate compatibility overloads record
+  // every sample at x7 = 0, so exploring x7 without an 8-coordinate caller
+  // would chase predictions no sample can ever confirm.
+  void set_tune_x7(bool v) { tune_x7_ = v; }
 
  private:
   void FitGP();
   void Predict(double x0, double x1, double x2, double x3, double x4,
-               double x5, double x6, double* mean, double* var) const;
+               double x5, double x6, double x7, double* mean,
+               double* var) const;
 
   struct Pt {
-    double x0, x1, x2, x3, x4, x5, x6;
+    double x0, x1, x2, x3, x4, x5, x6, x7;
   };
   std::vector<Pt> xs_;
   std::vector<double> ys_;      // raw scores
@@ -73,6 +97,7 @@ class BayesianOptimizer {
   bool tune_x4_ = true;
   bool tune_x5_ = true;
   bool tune_x6_ = true;
+  bool tune_x7_ = false;  // opt-in: see set_tune_x7
 };
 
 class ParameterManager {
@@ -87,13 +112,17 @@ class ParameterManager {
   // when the process has no usable jax device plane.  qdev_sched /
   // sched_tunable: same pair for the device-ring schedule (0=ring,
   // 1=bidi, 2=torus), pinned alongside qdev or when the plane's member
-  // count admits only the unidirectional ring.
+  // count admits only the unidirectional ring.  data_plane /
+  // plane_tunable: same pair for the in-jit gradient-exchange plane
+  // (0=eager, 1=gspmd), pinned when no multi-device mesh exists or the
+  // quantized device codec owns the traced reduction.
   void Initialize(int64_t fusion_threshold, double cycle_time_ms,
                   const std::string& log_path, bool hierarchical = false,
                   bool hier_tunable = false, int wire_comp = 0,
                   bool wire_tunable = false, int qdev_comp = 0,
                   bool qdev_tunable = false, int qdev_sched = 0,
-                  bool sched_tunable = false);
+                  bool sched_tunable = false, int data_plane = 0,
+                  bool plane_tunable = false);
   ~ParameterManager();
 
   // Record bytes covered by emitted responses.
@@ -130,6 +159,11 @@ class ParameterManager {
   // ops/collectives.py's resolve_device_schedule codomain).  Polled by
   // the Python side together with qdev().
   int qdev_sched() const { return qdev_sched_use_; }
+  // Categorical knob: in-jit gradient-exchange plane (0=eager, 1=gspmd —
+  // ops/gspmd_plane.py's resolve_plane codomain).  Polled like qdev():
+  // per-rank consistent because the tunable bit is rank-uniform, and a
+  // flip only takes effect at the next optimizer construction/trace.
+  int plane() const { return plane_use_; }
 
  private:
   void Score(double score);
@@ -151,6 +185,8 @@ class ParameterManager {
   bool qdev_tunable_ = false;
   int qdev_sched_use_ = 0;
   bool sched_tunable_ = false;
+  int plane_use_ = 0;
+  bool plane_tunable_ = false;
   double best_score_ = -1;
   int64_t best_fusion_ = 0;
   double best_cycle_ = 1.0;
@@ -159,6 +195,7 @@ class ParameterManager {
   int best_wire_ = 0;
   int best_qdev_ = 0;
   int best_qdev_sched_ = 0;
+  int best_plane_ = 0;
   int warmup_windows_ = 1;
   int windows_since_best_ = 0;
   bool converged_ = false;

@@ -1,0 +1,243 @@
+"""GSPMD compiler-inserted data plane.
+
+The third data plane, next to the host TCP ring (runtime.py) and the
+eager device plane (ops/device_plane.py).  Where the eager plane builds
+*explicit* collective programs — shard_map bodies whose ``lax.psum`` /
+``lax.ppermute`` sequence is fixed at trace time — this plane only
+*annotates*: gradients are batch-computed under a named mesh, tagged with
+``jax.lax.with_sharding_constraint``, and ``jax.jit``'s SPMD partitioner
+(GSPMD) inserts and schedules the collectives itself.  XLA is then free
+to overlap reduce traffic with the optimizer math, which is where the
+MLPerf TPU-pod submissions win their step time (PAPERS.md).
+
+Demotion contract (the PR 10/15 interaction): a plane request that cannot
+compose falls back to the eager plane *deterministically* and
+*bit-identically* — the annotations only guide XLA's scheduler, never the
+math — and every demotion increments a named counter here so the choice
+is observable (`plane_counters()`), mirroring the quantized plane's byte
+counters (ops/quantize.py).
+
+Demotion reasons:
+
+- ``world1``    — the mesh has a single device; there is no collective to
+                  overlap, and XLA would fold the annotations away anyway.
+- ``quantized`` — ``device=<codec>`` compression is active.  The quantized
+                  collectives are explicit ppermute rings built inside
+                  shard_map; GSPMD cannot schedule through them, so the
+                  optimizer keeps the eager plane end to end rather than
+                  mixing planes within one step.
+- ``dtype``     — a non-fp32 leaf (per leaf, at trace time).  The parity
+                  bar this plane is pinned to (tests/single/
+                  test_gspmd_plane.py) is fp32-reduction-order only, so
+                  other dtypes skip the annotation and take whatever
+                  layout XLA picks — same values, no constraint.
+- ``no_jax``    — jax is not importable (pure-python host-ring build).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, int] = {}
+
+
+def _bump(reason: str) -> None:
+    with _LOCK:
+        _COUNTERS[reason] = _COUNTERS.get(reason, 0) + 1
+
+
+def note_demotion(reason: str) -> None:
+    """Record a demotion decided outside this module (the optimizer
+    demotes for its own reasons too — accumulation, process sets, ZeRO-1
+    sharding — and those must be just as observable)."""
+    _bump(reason)
+
+
+def plane_counters() -> Dict[str, int]:
+    """Snapshot of demotion/selection counters: ``gspmd`` (optimizers that
+    resolved to the gspmd plane), ``demote_world1`` / ``demote_quantized``
+    / ``demote_no_jax`` (per optimizer), ``demote_dtype`` (per non-fp32
+    leaf, at trace time)."""
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_plane_counters() -> None:
+    with _LOCK:
+        _COUNTERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction
+# ---------------------------------------------------------------------------
+
+def _model_factors(n: int) -> Tuple[int, int]:
+    """(batch, model) factorization for a 2-D mesh over ``n`` devices,
+    degrading the model axis as devices run out (SNIPPETS.md [3]): 8+
+    devices keep 2-way batch and give the rest to model, 4+ go 2x2, 2 go
+    1x2, and a single device collapses to 1x1."""
+    if n >= 8:
+        return 2, n // 2
+    if n >= 4:
+        return 2, 2
+    if n >= 2:
+        return 1, 2
+    return 1, 1
+
+
+def build_gspmd_mesh(devices=None, model_parallel: bool = False):
+    """Named ``Mesh`` for the gspmd plane: 1-D ``batch`` over all visible
+    devices by default, or 2-D ``batch`` x ``model`` when the caller wants
+    tensor sharding on the same substrate (SNIPPETS.md [1]-[3])."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if not model_parallel:
+        return Mesh(np.asarray(devices), (BATCH_AXIS,))
+    b, m = _model_factors(len(devices))
+    arr = np.asarray(devices[: b * m]).reshape((b, m))
+    return Mesh(arr, (BATCH_AXIS, MODEL_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# Sharding-tree utilities
+# ---------------------------------------------------------------------------
+
+def batch_pspec(leaf, mesh) -> Any:
+    """PartitionSpec sharding ``leaf``'s leading dim over the batch axis
+    when it divides evenly, replicated otherwise (the naive-but-safe rule
+    of SNIPPETS.md [2] — a non-divisible dim must not silently pad)."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[BATCH_AXIS]
+    shape = getattr(leaf, "shape", ())
+    if len(shape) >= 1 and n > 1 and shape[0] % n == 0:
+        return P(BATCH_AXIS, *([None] * (len(shape) - 1)))
+    return P()
+
+
+def tree_pspecs(tree, mesh):
+    """Pytree of PartitionSpec leaves mirroring ``tree``: batch-sharded
+    where the leading dim divides the batch axis, replicated otherwise."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda l: batch_pspec(l, mesh), tree)
+
+
+def tree_shardings(tree, mesh):
+    """Pytree of ``NamedSharding`` leaves mirroring ``tree`` (same rule as
+    :func:`tree_pspecs`) — the form ``jax.device_put`` / ``jax.jit``
+    in_shardings accept without an ambient mesh context."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda l: NamedSharding(mesh, batch_pspec(l, mesh)), tree)
+
+
+def replicated_sharding(mesh):
+    """NamedSharding replicating a leaf over the whole mesh — the
+    constraint the optimizer pins gradients/updates to."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Trace-time annotation
+# ---------------------------------------------------------------------------
+
+def constrain_grad_leaf(leaf, mesh):
+    """Pin one gradient leaf replicated over ``mesh`` so GSPMD schedules
+    its (implicit, backprop-inserted) reduction where it can overlap with
+    the optimizer math.  Non-fp32 leaves demote per leaf: the annotation
+    is skipped (``demote_dtype``) and the leaf passes through bit-identically.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if getattr(leaf, "dtype", None) != jnp.float32:
+        _bump("demote_dtype")
+        return leaf
+    return jax.lax.with_sharding_constraint(leaf, replicated_sharding(mesh))
+
+
+def constrain_grads(grads, mesh):
+    """Annotate every fp32 leaf of a gradient pytree with a replicated
+    sharding constraint (see :func:`constrain_grad_leaf`)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: constrain_grad_leaf(l, mesh), grads)
+
+
+# ---------------------------------------------------------------------------
+# Plane resolution
+# ---------------------------------------------------------------------------
+
+def default_mesh():
+    """Mesh the optimizer constrains against when the caller passes none:
+    the 1-D batch mesh over all visible devices."""
+    return build_gspmd_mesh()
+
+
+def data_plane_default() -> str:
+    """Configured plane request: the live context's ``Config.data_plane``
+    when initialized (runtime.py consumed it at init), else
+    HOROVOD_DATA_PLANE — same fallback shape as the device plane's codec
+    and schedule defaults (ops/collectives.py)."""
+    try:
+        from ..context import HorovodContext
+        if HorovodContext.initialized():
+            return getattr(HorovodContext.instance().cfg,
+                           "data_plane", "auto")
+    except Exception:
+        pass
+    from ..utils.env import get_data_plane
+    return get_data_plane()
+
+
+def resolve_plane(request: Optional[str] = None, mesh=None,
+                  device_codec: Optional[str] = None,
+                  count: bool = True) -> Tuple[str, Any]:
+    """Resolve a plane request to ``("eager", None)`` or
+    ``("gspmd", mesh)``.
+
+    ``request`` is ``auto`` / ``eager`` / ``gspmd`` (None reads
+    HOROVOD_DATA_PLANE via utils.env); demotions are deterministic in the
+    mesh size and codec config — every rank resolves identically — and
+    each bumps its counter (module docstring).  An explicit ``eager``
+    request is a choice, not a demotion: no counter.  ``count=False``
+    resolves silently — the ``auto`` request probes capability on every
+    optimizer construction and must not read as a stream of demotions.
+    """
+    if request is None:
+        request = data_plane_default()
+    request = (request or "auto").strip().lower()
+    bump = _bump if count else (lambda reason: None)
+    if request == "eager":
+        return "eager", None
+    try:
+        import jax  # noqa: F401
+    except Exception:
+        bump("demote_no_jax")
+        return "eager", None
+    if device_codec is not None and device_codec != "none":
+        bump("demote_quantized")
+        return "eager", None
+    if mesh is None:
+        mesh = default_mesh()
+    if mesh.size < 2:
+        bump("demote_world1")
+        return "eager", None
+    bump("gspmd")
+    return "gspmd", mesh

@@ -57,6 +57,20 @@ def _leaf_vma(leaf):
         return None
 
 
+def _axes_bound(axis_name) -> bool:
+    """True when every resolved mesh axis is bound in the current trace
+    (shard_map / pmap context) — the discriminator between the two in-jit
+    calling conventions: bound axes mean per-shard gradients that still
+    need the explicit reduction; unbound means plain jit over sharded
+    arrays, where backprop already inserted it (the gspmd plane)."""
+    try:
+        for a in _resolve_axes(axis_name):
+            _jit_ops.axis_size(a)
+        return True
+    except (NameError, KeyError):
+        return False
+
+
 def _reduce_grad_leaf(leaf, axes, op: ReduceOp,
                       prescale_factor: float, postscale_factor: float,
                       vma_tracked: bool):
@@ -172,7 +186,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          process_set: Optional[ProcessSet] = None,
                          axis_name: Optional[str] = None,
                          shard_optimizer_states: bool = False,
-                         device_compression: Optional[str] = None
+                         device_compression: Optional[str] = None,
+                         plane: Optional[str] = None,
+                         mesh=None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with cross-rank gradient averaging.
 
@@ -203,6 +219,23 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     disables regardless of the environment.  Ineligible leaves demote to
     the uncompressed collective bit-identically; the eager path never
     quantizes (the host ring has its own coordinator-negotiated codec).
+
+    ``plane`` selects the in-jit gradient-exchange plane
+    (``ops.gspmd_plane``): ``"eager"`` is today's explicit path
+    (shard_map + psum); ``"gspmd"`` expects the *gspmd calling
+    convention* — the train step runs under plain ``jax.jit`` with
+    batch-sharded inputs and a global-mean loss, so backprop has already
+    globally reduced the gradients — and the optimizer only annotates
+    them with ``jax.lax.with_sharding_constraint`` over ``mesh``
+    (default: the 1-D batch mesh over all devices), letting XLA insert
+    and overlap the collectives.  ``None`` reads ``HOROVOD_DATA_PLANE``;
+    ``"auto"`` (the default) adapts per trace: the explicit path whenever
+    the mesh axis is bound (shard_map), the annotation path otherwise.
+    Requests that cannot compose (single-device mesh, an active
+    ``device=<codec>``, accumulation, process sets, ZeRO-1 sharding,
+    predivide) demote deterministically to eager with a counter
+    recording why (``ops.gspmd_plane.plane_counters()``) — demotion is
+    bit-identical, since the annotations never change the math.
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -244,6 +277,39 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
             raise ValueError(
                 "device_compression='int8' supports op=Average or Sum")
+    from .ops import gspmd_plane as _gspmd
+    from .utils.env import DATA_PLANES
+    plane_req = plane if plane is not None else _gspmd.data_plane_default()
+    plane_req = (plane_req or "auto").strip().lower()
+    if plane_req not in DATA_PLANES:
+        raise ValueError(
+            f"plane must be one of {DATA_PLANES}, got {plane_req!r}")
+    # Resolve once, at construction: demotions are deterministic in the
+    # mesh/codec config, and an explicit 'gspmd' request that cannot
+    # compose records why (auto probes silently).  gspmd_mesh None means
+    # the update runs today's eager plane end to end.
+    gspmd_mesh = None
+    if plane_req != "eager":
+        explicit = plane_req == "gspmd"
+
+        def _demote(reason):
+            if explicit:
+                _gspmd.note_demotion(reason)
+
+        if shard_optimizer_states:
+            _demote("demote_sharded")
+        elif backward_passes_per_step != 1:
+            _demote("demote_accum")
+        elif process_set is not None:
+            _demote("demote_process_set")
+        elif gradient_predivide_factor != 1.0:
+            _demote("demote_predivide")
+        else:
+            resolved, gspmd_mesh = _gspmd.resolve_plane(
+                plane_req, mesh=mesh, device_codec=dev_codec,
+                count=explicit)
+            if resolved != "gspmd":
+                gspmd_mesh = None
     if shard_optimizer_states:
         if compression is not Compression.none:
             raise ValueError(
@@ -342,6 +408,19 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     def update_fn(grads, state: DistributedOptState, params=None):
         if backward_passes_per_step == 1:
             leaves = jax.tree_util.tree_leaves(grads)
+            if (gspmd_mesh is not None and leaves and _is_traced(leaves[0])
+                    and not _axes_bound(axis_name)):
+                # GSPMD plane: no explicit collective.  The grads of a
+                # batch-sharded global-mean loss arrive globally reduced
+                # (backprop inserted the reduction); the constraint pins
+                # them replicated so GSPMD schedules that reduce where it
+                # overlaps the optimizer math below.
+                reduced = _gspmd.constrain_grads(grads, gspmd_mesh)
+                updates, inner = optimizer.update(reduced,
+                                                  state.inner_state, params)
+                return updates, DistributedOptState(inner, state.accum,
+                                                    state.counter,
+                                                    state.residual)
             if (ef_active and state.residual is not None and leaves
                     and _is_traced(leaves[0])):
                 reduced, residual = reduce_grads_ef(grads, state.residual)

@@ -95,6 +95,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "'host=bf16,device=int8' ('device=int8' enables the "
                         "in-jit int8 block-scaled ring); accumulation stays "
                         "fp32 (HOROVOD_WIRE_COMPRESSION)")
+    p.add_argument("--data-plane", default=None,
+                   choices=["auto", "eager", "gspmd"],
+                   help="in-jit gradient-exchange plane for "
+                        "DistributedOptimizer: 'eager' builds explicit "
+                        "shard_map collectives, 'gspmd' annotates shardings "
+                        "and lets XLA insert + overlap them, 'auto' adapts "
+                        "per trace (HOROVOD_DATA_PLANE)")
     p.add_argument("--control-tree", default=None,
                    choices=["auto", "on", "off"],
                    help="leader-tree control plane (protocol v9): host "
@@ -164,6 +171,7 @@ def _apply_config_file(args: argparse.Namespace,
         "slots_per_host": cfg.get("slots-per-host"),
         "log_level": cfg.get("log-level"),
         "wire_compression": cfg.get("wire-compression"),
+        "data_plane": cfg.get("data-plane"),
         "control_tree": cfg.get("control-tree"),
     }
     tl = cfg.get("timeline") or {}
@@ -221,6 +229,8 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     if args.wire_compression:
         env["HOROVOD_WIRE_COMPRESSION"] = args.wire_compression
+    if args.data_plane:
+        env["HOROVOD_DATA_PLANE"] = args.data_plane
     if args.control_tree:
         env["HOROVOD_CONTROL_TREE"] = args.control_tree
     if args.postmortem_dir:

@@ -15,6 +15,14 @@ RunLoopOnce, tensor_queue.cc, global_state.h; SURVEY.md §3.2):
   collectives (TCP) otherwise, identity at size()==1.
 - ``synchronize(handle)`` blocks on completion; ``poll(handle)`` checks.
 
+A third data plane never reaches this spine at all: ``plane=gspmd``
+(``ops.gspmd_plane``, selected via ``HOROVOD_DATA_PLANE`` /
+``Config.data_plane``) replaces explicit enqueue-or-psum with sharding
+annotations inside the user's own ``jax.jit`` — GSPMD inserts and
+schedules the collectives, so there is nothing to negotiate per step.
+The host ring and the negotiated ``device`` bit stay the planes for
+everything eager (broadcasts, eager allreduce, host numpy tensors).
+
 The crucial TPU-first property: a response list is negotiated to be *identical
 on every rank*, including a per-response ``device`` bit that is the AND of
 every rank's capability (a device-resident jax.Array + a ready rank mesh),

@@ -85,6 +85,30 @@ DEVICE_WIRE_COMPRESSION_CODECS = ("none", "int8", "int4", "int8g")
 # factorizable pod-slice shapes, bidi for rings of 4+, ring otherwise.
 DEVICE_SCHEDULES = ("auto", "ring", "bidi", "torus")
 
+# In-jit gradient-exchange planes DistributedOptimizer can run
+# (ops/gspmd_plane.py): 'eager' builds explicit psum/ppermute programs,
+# 'gspmd' annotates shardings and lets XLA insert + schedule the
+# collectives, 'auto' prefers gspmd where it composes and demotes
+# deterministically otherwise.
+DATA_PLANES = ("auto", "eager", "gspmd")
+
+
+def get_data_plane() -> str:
+    """Data-plane request from HOROVOD_DATA_PLANE (default 'auto').
+    Unrecognised values warn and fall back to 'auto' rather than failing
+    init — plane resolution (ops/gspmd_plane.py) is deterministic in the
+    mesh and the optimizer's codec config, so all ranks fall the same
+    way."""
+    raw = os.environ.get("HOROVOD_DATA_PLANE", "auto")
+    val = raw.strip().lower() or "auto"
+    if val in DATA_PLANES:
+        return val
+    from .logging import get_logger
+    get_logger().warning(
+        "HOROVOD_DATA_PLANE=%r: not one of %s; using 'auto'",
+        raw, "/".join(DATA_PLANES))
+    return "auto"
+
 
 def get_device_schedule() -> str:
     """Ring schedule request from HOROVOD_DEVICE_SCHEDULE (default
@@ -226,6 +250,13 @@ class Config:
     # resolves from the axis size, torus demotes to bidi when the world
     # has no 2-D factorization.
     device_schedule: str = "auto"
+    # HOROVOD_DATA_PLANE: which in-jit gradient-exchange plane
+    # DistributedOptimizer uses ("auto" | "eager" | "gspmd").  'eager'
+    # builds explicit collectives (shard_map + psum); 'gspmd' annotates
+    # shardings with with_sharding_constraint and lets jit insert and
+    # overlap the collectives; 'auto' resolves per optimizer — gspmd when
+    # it composes, demoting to eager (with a counter) otherwise.
+    data_plane: str = "auto"
     # HOROVOD_WIRE_COMPRESSION_MIN_BYTES: payload floor (bytes) below which
     # either plane's codec demotes to the uncompressed path — small tensors
     # are latency- not bandwidth-bound, and the scale overhead erodes the
@@ -347,6 +378,7 @@ class Config:
             wire_compression_min_bytes=get_int(
                 "HOROVOD_WIRE_COMPRESSION_MIN_BYTES", 1 << 16),
             device_schedule=get_device_schedule(),
+            data_plane=get_data_plane(),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             metrics_enabled=get_bool(

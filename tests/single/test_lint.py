@@ -198,6 +198,33 @@ def test_env_line_wrapped_var_prefix_not_flagged():
                    for k in keys)
 
 
+def test_env_data_plane_knob_coverage():
+    """HOROVOD_DATA_PLANE (PR 17) is a managed public knob: parsed in
+    utils/env.py and documented in a table row is clean; dropping the doc
+    row flags ENV-UNDOCUMENTED, and a read outside env.py (without the
+    central parse) flags ENV-UNMANAGED."""
+    parse = ('\n\ndef get_data_plane():\n'
+             '    return os.environ.get("HOROVOD_DATA_PLANE", "auto")\n')
+    doc = DOC_OK + "| `HOROVOD_DATA_PLANE` | gradient-exchange plane |\n"
+
+    def run(env_py, py_extra="", doc_text=doc):
+        return hvd_lint.env_pass(
+            {"horovod_tpu/utils/env.py": env_py,
+             "horovod_tpu/other.py": py_extra},
+            {"horovod_tpu/cpp/x.cc": 'getenv("HOROVOD_NATIVE_KNOB")'},
+            {"docs/api.md": doc_text},
+            native_read_vars={"HOROVOD_NATIVE_KNOB"}, py_direct_vars=set(),
+            internal_vars=set())
+
+    assert run(ENV_PY + parse) == []
+    keys = {f.key for f in run(ENV_PY + parse, doc_text=DOC_OK)}
+    assert "ENV-UNDOCUMENTED:HOROVOD_DATA_PLANE" in keys
+    keys = {f.key for f in run(
+        ENV_PY, py_extra='p = os.environ.get("HOROVOD_DATA_PLANE")',
+        doc_text=DOC_OK)}
+    assert "ENV-UNMANAGED:HOROVOD_DATA_PLANE" in keys
+
+
 # ---------------------------------------------------------------------------
 # protocol pass fixtures
 # ---------------------------------------------------------------------------

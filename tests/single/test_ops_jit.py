@@ -598,3 +598,22 @@ def test_resolve_device_schedule_rules():
     assert r(8, "torus") == "torus"
     assert r(8, "ring") == "ring"
     assert r(8, "nonsense") == "ring"
+
+
+def test_gspmd_plane_demotes_alongside_quantized_ring():
+    """A quantized device codec owns the traced reduction (the explicit
+    ppermute ring above): an explicit gspmd request alongside it demotes
+    to eager and says so in the counter, while the silent auto probe makes
+    the same decision without reading as a demotion stream (PR 17)."""
+    from horovod_tpu.ops import gspmd_plane as gp
+
+    gp.reset_plane_counters()
+    try:
+        plane, mesh = gp.resolve_plane("gspmd", device_codec="int8")
+        assert (plane, mesh) == ("eager", None)
+        assert gp.plane_counters() == {"demote_quantized": 1}
+        plane, _ = gp.resolve_plane("auto", device_codec="int8", count=False)
+        assert plane == "eager"
+        assert gp.plane_counters() == {"demote_quantized": 1}
+    finally:
+        gp.reset_plane_counters()

@@ -29,6 +29,14 @@ with --device-codec to sweep schedules at a fixed codec.  On CPU the
 ratio is the point — the hop count is what the schedules change, and
 interpret-mode kernels are not a speed story.
 
+With --data-plane an additional section times one SGD train step under
+the eager plane (shard_map + the optimizer's explicit psum) vs the gspmd
+plane (batch-sharded inputs + compiler-inserted collectives) on the
+forced 8-device CPU mesh — interleaved, best-of-3 per plane like the
+flight section — and reports the gspmd-vs-eager step ratio recorded in
+docs/benchmarks.md (the acceptance bar: gspmd's step time <= eager's,
+i.e. step_time_ratio_gspmd_vs_eager <= 1.0).
+
 With --metrics an additional section reruns the cache_on configuration
 with HOROVOD_METRICS=1 and reports the registry's negotiation-throughput
 overhead against the metrics-off baseline (disabled is the baseline
@@ -273,6 +281,113 @@ def run_device_config(codec: str, steps: int, elems: int,
     return agg
 
 
+def _plane_worker(steps: int, elems: int, plane: str):
+    import time
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import gspmd_plane as gp
+    from horovod_tpu.optimizer import DistributedOptimizer
+
+    hvd.init(build_mesh=False)
+    devs = jax.devices()
+    n = len(devs)
+
+    # One SGD step on an elementwise model: the weight vector IS the
+    # collective payload (elems fp32), the batch is sharded n ways.  An
+    # elementwise (not matmul) backward keeps the comparison about the
+    # planes: the SPMD partitioner lowers a matmul's weight gradient
+    # through a post-all-reduce transpose copy on the CPU backend, a
+    # partitioner artifact that would swamp the collective delta.
+    d = max(8, elems)
+    batch = 2 * n
+    rs = np.random.RandomState(0)
+    x_np = rs.randn(batch, d).astype(np.float32)
+    y_np = rs.randn(batch, d).astype(np.float32)
+    params = {"w": jnp.zeros((d,), jnp.float32)}
+
+    def loss(p, xs, ys):
+        return jnp.mean((xs * p["w"] - ys) ** 2)
+
+    if plane == "gspmd":
+        # gspmd convention: plain jit, batch-sharded inputs, global-mean
+        # loss — GSPMD inserts and schedules the gradient reduction.
+        mesh = gp.build_gspmd_mesh()
+        tx = DistributedOptimizer(optax.sgd(0.01), plane="gspmd")
+        x = jax.device_put(jnp.asarray(x_np),
+                           NamedSharding(mesh, P(gp.BATCH_AXIS)))
+        y = jax.device_put(jnp.asarray(y_np),
+                           NamedSharding(mesh, P(gp.BATCH_AXIS)))
+
+        @jax.jit
+        def step(p, s, xs, ys):
+            g = jax.grad(loss)(p, xs, ys)
+            u, s2 = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+    else:
+        # eager convention: shard_map with the bound mesh axis, explicit
+        # psum-average inside the optimizer.  Inputs are committed
+        # sharded exactly like the gspmd leg — neither plane pays a
+        # per-call scatter.
+        mesh = Mesh(np.asarray(devs), ("hvd",))
+        tx = DistributedOptimizer(optax.sgd(0.01), plane="eager")
+        x = jax.device_put(jnp.asarray(x_np),
+                           NamedSharding(mesh, P("hvd")))
+        y = jax.device_put(jnp.asarray(y_np),
+                           NamedSharding(mesh, P("hvd")))
+
+        def shard_step(p, s, xs, ys):
+            g = jax.grad(loss)(p, xs, ys)
+            u, s2 = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+
+        try:
+            sm = shard_map(shard_step, mesh=mesh,
+                           in_specs=(P(), P(), P("hvd"), P("hvd")),
+                           out_specs=(P(), P()), check_rep=False)
+        except TypeError:  # newer jax renamed the kwarg
+            sm = shard_map(shard_step, mesh=mesh,
+                           in_specs=(P(), P(), P("hvd"), P("hvd")),
+                           out_specs=(P(), P()), check_vma=False)
+        step = jax.jit(sm)
+
+    state = tx.init(params)
+    p, s = step(params, state, x, y)  # compile outside the timed loop
+    jax.tree_util.tree_leaves(p)[0].block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, s = step(p, s, x, y)
+    jax.tree_util.tree_leaves(p)[0].block_until_ready()
+    dt = time.perf_counter() - t0
+
+    hvd.shutdown()
+    return {"steps_per_s": steps / dt, "plane": plane,
+            "grad_bytes": d * 4}
+
+
+def run_plane_config(plane: str, steps: int, elems: int):
+    from horovod_tpu.runner import run
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    results = run(_plane_worker, args=(steps, elems, plane), np=1, env=env,
+                  stream_prefix=False)
+    agg = dict(results[0])
+    agg.update({"config": f"plane_{plane}",
+                "steps_per_s": round(agg["steps_per_s"], 2)})
+    print(json.dumps(agg), flush=True)
+    return agg
+
+
 def _sweep_worker(steps: int, tensors: int):
     import numpy as np
     import horovod_tpu as hvd
@@ -364,6 +479,13 @@ def main():
     ap.add_argument("--device-mb", type=float, default=4.0,
                     help="fp32 payload size for the device benchmark (MiB)")
     ap.add_argument("--device-steps", type=int, default=20)
+    ap.add_argument("--data-plane", action="store_true",
+                    help="also measure one SGD train step under the eager "
+                         "plane (shard_map + explicit psum) vs the gspmd "
+                         "plane (sharded inputs, compiler-inserted "
+                         "collectives) on the 8-device CPU mesh — "
+                         "interleaved, best-of-3 — and report the "
+                         "gspmd-vs-eager step ratio")
     ap.add_argument("--metrics", action="store_true",
                     help="also measure the metrics registry's negotiation "
                          "overhead: cache_on rerun with HOROVOD_METRICS=1, "
@@ -506,6 +628,27 @@ def main():
             "best_of": 3,
             "steps_ratio_on_vs_off": round(ratio, 3),
             "overhead_pct": round(max(0.0, (1.0 - ratio)) * 100.0, 2),
+        }), flush=True)
+
+    if args.data_plane:
+        # Interleaved best-of-3 like the flight section: loopback wall
+        # clock is noisier than the plane delta being measured.  Same
+        # train step, both calling conventions (docs/architecture.md
+        # "Three data planes"), sized by --device-mb / --device-steps.
+        elems = int(args.device_mb * (1 << 20)) // 4
+        best_eager = best_gspmd = 0.0
+        for _ in range(3):
+            e = run_plane_config("eager", args.device_steps, elems)
+            g = run_plane_config("gspmd", args.device_steps, elems)
+            best_eager = max(best_eager, e["steps_per_s"])
+            best_gspmd = max(best_gspmd, g["steps_per_s"])
+        print(json.dumps({
+            "metric": "data_plane",
+            "best_of": 3,
+            "steps_ratio_gspmd_vs_eager": round(
+                best_gspmd / max(best_eager, 1e-9), 3),
+            "step_time_ratio_gspmd_vs_eager": round(
+                best_eager / max(best_gspmd, 1e-9), 3),
         }), flush=True)
 
     if args.wire_compression:

@@ -56,6 +56,7 @@ OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_flight_record", "hvd_add_process_set2",
                     "hvd_device_plane_note", "hvd_device_plane_stats",
                     "hvd_autotune_qdev", "hvd_autotune_qsched",
+                    "hvd_autotune_plane",
                     "hvd_migrate_note",
                     "hvd_elastic_generation_set", "hvd_step_trace",
                     "hvd_fleet_history"}

@@ -65,6 +65,14 @@ combination of:
            (metrics()["fleet"]) and hvd.fleet_history() serves the
            fleethistory-v1 payload, "off" combos that both stay empty;
            one on-combo in the quick set
+- dplane:  off / gspmd / diff (HOROVOD_DATA_PLANE, the gspmd
+           compiler-inserted gradient-exchange plane over a forced
+           4-device host) — "gspmd" asserts the env-plumbed request
+           reaches the optimizer (ops/gspmd_plane.py selection counter)
+           and a jitted train step runs; "diff" trains the same problem
+           under the eager and gspmd calling conventions and asserts
+           parity within fp32 reduction-order tolerance; the gspmd
+           on-combo rides in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -238,6 +246,93 @@ WORKLOAD = textwrap.dedent("""
             qplain = np.asarray(jax.jit(_smap(
                 lambda shard: lax.pmean(shard, "q")))(jnp.asarray(qx)))
             np.testing.assert_array_equal(qout, qplain)
+
+    # dplane axis: the gspmd data plane (HOROVOD_DATA_PLANE / the
+    # DistributedOptimizer plane= knob) over the forced multi-device host
+    # platform.  "gspmd" asserts the env-plumbed request reaches the
+    # optimizer (selection counter moves) and a jitted train step runs;
+    # "diff" trains the same problem under both planes and asserts parity
+    # within fp32 reduction-order tolerance.
+    dplane = os.environ.get("HVD_MATRIX_DPLANE", "off")
+    if dplane != "off":
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from horovod_tpu.ops import gspmd_plane as gp
+        from horovod_tpu.optimizer import DistributedOptimizer
+
+        devs = jax.devices()
+        assert len(devs) >= 2, "dplane combo expects a forced multi-dev host"
+        drs = np.random.RandomState(7)
+        dx = drs.randn(8 * len(devs), 4).astype(np.float32)
+        dy = drs.randn(8 * len(devs)).astype(np.float32)
+        dp0 = {"w": np.zeros(4, np.float32), "b": np.float32(0.0)}
+
+        def dloss(p, xs, ys):
+            return jnp.mean((xs @ p["w"] + p["b"] - ys) ** 2)
+
+        def train_gspmd(tx):
+            mesh = gp.build_gspmd_mesh()
+            xs = jax.device_put(jnp.asarray(dx),
+                                NamedSharding(mesh, P(gp.BATCH_AXIS)))
+            ys = jax.device_put(jnp.asarray(dy),
+                                NamedSharding(mesh, P(gp.BATCH_AXIS)))
+            p = jax.tree_util.tree_map(jnp.asarray, dp0)
+            st = tx.init(p)
+
+            @jax.jit
+            def step(p, st, xs, ys):
+                g = jax.grad(dloss)(p, xs, ys)
+                u, st2 = tx.update(g, st, p)
+                return optax.apply_updates(p, u), st2
+
+            for _ in range(3):
+                p, st = step(p, st, xs, ys)
+            return p
+
+        gp.reset_plane_counters()
+        if dplane == "gspmd":
+            # plane unset: HOROVOD_DATA_PLANE=gspmd must have ridden
+            # env.py -> Config -> data_plane_default into the optimizer.
+            pg = train_gspmd(DistributedOptimizer(optax.sgd(0.1)))
+            dc = gp.plane_counters()
+            assert dc.get("gspmd") == 1, dc
+            assert np.isfinite(np.asarray(pg["w"])).all()
+        else:  # diff: eager-vs-gspmd differential parity
+            pg = train_gspmd(DistributedOptimizer(optax.sgd(0.1),
+                                                  plane="gspmd"))
+            emesh = Mesh(np.asarray(devs), ("dpx",))
+            tx_e = DistributedOptimizer(optax.sgd(0.1), plane="eager",
+                                        axis_name="dpx")
+
+            def eshard(p, st, xs, ys):
+                g = jax.grad(dloss)(p, xs, ys)
+                u, st2 = tx_e.update(g, st, p)
+                return optax.apply_updates(p, u), st2
+
+            especs = dict(mesh=emesh, in_specs=(P(), P(), P("dpx"),
+                                                P("dpx")),
+                          out_specs=(P(), P()))
+            try:
+                esm = shard_map(eshard, check_rep=False, **especs)
+            except TypeError:  # newer jax renamed the kwarg
+                esm = shard_map(eshard, check_vma=False, **especs)
+            estep = jax.jit(esm)
+            pe = jax.tree_util.tree_map(jnp.asarray, dp0)
+            ste = tx_e.init(pe)
+            for _ in range(3):
+                pe, ste = estep(pe, ste, jnp.asarray(dx), jnp.asarray(dy))
+            np.testing.assert_allclose(np.asarray(pg["w"]),
+                                       np.asarray(pe["w"]),
+                                       rtol=2e-6, atol=1e-7)
+            np.testing.assert_allclose(np.asarray(pg["b"]),
+                                       np.asarray(pe["b"]),
+                                       rtol=2e-6, atol=1e-7)
 
     # flight axis: the always-on black box must have recorded the work
     # (ctrl frames exist at np>1 only; np=1 has no socket control plane).
@@ -420,6 +515,10 @@ def combos(quick: bool):
                "def", "off", "int8")
         yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
                "def", "off", "int4:bidi")
+        # dplane axis: the one quick gspmd on-combo — HOROVOD_DATA_PLANE
+        # plumbed env -> Config -> optimizer over a forced 4-dev host.
+        yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+               "def", "off", "off", "off", "def", "def", "gspmd")
         # migrate axis: the one quick on-combo — peer-shard replication
         # rides a committed elastic state over the shm data plane.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
@@ -512,6 +611,15 @@ def combos(quick: bool):
            "def", "off", "int4:torus")
     yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
            "def", "off", "int8g:ring")
+    # dplane axis: the gspmd data plane over a forced 4-device host — the
+    # env-plumbed engagement row (HOROVOD_DATA_PLANE=gspmd reaches the
+    # optimizer, selection counter moves) and the eager-vs-gspmd
+    # differential row (same problem trained under both calling
+    # conventions, parity within fp32 reduction-order tolerance).
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "off", "off", "def", "def", "gspmd")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "off", "off", "def", "def", "diff")
     # Migrate axis: replication across the plane shapes the shards actually
     # ride in production — shm, the flat TCP ring, and the hier topology —
     # plus a metrics-on row so the hvd_migrate_* counters are scraped live.
@@ -667,7 +775,8 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
               autopilot: str, qdev: str, migrate: str, trace: str,
-              fleet: str, script: str, timeout: float) -> tuple:
+              fleet: str, dplane: str, script: str,
+              timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     # The plane axis must own this knob: an ambient setting would
@@ -712,6 +821,9 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # threshold would skew the anomaly-free expectation of "on" combos.
     env.pop("HOROVOD_FLEET_TELEMETRY", None)
     env.pop("HOROVOD_SENTINEL_ZSCORE", None)
+    # The dplane axis owns the data-plane knob: an ambient gspmd request
+    # would reroute every combo's optimizer path.
+    env.pop("HOROVOD_DATA_PLANE", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -771,6 +883,14 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
         env["HOROVOD_STEP_TRACE"] = "1"
     elif trace == "off":
         env["HOROVOD_STEP_TRACE"] = "0"
+    if dplane != "off":
+        env["HVD_MATRIX_DPLANE"] = dplane
+        if "xla_force_host_platform_device_count" not in \
+                env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=4")
+        if dplane == "gspmd":
+            env["HOROVOD_DATA_PLANE"] = "gspmd"
     if fleet == "on":
         # The fleet plane rides the metrics registry: sketches encode the
         # local histograms, so the combo forces the metrics plane on.
@@ -833,17 +953,22 @@ def main() -> int:
                 combo = combo + ("def",)
             if len(combo) == 14:  # rows predating the fleet axis
                 combo = combo + ("def",)
+            if len(combo) == 15:  # rows predating the dplane axis
+                combo = combo + ("off",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
-             tree, flight, autopilot, qdev, migrate, trace, fleet) = combo
+             tree, flight, autopilot, qdev, migrate, trace, fleet,
+             dplane) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
                      f"flight={flight:<4} ap={autopilot} qdev={qdev} "
-                     f"mig={migrate} trace={trace} fleet={fleet}")
+                     f"mig={migrate} trace={trace} fleet={fleet} "
+                     f"dp={dplane}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
                                        autopilot, qdev, migrate, trace,
-                                       fleet, script=scripts[binding],
+                                       fleet, dplane,
+                                       script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",
                   flush=True)

@@ -220,6 +220,21 @@ def _declare(lib: ctypes.CDLL) -> None:
         lib.hvd_elastic_generation_set.argtypes = [c.c_longlong]
     except AttributeError:
         pass
+    try:
+        # Old-ABI tolerance: a stale .so predating compiled-collective
+        # introspection loses the native gspmd byte counters
+        # (data_plane_stats() falls back to the Python-side inventory
+        # totals), the type-16 forensics and the step-trace plane tag.
+        lib.hvd_gspmd_plane_note.restype = None
+        lib.hvd_gspmd_plane_note.argtypes = [
+            c.c_longlong, c.c_longlong, c.c_longlong]
+        lib.hvd_gspmd_plane_stats.restype = None
+        lib.hvd_gspmd_plane_stats.argtypes = [
+            c.POINTER(c.c_longlong), c.POINTER(c.c_longlong)]
+        lib.hvd_step_trace_note_plane.restype = None
+        lib.hvd_step_trace_note_plane.argtypes = [c.c_int]
+    except AttributeError:
+        pass
 
 
 class NativeCoreError(RuntimeError):
@@ -362,6 +377,26 @@ class NativeCore(CoreBackend):
                 note = self._lib.hvd_device_plane_note
                 _qz.set_native_byte_sink(
                     lambda raw, enc: note(int(raw), int(enc)))
+        if hasattr(self._lib, "hvd_gspmd_plane_note"):
+            # Mirror each gspmd trace's HLO collective inventory into the
+            # native metrics registry (hvd.metrics() / Prometheus / flight
+            # type 16) — once per trace, never per step.
+            try:
+                from .ops import hlo_inspect as _hi
+            except Exception:
+                pass
+            else:
+                gnote = self._lib.hvd_gspmd_plane_note
+                _hi.set_native_sink(
+                    lambda ops, raw, wire: gnote(int(ops), int(raw),
+                                                 int(wire)))
+
+    def step_trace_note_plane(self, plane: int) -> None:
+        """Tag the step-trace ring with the data plane running the steps
+        (-1 unknown, 0 eager, 1 gspmd).  Silently a no-op on a stale .so
+        predating the entry point."""
+        if hasattr(self._lib, "hvd_step_trace_note_plane"):
+            self._lib.hvd_step_trace_note_plane(int(plane))
 
     def shutdown(self) -> None:
         if self._lib.hvd_is_initialized():
@@ -602,7 +637,10 @@ class NativeCore(CoreBackend):
         compression's is wire bytes dropping below the raw (pre-codec)
         bytes, which the data_raw_* counters track.  device_raw /
         device_encoded are the analogous pair for the device plane's
-        quantized in-jit ring (HOROVOD_WIRE_COMPRESSION=device=int8)."""
+        quantized in-jit ring (HOROVOD_WIRE_COMPRESSION=device=int8);
+        gspmd_raw / gspmd_wire are the gspmd plane's — analytic payload
+        vs. wire bytes of the compiler-inserted collectives inventoried
+        at trace time (ops/hlo_inspect.py)."""
         local = ctypes.c_longlong()
         xhost = ctypes.c_longlong()
         raw_local = ctypes.c_longlong()
@@ -624,12 +662,28 @@ class NativeCore(CoreBackend):
                 dev_raw, dev_enc = _qz.device_byte_counters()
             except Exception:
                 pass
+        gspmd_raw = gspmd_wire = 0
+        if hasattr(self._lib, "hvd_gspmd_plane_stats"):
+            a = ctypes.c_longlong()
+            b = ctypes.c_longlong()
+            self._lib.hvd_gspmd_plane_stats(ctypes.byref(a), ctypes.byref(b))
+            gspmd_raw, gspmd_wire = a.value, b.value
+        else:
+            # Stale .so: the Python-side inventory counters hold the same
+            # totals (the native registry only ever sees forwarded notes).
+            try:
+                from .ops import hlo_inspect as _hi
+                gspmd_raw, gspmd_wire = _hi.gspmd_byte_counters()
+            except Exception:
+                pass
         return {"data_sent_local": local.value,
                 "data_sent_xhost": xhost.value,
                 "data_raw_local": raw_local.value,
                 "data_raw_xhost": raw_xhost.value,
                 "device_raw": dev_raw,
-                "device_encoded": dev_enc}
+                "device_encoded": dev_enc,
+                "gspmd_raw": gspmd_raw,
+                "gspmd_wire": gspmd_wire}
 
     _warned_no_metrics = False
 

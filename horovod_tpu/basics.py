@@ -197,9 +197,22 @@ def metrics() -> dict:
     efficiency, stall warnings) and power-of-two-bucket histograms
     (negotiation wait, ring hop latency, shm fence wait).  On rank 0 the
     dict also carries ``cluster`` (per-rank snapshots aggregated by the
-    coordinator) and ``straggler_report``.  Empty when the metrics plane is
-    disabled or the backend has no native registry."""
-    return HorovodContext.instance().core.metrics()
+    coordinator) and ``straggler_report``.  A non-empty dump additionally
+    carries ``plane_counters`` — the gspmd plane's Python-side
+    selection/demotion counters (ops/gspmd_plane.py), rendered by
+    ``metrics_prometheus()`` as ``hvd_plane_demotions_total{reason=...}``
+    / ``hvd_plane_selected_total{plane=...}``.  Empty when the metrics
+    plane is disabled or the backend has no native registry."""
+    dump = HorovodContext.instance().core.metrics()
+    if dump:
+        try:
+            from .ops.gspmd_plane import plane_counters
+            pc = plane_counters()
+        except Exception:
+            pc = {}
+        if pc:
+            dump["plane_counters"] = pc
+    return dump
 
 
 def metrics_prometheus() -> str:

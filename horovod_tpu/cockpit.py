@@ -237,10 +237,28 @@ class CockpitServer:
                     self._clients.remove(q)
 
 
+# Step-trace plane tag -> /state name (step_trace.h: -1 unknown, 0
+# eager, 1 gspmd).  Old native payloads predate the tag entirely; their
+# fleet records carry no "plane" key and degrade to "?" like -1 does.
+_PLANE_NAMES = {0: "eager", 1: "gspmd"}
+
+
+def _tag_steps_with_plane(fleet: List[dict]) -> List[dict]:
+    """Normalize each fleet record's numeric plane tag to its name,
+    tolerating records (old .so, old coordinator) without one."""
+    out = []
+    for f in fleet:
+        f = dict(f or {})
+        f["plane"] = _PLANE_NAMES.get(f.get("plane"), "?")
+        out.append(f)
+    return out
+
+
 def build_state_fn(ctx) -> Callable[[], dict]:
     """The production /state builder over a HorovodContext: elastic
     generation, tenants, straggler windows, migration counters, and the
-    fleet's last-N step breakdowns (rank 0's step-trace ring)."""
+    fleet's last-N step breakdowns (rank 0's step-trace ring), each
+    tagged with the data plane that ran it."""
     import os
 
     def state() -> dict:
@@ -271,7 +289,7 @@ def build_state_fn(ctx) -> Callable[[], dict]:
                 for k in ("migrate_events_total", "migrate_bytes_total",
                           "migrate_fallbacks_total")
             },
-            "steps": trace.get("fleet") or [],
+            "steps": _tag_steps_with_plane(trace.get("fleet") or []),
             "phases": trace.get("phases") or [],
         }
 

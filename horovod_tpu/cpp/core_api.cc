@@ -957,6 +957,39 @@ void hvd_device_plane_stats(long long* raw_bytes, long long* encoded_bytes) {
   *encoded_bytes = m.device_encoded_bytes.load(std::memory_order_relaxed);
 }
 
+// GSPMD-plane (compiler-inserted collective) accounting, reported by the
+// Python HLO inspector (ops/hlo_inspect.py) once per inspected trace:
+// the number of collectives XLA emitted, their analytic raw payload
+// bytes, and the analytic ring wire bytes.  Like the device-plane pair,
+// these tick per trace, never per step — a compiled program cannot count
+// at run time.  Callable before/without init (the registry is
+// process-global); the timeline instant needs a live core.
+void hvd_gspmd_plane_note(long long ops, long long raw_bytes,
+                          long long wire_bytes) {
+  NoteHloInspect(ops, raw_bytes, wire_bytes);
+  if (g != nullptr) {
+    g->timeline.Instant(
+        "HLO_INSPECT", "{\"collectives\":" + std::to_string(ops) +
+                           ",\"raw_bytes\":" + std::to_string(raw_bytes) +
+                           ",\"wire_bytes\":" + std::to_string(wire_bytes) +
+                           "}");
+  }
+}
+
+void hvd_gspmd_plane_stats(long long* raw_bytes, long long* wire_bytes) {
+  auto& m = GlobalMetrics();
+  *raw_bytes = m.gspmd_raw_bytes.load(std::memory_order_relaxed);
+  *wire_bytes = m.gspmd_wire_bytes.load(std::memory_order_relaxed);
+}
+
+// Tags the forming causal steps with the data plane running them
+// (0 eager, 1 gspmd, -1 unknown) — noted by the optimizer at trace time,
+// stamped into each closing step record and the coordinator's fleet
+// records, surfaced by tools/critical_path.py and the cockpit.
+void hvd_step_trace_note_plane(int plane) {
+  StepTraceNotePlane(plane);
+}
+
 // The autotuner's current device-plane codec decision (0=none, 1=int8,
 // 2=int4, 3=int8g; -1 = not initialized).  The Python side polls it
 // between steps and re-traces with the quantized ring when it flips — the

@@ -29,7 +29,8 @@ const char kFlightTypesLegend[] =
     "\"4\":\"verdict\",\"5\":\"ring_hop\",\"6\":\"wire_codec\","
     "\"7\":\"shm_fence\",\"8\":\"shm_map\",\"9\":\"tree_aggregate\","
     "\"10\":\"fault_trip\",\"11\":\"abort\",\"12\":\"digest\","
-    "\"13\":\"autopilot\",\"14\":\"migrate\",\"15\":\"sentinel\"}";
+    "\"13\":\"autopilot\",\"14\":\"migrate\",\"15\":\"sentinel\","
+    "\"16\":\"hloinspect\"}";
 
 // One ring slot.  Four atomics (not a raw struct) so a dump racing a
 // record is a data-race-free torn read at worst — the consumer sorts by

@@ -43,6 +43,8 @@ enum FlightType : int32_t {
                           // b = payload bytes
   kFlightSentinel = 15,   // a = kind<<8 | rank (+1; 0 = fleet-wide),
                           // b = observed value (us or ppm)
+  kFlightHloInspect = 16, // a = compiler-inserted collective op count,
+                          // b = analytic wire bytes for the trace
 };
 
 struct FlightEvent {

@@ -70,6 +70,10 @@ void MetricsRegistry::Reset() {
   sentinel_anomalies_total.store(0, std::memory_order_relaxed);
   device_raw_bytes.store(0, std::memory_order_relaxed);
   device_encoded_bytes.store(0, std::memory_order_relaxed);
+  gspmd_collectives_total.store(0, std::memory_order_relaxed);
+  gspmd_raw_bytes.store(0, std::memory_order_relaxed);
+  gspmd_wire_bytes.store(0, std::memory_order_relaxed);
+  gspmd_traces_total.store(0, std::memory_order_relaxed);
   ctrl_msgs_sent.store(0, std::memory_order_relaxed);
   ctrl_msgs_recv.store(0, std::memory_order_relaxed);
   ctrl_bytes_sent.store(0, std::memory_order_relaxed);
@@ -122,6 +126,14 @@ std::string MetricsRegistry::DumpJson(int rank,
      << device_raw_bytes.load(std::memory_order_relaxed)
      << ",\"device_encoded_bytes\":"
      << device_encoded_bytes.load(std::memory_order_relaxed)
+     << ",\"gspmd_collectives_total\":"
+     << gspmd_collectives_total.load(std::memory_order_relaxed)
+     << ",\"gspmd_raw_bytes\":"
+     << gspmd_raw_bytes.load(std::memory_order_relaxed)
+     << ",\"gspmd_wire_bytes\":"
+     << gspmd_wire_bytes.load(std::memory_order_relaxed)
+     << ",\"gspmd_traces_total\":"
+     << gspmd_traces_total.load(std::memory_order_relaxed)
      << ",\"ctrl_msgs_sent\":"
      << ctrl_msgs_sent.load(std::memory_order_relaxed)
      << ",\"ctrl_msgs_recv\":"
@@ -190,6 +202,23 @@ void NoteMigration(int phase, int64_t bytes, int source_rank) {
     int src = source_rank < 0 ? 0 : (source_rank >= 254 ? 255
                                                         : source_rank + 1);
     FlightRecord(kFlightMigrate, (phase << 8) | src, bytes);
+  }
+}
+
+void NoteHloInspect(int64_t ops, int64_t raw_bytes, int64_t wire_bytes) {
+  MetricsRegistry& m = GlobalMetrics();
+  m.gspmd_traces_total.fetch_add(1, std::memory_order_relaxed);
+  if (ops > 0)
+    m.gspmd_collectives_total.fetch_add(ops, std::memory_order_relaxed);
+  if (raw_bytes > 0)
+    m.gspmd_raw_bytes.fetch_add(raw_bytes, std::memory_order_relaxed);
+  if (wire_bytes > 0)
+    m.gspmd_wire_bytes.fetch_add(wire_bytes, std::memory_order_relaxed);
+  if (FlightOn()) {
+    // a = op count (an inspected trace holds a handful of collectives,
+    // far under 2^31), b = the trace's analytic wire bytes.
+    int32_t a = ops > INT32_MAX ? INT32_MAX : static_cast<int32_t>(ops);
+    FlightRecord(kFlightHloInspect, a, wire_bytes);
   }
 }
 

@@ -99,6 +99,18 @@ struct MetricsRegistry {
   std::atomic<int64_t> device_raw_bytes{0};
   std::atomic<int64_t> device_encoded_bytes{0};
 
+  // GSPMD-plane (compiler-inserted) collective accounting, reported by
+  // the Python-side HLO inspector once per inspected trace
+  // (ops/hlo_inspect.py): the number of collectives XLA emitted, the
+  // analytic raw payload bytes they cover, and the analytic wire bytes a
+  // ring schedule moves for them.  A compiled program cannot count at
+  // run time, so — like the device_* pair above — these tick per trace,
+  // not per step.
+  std::atomic<int64_t> gspmd_collectives_total{0};
+  std::atomic<int64_t> gspmd_raw_bytes{0};
+  std::atomic<int64_t> gspmd_wire_bytes{0};
+  std::atomic<int64_t> gspmd_traces_total{0};
+
   // Control-plane traffic (protocol v9): negotiation frames and payload
   // bytes moved on this rank's ctrl links.  On the coordinator,
   // ctrl_msgs_recv per cycle is the leader-tree acceptance metric —
@@ -186,6 +198,14 @@ enum MigratePhase : int {
 // (under MetricsOn) and records a type-14 flight event (under FlightOn).
 // `source_rank` < 0 means "no specific peer".
 void NoteMigration(int phase, int64_t bytes, int source_rank);
+
+// Shared note point for the compiled-HLO introspection layer, callable
+// from the extern-C ABI before or without hvd_init (the registry is
+// process-global): bumps the gspmd_* counters unconditionally (like the
+// device_plane byte pair — data_plane_stats() serves them with the
+// metrics plane off) and records a type-16 flight event carrying the op
+// count and the analytic wire bytes (under FlightOn).
+void NoteHloInspect(int64_t ops, int64_t raw_bytes, int64_t wire_bytes);
 
 // JSON string-body escaping shared by the timeline writer, the metrics
 // dump, and the error-string paths: quotes, backslashes, and all control

@@ -26,6 +26,7 @@ struct StepRec {
   int64_t start_us = 0;
   int64_t end_us = 0;
   int64_t phase_us[kStepPhases] = {0};
+  int plane = -1;  // -1 unknown, 0 eager, 1 gspmd
 };
 
 // One fleet record per step id on the coordinator.  Keyed by
@@ -41,6 +42,7 @@ struct FleetRec {
   // step completes; only the first report per (rank, step) counts.
   std::vector<uint8_t> rank_reported;
   int reported = 0;
+  int plane = -1;  // coordinator's plane tag when the record formed
 };
 
 struct State {
@@ -54,6 +56,9 @@ struct State {
   std::atomic<int64_t> cur_step{0};
   std::atomic<int64_t> cur_phase_us[kStepPhases] = {};
   std::atomic<int64_t> cur_start_us{0};
+  // Sticky data-plane tag (StepTraceNotePlane): -1 unknown, 0 eager,
+  // 1 gspmd.  Written once per trace, read once per Advance.
+  std::atomic<int> cur_plane{-1};
 
   std::mutex mu;  // guards everything below
   std::vector<StepRec> ring;
@@ -92,6 +97,7 @@ FleetRec* FleetFor(State& s, int64_t step_id) {
   f.rank_neg_us.assign(s.world, 0);
   f.rank_reported.assign(s.world, 0);
   f.reported = 0;
+  f.plane = s.cur_plane.load(std::memory_order_relaxed);
   ++s.fleet_seen;
   return &f;
 }
@@ -146,7 +152,7 @@ void AppendFleetJson(std::ostringstream& os, const FleetRec& f) {
   }
   os << "],\"reported\":" << f.reported << ",\"dominant_phase\":\""
      << StepPhaseName(DominantPhase(f.phase_us)) << "\",\"dominant_rank\":"
-     << DominantRank(f) << "}";
+     << DominantRank(f) << ",\"plane\":" << f.plane << "}";
 }
 
 }  // namespace
@@ -180,6 +186,7 @@ void InitStepTrace(bool enabled, int slots, const std::string& postmortem_dir,
   s.last = StepRec();
   s.cur_step.store(0, std::memory_order_relaxed);
   for (auto& a : s.cur_phase_us) a.store(0, std::memory_order_relaxed);
+  s.cur_plane.store(-1, std::memory_order_relaxed);
   s.cur_start_us.store(NowUs(), std::memory_order_relaxed);
   std::string dir = postmortem_dir;
   auto pos = dir.find("{rank}");
@@ -193,6 +200,11 @@ void StepTraceAddPhaseUs(int phase, int64_t us) {
   if (!StepTraceOn()) return;
   if (phase < 0 || phase >= kStepPhases || us <= 0) return;
   S().cur_phase_us[phase].fetch_add(us, std::memory_order_relaxed);
+}
+
+void StepTraceNotePlane(int plane) {
+  if (plane < -1 || plane > 1) return;
+  S().cur_plane.store(plane, std::memory_order_relaxed);
 }
 
 void StepTraceAdvance(int64_t step_id) {
@@ -210,6 +222,7 @@ void StepTraceAdvance(int64_t step_id) {
     // step (a few microseconds of drift) instead of being double-counted.
     rec.phase_us[p] = s.cur_phase_us[p].exchange(0, std::memory_order_relaxed);
   }
+  rec.plane = s.cur_plane.load(std::memory_order_relaxed);
   if (!s.ring.empty()) {
     s.ring[static_cast<size_t>(s.completed) % s.ring.size()] = rec;
   }
@@ -326,7 +339,9 @@ std::string StepTraceDumpJson() {
     first = false;
     os << '[' << r.step_id << ',' << r.start_us << ',' << r.end_us;
     for (int p = 0; p < kStepPhases; ++p) os << ',' << r.phase_us[p];
-    os << ']';
+    // Trailing plane tag (steptrace-v1 stays the schema: consumers index
+    // the phase columns positionally and tolerate extra elements).
+    os << ',' << r.plane << ']';
   }
   os << "],\"fleet\":[";
   // Ascending step order: walk the ring sorted by id (ids are sparse in
@@ -379,6 +394,7 @@ void ResetStepTraceForTest() {
   s.dump_path.clear();
   s.cur_step.store(0, std::memory_order_relaxed);
   for (auto& a : s.cur_phase_us) a.store(0, std::memory_order_relaxed);
+  s.cur_plane.store(-1, std::memory_order_relaxed);
 }
 
 }  // namespace hvdtpu

@@ -65,6 +65,14 @@ void InitStepTrace(bool enabled, int slots, const std::string& postmortem_dir,
 // Callable from any thread (relaxed fetch_add).
 void StepTraceAddPhaseUs(int phase, int64_t us);
 
+// Tag the steps being formed with the data plane running them: -1
+// unknown, 0 eager, 1 gspmd (compiler-inserted collectives).  Sticky
+// until changed — the optimizer notes it once per trace, not per step.
+// Closed steps carry the tag as a trailing element of their dump row and
+// fleet records inherit the coordinator's current tag, so
+// tools/critical_path.py and the cockpit can attribute steps to a plane.
+void StepTraceNotePlane(int plane);
+
 // Close the forming step into the ring and start `step_id`.  Workers call
 // it when the RESPONSES trailer's step id moves past their own; the
 // coordinator when a cycle ships real work.  Ids must be monotonic;

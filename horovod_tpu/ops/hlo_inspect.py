@@ -1,0 +1,479 @@
+"""Compiled-collective introspection for the gspmd data plane.
+
+The gspmd plane (ops/gspmd_plane.py) never builds a collective: it
+annotates shardings and lets ``jax.jit``'s SPMD partitioner insert and
+schedule the collectives itself.  That makes it the one data plane the
+observability pillars cannot see — no enqueue, no ring hop, no byte
+counter ever fires.  This module closes the gap at the only place the
+plane is visible: the *compiled* HLO module.
+
+At trace time (once per abstract-argument signature, never per step) an
+instrumented train step is lowered and compiled, the optimized module
+text is walked, and every compiler-inserted collective is inventoried:
+op kind (all-reduce / all-gather / reduce-scatter / collective-permute /
+all-to-all, async ``-start`` forms counted once), element type, shape,
+replica-group size, and analytic wire bytes under the ring model the
+host and device planes already use:
+
+- all-reduce:          ``2 * payload * (g - 1) / g``  (reduce-scatter +
+  all-gather halves of the ring algorithm);
+- all-gather / reduce-scatter / all-to-all: ``payload * (g - 1) / g``
+  (each rank ships every shard but its own);
+- collective-permute:  ``payload`` (one full hop).
+
+``payload`` is the logical full-tensor byte count and ``g`` the
+replica-group size.  The inventory then feeds every pillar: the native
+gspmd byte counters (``hvd.metrics()`` / ``data_plane_stats()`` /
+``hvd_gspmd_*`` Prometheus series) via :func:`set_native_sink`, a
+type-16 ``hloinspect`` flight-recorder event (a = op count, b = wire
+bytes), and the step-trace plane tag so ``tools/critical_path.py`` and
+the cockpit attribute steps to the plane.  ``tools/hlo_report.py``
+renders the same inventory offline.
+
+Cost discipline: ``HOROVOD_HLO_INSPECT=0`` makes :func:`instrument`
+return its argument unchanged — zero per-step work.  Enabled, the only
+per-step cost is an abstract-signature cache lookup; the lower + compile
++ parse happens once per new signature.  Inspection is gated on the
+resolved plane (the optimizer marks traces via :func:`mark_plane`), so
+eager shard_map/psum traces — whose HLO also contains all-reduce ops the
+explicit pillars already count — report an empty inventory rather than
+double-counted bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.env import get_bool
+
+# Collective op kinds inventoried (HLO opcode names, sync form).
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+# HLO element-type bit widths (shape tokens like ``f32[64,8]``).
+_DTYPE_BITS = {
+    "pred": 8, "s4": 4, "u4": 4, "s8": 8, "u8": 8,
+    "f8e4m3fn": 8, "f8e5m2": 8, "s16": 16, "u16": 16, "f16": 16,
+    "bf16": 16, "s32": 32, "u32": 32, "f32": 32,
+    "s64": 64, "u64": 64, "f64": 64, "c64": 64, "c128": 128,
+}
+
+# ``%name = <shape> all-reduce(...)`` — the shape part is captured lazily
+# up to the opcode so tuple shapes (variadic / async forms) survive.
+# ``-done`` halves of async pairs are skipped (the ``-start`` carries the
+# shape and the replica groups; counting both would double every op).
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<shape>.*?)\s*"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<variant>-start|-done)?\(")
+_SHAPE_TOKEN_RE = re.compile(
+    r"(pred|bf16|f8e4m3fn|f8e5m2|[fsuc]\d+)\[([0-9,]*)\]")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9, ]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+
+def enabled() -> bool:
+    """HOROVOD_HLO_INSPECT gate (default on).  Reads the live context's
+    config when initialized, the environment otherwise — same fallback
+    shape as the plane default (ops/gspmd_plane.py)."""
+    try:
+        from ..context import HorovodContext
+        if HorovodContext.initialized():
+            return bool(getattr(HorovodContext.instance().cfg,
+                                "hlo_inspect_enabled", True))
+    except Exception:
+        pass
+    return get_bool("HOROVOD_HLO_INSPECT", True)
+
+
+# ---------------------------------------------------------------------------
+# Inventory model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One compiler-inserted collective from an optimized HLO module."""
+
+    kind: str            # sync opcode name ("all-reduce", ...)
+    name: str            # HLO instruction name
+    dtype: str           # element type of the first payload operand
+    shape: str           # result shape as printed in the module
+    elements: int        # payload element count (summed over tuple parts)
+    raw_bytes: int       # logical full-tensor bytes exchanged
+    group_size: int      # replica-group size g (world when ungrouped)
+    wire_bytes: int      # analytic ring-model wire bytes
+    asynchronous: bool   # came from an async -start form
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class TraceInventory:
+    """Every collective of one compiled gspmd-plane trace."""
+
+    label: str
+    world: int                       # module partition count
+    ops: List[CollectiveOp]
+    raw_bytes: int
+    wire_bytes: int
+    cost: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def collectives(self) -> int:
+        return len(self.ops)
+
+    def kind_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for op in self.ops:
+            out[op.kind] = out.get(op.kind, 0) + 1
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"label": self.label, "world": self.world,
+                "collectives": self.collectives,
+                "kinds": self.kind_counts(),
+                "raw_bytes": self.raw_bytes,
+                "wire_bytes": self.wire_bytes,
+                "cost": dict(self.cost),
+                "ops": [op.to_dict() for op in self.ops]}
+
+
+def ring_wire_bytes(kind: str, raw_bytes: int, group_size: int) -> int:
+    """Analytic per-device wire bytes for one collective of ``raw_bytes``
+    logical payload over a replica group of ``group_size`` (module
+    docstring).  Exact integer arithmetic so every consumer — the live
+    counters, the tests, tools/hlo_report.py — reproduces the same
+    totals bit-for-bit."""
+    g = max(1, int(group_size))
+    raw = int(raw_bytes)
+    if g <= 1:
+        return 0
+    if kind == "all-reduce":
+        return (2 * raw * (g - 1)) // g
+    if kind in ("all-gather", "reduce-scatter", "all-to-all"):
+        return (raw * (g - 1)) // g
+    return raw  # collective-permute: one full hop
+
+
+def _shape_tokens(shape: str) -> List[Tuple[str, int, int]]:
+    """[(dtype, elements, bytes)] per payload token of a printed shape.
+    Sub-byte and non-8-multiple widths round up per token."""
+    toks: List[Tuple[str, int, int]] = []
+    for dt, dims in _SHAPE_TOKEN_RE.findall(shape):
+        bits = _DTYPE_BITS.get(dt)
+        if bits is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        toks.append((dt, n, (n * bits + 7) // 8))
+    return toks
+
+
+def _shape_payload(shape: str) -> Tuple[str, int, int]:
+    """(dtype, elements, bytes) summed over a shape's payload tokens."""
+    toks = _shape_tokens(shape)
+    if not toks:
+        return "", 0, 0
+    return (toks[0][0], sum(t[1] for t in toks), sum(t[2] for t in toks))
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_LIST_RE.search(line)
+    if m:
+        return len([t for t in m.group(1).split(",") if t.strip()])
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return int(m.group(2))
+    return max(1, int(default))
+
+
+def module_partitions(text: str) -> int:
+    """Partition count from the module header (0 when unstated)."""
+    m = _PARTITIONS_RE.search(text)
+    return int(m.group(1)) if m else 0
+
+
+def inventory_from_text(text: str, world: int = 0,
+                        label: str = "") -> TraceInventory:
+    """Walk optimized HLO module text and inventory every collective.
+
+    ``world`` defaults to the module's own ``num_partitions`` header (1
+    when absent).  Pure text analysis — usable offline on dumped modules
+    (tools/hlo_report.py) as well as on live Compiled objects.
+    """
+    if world <= 0:
+        world = module_partitions(text) or 1
+    ops: List[CollectiveOp] = []
+    for line in text.splitlines():
+        m = _COLLECTIVE_RE.match(line)
+        if m is None:
+            continue
+        if m.group("variant") == "-done":
+            continue  # async pair: the -start already carried the op
+        kind = m.group("kind")
+        dtype, elements, nbytes = _shape_payload(m.group("shape"))
+        if elements == 0 and nbytes == 0:
+            continue
+        g = _group_size(line, world)
+        asynchronous = m.group("variant") == "-start"
+        if asynchronous:
+            # A -start's tuple shape carries (operand, result, ...); the
+            # logical payload is the result alone, so summing the tuple
+            # would double-count.
+            if kind == "all-gather":
+                # The gathered result is the largest tuple part.
+                toks = _shape_tokens(m.group("shape"))
+                if toks:
+                    dtype, elements, nbytes = max(toks, key=lambda t: t[2])
+            else:
+                # all-reduce / collective-permute: operand and result
+                # shapes alias — halve the summed pair.
+                nbytes //= 2
+                elements //= 2
+        raw = nbytes * g if kind == "reduce-scatter" else nbytes
+        ops.append(CollectiveOp(
+            kind=kind, name=m.group("name"), dtype=dtype,
+            shape=m.group("shape"), elements=elements, raw_bytes=raw,
+            group_size=g, wire_bytes=ring_wire_bytes(kind, raw, g),
+            asynchronous=asynchronous))
+    return TraceInventory(
+        label=label, world=world, ops=ops,
+        raw_bytes=sum(op.raw_bytes for op in ops),
+        wire_bytes=sum(op.wire_bytes for op in ops))
+
+
+# ---------------------------------------------------------------------------
+# Counters and the native sink (mirror of ops/quantize.py's byte pair)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_RAW = 0
+_WIRE = 0
+_OPS = 0
+_TRACES = 0
+_INVENTORIES: List[TraceInventory] = []
+_MAX_INVENTORIES = 32
+_NATIVE_SINK: Optional[Callable[[int, int, int], None]] = None
+
+
+def set_native_sink(fn: Optional[Callable[[int, int, int], None]]) -> None:
+    """Register a callable forwarding (ops, raw, wire) per inspected
+    trace to the native metrics registry (NativeCore wires
+    hvd_gspmd_plane_note here) so the inventory shows up in
+    hvd.metrics() / Prometheus and as a type-16 flight event."""
+    global _NATIVE_SINK
+    _NATIVE_SINK = fn
+
+
+def note_inventory(inv: TraceInventory) -> None:
+    """Record one inspected trace: Python-side counters (the stale-.so
+    fallback data_plane_stats() reads), the bounded inventory ring, and
+    the native sink."""
+    global _RAW, _WIRE, _OPS, _TRACES
+    with _LOCK:
+        _RAW += inv.raw_bytes
+        _WIRE += inv.wire_bytes
+        _OPS += inv.collectives
+        _TRACES += 1
+        _INVENTORIES.append(inv)
+        del _INVENTORIES[:-_MAX_INVENTORIES]
+    sink = _NATIVE_SINK
+    if sink is not None:
+        try:
+            sink(int(inv.collectives), int(inv.raw_bytes),
+                 int(inv.wire_bytes))
+        except Exception:
+            pass
+
+
+def gspmd_byte_counters() -> Tuple[int, int]:
+    """(raw, wire) analytic byte totals over every inspected trace."""
+    with _LOCK:
+        return (_RAW, _WIRE)
+
+
+def counters() -> Dict[str, int]:
+    with _LOCK:
+        return {"gspmd_collectives_total": _OPS, "gspmd_raw_bytes": _RAW,
+                "gspmd_wire_bytes": _WIRE, "gspmd_traces_total": _TRACES}
+
+
+def inventories() -> List[TraceInventory]:
+    """The most recent inspected-trace inventories, oldest first."""
+    with _LOCK:
+        return list(_INVENTORIES)
+
+
+def reset() -> None:
+    """Clear counters, inventories and the plane memo (tests)."""
+    global _RAW, _WIRE, _OPS, _TRACES
+    with _LOCK:
+        _RAW = _WIRE = _OPS = _TRACES = 0
+        _INVENTORIES.clear()
+    _STEP_PLANE[0] = -2
+
+
+# ---------------------------------------------------------------------------
+# Plane coupling: the optimizer marks traces, instrument() gates on it
+# ---------------------------------------------------------------------------
+
+_TRACE_TLS = threading.local()
+_STEP_PLANE = [-2]  # last plane noted natively; -2 = never
+_PLANE_IDS = {"eager": 0, "gspmd": 1}
+
+
+def _note_step_plane(plane_id: int) -> None:
+    if _STEP_PLANE[0] == plane_id:
+        return
+    _STEP_PLANE[0] = plane_id
+    try:
+        from ..context import HorovodContext
+        if HorovodContext.initialized():
+            HorovodContext.instance().core.step_trace_note_plane(plane_id)
+    except Exception:
+        pass
+
+
+def mark_plane(plane: str) -> None:
+    """Called by DistributedOptimizer when an update resolves to a plane
+    ("eager" / "gspmd"): tags the trace being formed in this thread (the
+    gspmd gate for :func:`instrument`) and stamps the sticky step-trace
+    plane tag natively (dedup'd, so the eager per-step path pays one list
+    compare after the first note)."""
+    _TRACE_TLS.plane = plane
+    pid = _PLANE_IDS.get(plane, -1)
+    if pid >= 0:
+        _note_step_plane(pid)
+
+
+def _begin_trace() -> None:
+    _TRACE_TLS.plane = None
+
+
+def _end_trace() -> Optional[str]:
+    return getattr(_TRACE_TLS, "plane", None)
+
+
+# ---------------------------------------------------------------------------
+# Live inspection of jitted callables
+# ---------------------------------------------------------------------------
+
+def _compiled_text(compiled) -> str:
+    try:
+        mods = compiled.hlo_modules()
+        if mods:
+            return "\n".join(m.to_string() for m in mods)
+    except Exception:
+        pass
+    try:
+        return compiled.as_text()
+    except Exception:
+        return ""
+
+
+def _cost_summary(compiled) -> Dict[str, float]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    out = {}
+    try:
+        for key in ("flops", "bytes accessed", "optimal_seconds"):
+            if key in ca:
+                out[key.replace(" ", "_")] = float(ca[key])
+    except Exception:
+        return {}
+    return out
+
+
+def _abstract_signature(args, kwargs) -> tuple:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            sig.append(("py", repr(type(leaf))))
+        else:
+            sig.append((tuple(shape), str(dtype)))
+    return (str(treedef), tuple(sig))
+
+
+def inspect_lowered(lowered, label: str = "") -> Optional[TraceInventory]:
+    """Compile a ``jax.jit(...).lower(...)`` result and inventory its
+    compiled module.  Returns None when nothing could be compiled or the
+    module text is unavailable; the inventory is NOT recorded into the
+    counters — callers decide (``instrument`` records only resolved-gspmd
+    traces)."""
+    try:
+        compiled = lowered.compile()
+        text = _compiled_text(compiled)
+        if not text:
+            return None
+        inv = inventory_from_text(text, label=label)
+        inv.cost = _cost_summary(compiled)
+        return inv
+    except Exception:
+        return None
+
+
+def instrument(fn, label: Optional[str] = None):
+    """Wrap a jitted train step with trace-time collective introspection.
+
+    On the first call per abstract-argument signature the wrapper lowers
+    ``fn`` (running the optimizer's trace-time plane resolution), and —
+    only when the trace resolved to the gspmd plane — compiles the
+    lowered module, inventories its collectives and feeds the pillars
+    via :func:`note_inventory`.  Every later call with the same
+    signature is a dict lookup followed by the undecorated ``fn``.
+
+    With HOROVOD_HLO_INSPECT=0 the callable is returned unchanged: the
+    instrumented and uninstrumented steps are then the same object, the
+    zero-overhead bar bench_negotiation.py --hlo-inspect measures.
+    """
+    if not enabled():
+        return fn
+    import functools
+
+    import jax
+
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    name = label or getattr(fn, "__name__", "step")
+    seen: Dict[tuple, bool] = {}
+    lock = threading.Lock()
+
+    def wrapper(*args, **kwargs):
+        key = _abstract_signature(args, kwargs)
+        with lock:
+            first = key not in seen
+            if first:
+                seen[key] = True
+        if first:
+            try:
+                _begin_trace()
+                lowered = jfn.lower(*args, **kwargs)
+                plane = _end_trace()
+            except Exception:
+                plane = None
+            if plane == "gspmd":
+                inv = inspect_lowered(lowered, label=name)
+                if inv is not None:
+                    note_inventory(inv)
+        return jfn(*args, **kwargs)
+
+    try:
+        functools.update_wrapper(wrapper, fn)
+    except Exception:
+        pass
+    return wrapper

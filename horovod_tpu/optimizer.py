@@ -40,6 +40,7 @@ import optax
 from .compression import Compression
 from .mpi_ops import allreduce_async, synchronize, _is_traced
 from .ops import collectives as _jit_ops
+from .ops import hlo_inspect as _hlo
 from .parallel import mesh as _mesh
 from .process_sets import ProcessSet, _resolve_psid
 from .wire import ReduceOp
@@ -406,6 +407,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         )
 
     def update_fn(grads, state: DistributedOptState, params=None):
+        # Plane mark for compiled-collective introspection + the sticky
+        # step-trace plane tag (ops/hlo_inspect.py): trace-time only for
+        # traced paths, memo-deduplicated for the eager per-step path.
+        # The gspmd branch below overrides the tag within its trace.
+        _hlo.mark_plane("eager")
         if backward_passes_per_step == 1:
             leaves = jax.tree_util.tree_leaves(grads)
             if (gspmd_mesh is not None and leaves and _is_traced(leaves[0])
@@ -415,6 +421,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                 # (backprop inserted the reduction); the constraint pins
                 # them replicated so GSPMD schedules that reduce where it
                 # overlaps the optimizer math below.
+                _hlo.mark_plane("gspmd")
                 reduced = _gspmd.constrain_grads(grads, gspmd_mesh)
                 updates, inner = optimizer.update(reduced,
                                                   state.inner_state, params)

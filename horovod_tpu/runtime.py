@@ -269,17 +269,25 @@ class CoreBackend:
         """Cumulative host-data-plane bytes sent, split by locality, plus
         the raw (pre-wire-codec) byte counts (zero for backends without a
         socket data plane).  device_raw / device_encoded track the device
-        plane's quantized in-jit ring and come from the Python-side
-        counters, so every backend reports them."""
+        plane's quantized in-jit ring, gspmd_raw / gspmd_wire the gspmd
+        plane's compiler-inserted collectives; both pairs come from the
+        Python-side counters, so every backend reports them."""
         dev_raw = dev_enc = 0
         try:
             from .ops import quantize as _qz
             dev_raw, dev_enc = _qz.device_byte_counters()
         except Exception:
             pass
+        gspmd_raw = gspmd_wire = 0
+        try:
+            from .ops import hlo_inspect as _hi
+            gspmd_raw, gspmd_wire = _hi.gspmd_byte_counters()
+        except Exception:
+            pass
         return {"data_sent_local": 0, "data_sent_xhost": 0,
                 "data_raw_local": 0, "data_raw_xhost": 0,
-                "device_raw": dev_raw, "device_encoded": dev_enc}
+                "device_raw": dev_raw, "device_encoded": dev_enc,
+                "gspmd_raw": gspmd_raw, "gspmd_wire": gspmd_wire}
 
     def metrics(self) -> dict:
         """Local metrics registry (counters + histograms) as a dict; empty
@@ -308,6 +316,11 @@ class CoreBackend:
         """Record one elastic-migration phase on the forensic planes
         (metrics counters, flight type 14, MIGRATE timeline instant);
         a no-op for backends without the native registry."""
+
+    def step_trace_note_plane(self, plane: int) -> None:
+        """Tag the step-trace ring with the data plane running the steps
+        (-1 unknown, 0 eager, 1 gspmd); a no-op for backends without the
+        native tracer."""
 
     def start_timeline(self, path: str, mark_cycles: bool) -> None:
         raise NotImplementedError

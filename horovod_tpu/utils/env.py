@@ -257,6 +257,14 @@ class Config:
     # overlap the collectives; 'auto' resolves per optimizer — gspmd when
     # it composes, demoting to eager (with a counter) otherwise.
     data_plane: str = "auto"
+    # HOROVOD_HLO_INSPECT: compiled-collective introspection for the gspmd
+    # plane (ops/hlo_inspect.py) — at trace time the lowered module's
+    # compiler-inserted collectives are inventoried and fed to the
+    # observability pillars (gspmd byte counters, flight type 16, the
+    # step-trace plane tag).  On by default: the cost is one extra
+    # lower+compile per trace signature, never per-step work; 0 disables
+    # inspection entirely.
+    hlo_inspect_enabled: bool = True
     # HOROVOD_WIRE_COMPRESSION_MIN_BYTES: payload floor (bytes) below which
     # either plane's codec demotes to the uncompressed path — small tensors
     # are latency- not bandwidth-bound, and the scale overhead erodes the
@@ -379,6 +387,7 @@ class Config:
                 "HOROVOD_WIRE_COMPRESSION_MIN_BYTES", 1 << 16),
             device_schedule=get_device_schedule(),
             data_plane=get_data_plane(),
+            hlo_inspect_enabled=get_bool("HOROVOD_HLO_INSPECT", True),
             timeline_path=env.get("HOROVOD_TIMELINE"),
             timeline_mark_cycles=get_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
             metrics_enabled=get_bool(

@@ -49,6 +49,22 @@ _HELP = {
         "Cumulative fleet-telemetry sketches merged by the coordinator"),
     "hvd_sentinel_anomalies_total": (
         "Cumulative anomalies flagged by the fleet telemetry sentinel"),
+    "hvd_plane_demotions_total": (
+        "Cumulative gspmd-plane demotions by reason "
+        "(ops/gspmd_plane.py demotion contract)"),
+    "hvd_plane_selected_total": (
+        "Optimizers that resolved to the named gradient-exchange plane"),
+    "hvd_gspmd_collectives_total": (
+        "Compiler-inserted collectives inventoried across inspected "
+        "gspmd-plane traces"),
+    "hvd_gspmd_raw_bytes_total": (
+        "Analytic payload bytes of compiler-inserted collectives "
+        "(inspected gspmd-plane traces)"),
+    "hvd_gspmd_wire_bytes_total": (
+        "Analytic ring-model wire bytes of compiler-inserted collectives "
+        "(inspected gspmd-plane traces)"),
+    "hvd_gspmd_traces_total": (
+        "gspmd-plane traces inspected by ops/hlo_inspect.py"),
 }
 
 
@@ -128,6 +144,18 @@ def render_prometheus(dump: Dict) -> str:
         metric = _counter_name(name)
         _meta(lines, seen, metric, "counter")
         lines.append(f'{metric}{{{rank_label}}} {int(value)}')
+    # gspmd-plane selection/demotion counters (Python-side, merged into
+    # the dump by hvd.metrics()): demote_<reason> keys become the
+    # labelled demotions family, plane names the selection family.
+    for name, value in sorted((dump.get("plane_counters") or {}).items()):
+        if name.startswith("demote_"):
+            metric = "hvd_plane_demotions_total"
+            label = f'reason="{_escape_label(name[len("demote_"):])}"'
+        else:
+            metric = "hvd_plane_selected_total"
+            label = f'plane="{_escape_label(name)}"'
+        _meta(lines, seen, metric, "counter")
+        lines.append(f'{metric}{{{rank_label},{label}}} {int(value)}')
     gauges = dump.get("gauges") or {}
     for name, value in sorted(gauges.items()):
         # Gauges keep the bare name — no ``_total`` suffix (they are

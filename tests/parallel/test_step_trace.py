@@ -65,8 +65,12 @@ def test_step_ring_and_fleet_attribution_np2(tmp_path):
         for row in t["steps"]:
             sid, start, end = row[0], row[1], row[2]
             assert end >= start >= 1  # wall-clock us, not zero
-            assert len(row) == 3 + len(PHASES)
-            assert all(us >= 0 for us in row[3:])
+            # 3 id/wall columns + the phase sums + the trailing plane tag
+            # (-1 unknown / 0 eager / 1 gspmd; this host-plane workload
+            # never notes one).
+            assert len(row) == 4 + len(PHASES)
+            assert all(us >= 0 for us in row[3:3 + len(PHASES)])
+            assert row[3 + len(PHASES)] in (-1, 0, 1)
     # Only the coordinator holds fleet records; both ranks reported.
     fleet0 = res[0]["trace"]["fleet"]
     assert fleet0, "coordinator recorded no fleet attribution"

@@ -170,7 +170,9 @@ class _StubCore:
         return {"phases": ["negotiation_wait", "fusion", "ring", "fence",
                            "idle"],
                 "fleet": [{"step": 0, "dominant_phase": "ring",
-                           "dominant_rank": 1}]}
+                           "dominant_rank": 1, "plane": 1},
+                          {"step": 1, "dominant_phase": "fusion",
+                           "dominant_rank": 2}]}
 
     def fleet_history(self):
         return {"schema": "fleethistory-v1",
@@ -205,6 +207,10 @@ def test_maybe_start_cockpit_serves_production_state():
         assert state["schema"] == "cockpit-state-v1"
         assert (state["rank"], state["world"]) == (0, 4)
         assert state["steps"][0]["dominant_phase"] == "ring"
+        # Numeric plane ids from the coordinator are served as names; a
+        # record without the key (older coordinator) degrades to "?".
+        assert state["steps"][0]["plane"] == "gspmd"
+        assert state["steps"][1]["plane"] == "?"
         assert state["tenants"]["default"]["bytes"] == 1024
         assert state["migration"]["migrate_events_total"] == 2
         _, _, body = _get(srv.port, "/metrics")
@@ -216,6 +222,15 @@ def test_maybe_start_cockpit_serves_production_state():
         assert json.loads(body)["schema"] == "fleethistory-v1"
     finally:
         srv.stop()
+
+
+def test_tag_steps_with_plane_degrades():
+    fleet = [{"step": 0, "plane": 0}, {"step": 1, "plane": 1},
+             {"step": 2, "plane": -1}, {"step": 3}]
+    tagged = ck._tag_steps_with_plane(fleet)
+    assert [t["plane"] for t in tagged] == ["eager", "gspmd", "?", "?"]
+    # Records are copied, not mutated: the coordinator may re-serve them.
+    assert fleet[0]["plane"] == 0 and "plane" not in fleet[3]
 
 
 def test_history_route_degrades_without_history_fn():

@@ -96,6 +96,19 @@ def test_render_history_degrades_when_plane_off():
         assert any(top.DIM in ln for ln in lines)
 
 
+def test_render_plane_in_dominant_line():
+    # The cockpit tags each step with its data plane; a single-plane
+    # window names it, a mixed window says so.
+    state = _full_state()
+    for s in state["steps"]:
+        s["plane"] = "gspmd"
+    assert "plane gspmd)" in "\n".join(top.render(state))
+    state["steps"][0]["plane"] = "eager"
+    assert "plane mixed)" in "\n".join(top.render(state))
+    # Old /state payloads carry no plane key: degrade to "?", no crash.
+    assert "plane ?)" in "\n".join(top.render(_full_state()))
+
+
 def test_sparkline_shape():
     assert top.sparkline([]) == ""
     assert len(top.sparkline([1, 2, 3])) == 3

@@ -56,6 +56,16 @@ BUBBLE_PHASES = {"negotiation_wait", "fence", "idle"}
 # cpp/flight_recorder.cc); used only to flag aborted runs in the summary.
 FLIGHT_ABORT_TYPE = 11
 
+# Step-trace plane tag (cpp/step_trace.h: -1 unknown, 0 eager, 1 gspmd),
+# carried as the trailing element of step rows and the "plane" key of
+# fleet records.  Dumps predating the tag simply lack both — every step
+# then attributes to "?".
+PLANE_NAMES = {0: "eager", 1: "gspmd"}
+
+
+def plane_name(tag) -> str:
+    return PLANE_NAMES.get(tag, "?")
+
 
 class RankSteps:
     """Per-rank view: step id -> (start_us, end_us, {phase: us})."""
@@ -63,6 +73,8 @@ class RankSteps:
     def __init__(self, rank: int):
         self.rank = rank
         self.steps: Dict[int, Tuple[int, int, Dict[str, int]]] = {}
+        # step id -> plane tag (only for dumps that carry the trailer).
+        self.planes: Dict[int, int] = {}
 
 
 def _load(path: str):
@@ -92,6 +104,8 @@ def ingest_steptrace(doc: dict, ranks: Dict[int, RankSteps],
         sid, start, end = row[0], row[1], row[2]
         rs.steps[sid] = (start, end,
                          {phases[i]: row[3 + i] for i in range(len(phases))})
+        if len(row) >= 4 + len(phases):  # trailing plane tag (new dumps)
+            rs.planes[sid] = row[3 + len(phases)]
     for f in doc.get("fleet") or []:
         if isinstance(f, dict) and isinstance(f.get("step"), int):
             # Coordinator dumps are authoritative; keep the record with the
@@ -171,7 +185,18 @@ def critical_rows(ranks: Dict[int, RankSteps],
                 busy = {p: us for p, us in phases.items() if p != "idle"}
                 if busy and max(busy.values()) > 0:
                     phase = max(busy, key=busy.get)
+        # Plane attribution: the fleet record's tag when present, else
+        # the first per-rank tag seen for the step (dumps without the
+        # trailer attribute to "?").
+        tag = f.get("plane") if f is not None else None
+        if tag is None:
+            for rs in ranks.values():
+                if sid in rs.planes:
+                    tag = rs.planes[sid]
+                    if tag in PLANE_NAMES:
+                        break
         rows.append({"step": sid, "rank": rank, "phase": phase,
+                     "plane": plane_name(tag),
                      "duration_us": max(wall_us, 0), "source": source})
     return rows
 
@@ -225,6 +250,12 @@ def analyze(paths: List[str]) -> dict:
         summary["dominant_rank"] = rank
         summary["dominant_phase"] = phase
         summary["dominant_steps"] = n
+    # Steps per data plane (the gspmd plane runs no explicit collective,
+    # so this is the only offline surface saying which plane set the pace).
+    planes: Dict[str, int] = {}
+    for r in rows:
+        planes[r["plane"]] = planes.get(r["plane"], 0) + 1
+    summary["plane_steps"] = planes
     return {"rows": rows, "summary": summary, "skipped": skipped}
 
 
@@ -234,10 +265,11 @@ def render(result: dict, last: int) -> str:
     shown = rows[-last:] if last > 0 else rows
     if len(shown) < len(rows):
         lines.append(f"(showing last {len(shown)} of {len(rows)} steps)")
-    lines.append(f"{'step':>6}  {'rank':>4}  {'phase':<18}"
+    lines.append(f"{'step':>6}  {'rank':>4}  {'phase':<18}  {'plane':<6}"
                  f"  {'duration':>10}  src")
     for r in shown:
         lines.append(f"{r['step']:>6}  {r['rank']:>4}  {r['phase']:<18}"
+                     f"  {r.get('plane', '?'):<6}"
                      f"  {r['duration_us']:>8}us  {r['source']}")
     lines.append("")
     frac = summary["bubble_fraction"]
@@ -249,6 +281,13 @@ def render(result: dict, last: int) -> str:
         lines.append(f"critical path: rank {summary['dominant_rank']} / "
                      f"{summary['dominant_phase']} set the pace on "
                      f"{summary['dominant_steps']}/{summary['steps']} steps")
+    planes = summary.get("plane_steps") or {}
+    named = {p: n for p, n in planes.items() if p != "?"}
+    if named:
+        split = ", ".join(f"{p}: {n}" for p, n in sorted(named.items()))
+        lines.append(f"data plane: {split}"
+                     + (f" (untagged: {planes['?']})" if "?" in planes
+                        else ""))
     if summary["aborted"]:
         lines.append("note: a flight-recorder dump records an ABORT — the "
                      "last steps may be partial")

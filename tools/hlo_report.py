@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Render compiled-collective inventories for the gspmd data plane.
+
+The gspmd plane never builds a collective — XLA's SPMD partitioner
+inserts them during compilation — so the only ground truth for "what
+moved over the wire" is the compiled HLO module.  This tool renders that
+inventory (horovod_tpu/ops/hlo_inspect.py) offline, from three sources:
+
+  HLO text dumps      Positional args: optimized-module text files
+                      (``compiled.as_text()`` saved to disk, or an
+                      ``--xla_dump_to`` ``*.after_optimizations.txt``).
+                      Each file is walked for compiler-inserted
+                      collectives: kind, dtype, shape, replica-group
+                      size, and analytic ring-model wire bytes.
+  --bundle DIR        A crash bundle (tools/postmortem.py layout):
+                      every type-16 ``hloinspect`` flight event is
+                      tallied per rank (a = collective op count, b =
+                      analytic wire bytes), so an aborted gspmd run
+                      still reports what its traces inventoried.
+  --live              Self-check: forces an 8-device CPU mesh, runs one
+                      gspmd SGD step through ``hlo_inspect.instrument``,
+                      and verifies the parsed inventory's analytic byte
+                      totals match the live ``gspmd_byte_counters()``
+                      exactly.  Exit code 1 on mismatch — CI-usable.
+
+A ``--metrics FILE`` (a saved ``hvd.metrics()`` JSON dump) cross-checks
+the analytic totals of the HLO inputs against the live
+``gspmd_raw_bytes`` / ``gspmd_wire_bytes`` counters from the run that
+produced the dump: exact match is the contract (both sides use the same
+integer ring model), and a mismatch exits 1.
+
+Usage:
+    python tools/hlo_report.py module.after_optimizations.txt
+    python tools/hlo_report.py dump1.txt dump2.txt --metrics metrics.json
+    python tools/hlo_report.py --bundle /path/to/postmortem-dir
+    python tools/hlo_report.py --live
+    python tools/hlo_report.py ... --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.ops import hlo_inspect  # noqa: E402
+
+# Flight-recorder event type for hloinspect (the four synced copies:
+# cpp/flight_recorder.h, its legend, tools/postmortem.py FLIGHT_TYPES,
+# and the docs/observability.md table).
+FLIGHT_HLO_INSPECT_TYPE = 16
+
+
+# ---------------------------------------------------------------------------
+# Source 1: HLO text dumps
+# ---------------------------------------------------------------------------
+
+def inventories_from_files(paths: List[str]) -> List[hlo_inspect.TraceInventory]:
+    out = []
+    for path in paths:
+        with open(path) as f:
+            text = f.read()
+        out.append(hlo_inspect.inventory_from_text(
+            text, label=os.path.basename(path)))
+    return out
+
+
+def render_inventory(inv: hlo_inspect.TraceInventory, out=sys.stdout) -> None:
+    print(f"\ntrace {inv.label or '<unnamed>'}  "
+          f"(num_partitions={inv.world})", file=out)
+    print("-" * 72, file=out)
+    if not inv.ops:
+        print("  no compiler-inserted collectives", file=out)
+        return
+    print(f"  {'kind':<19} {'dtype':<9} {'elements':>9} {'g':>3} "
+          f"{'raw_bytes':>10} {'wire_bytes':>10}  name", file=out)
+    for op in inv.ops:
+        mark = "*" if op.asynchronous else " "
+        print(f"  {op.kind:<19} {op.dtype:<9} {op.elements:>9} "
+              f"{op.group_size:>3} {op.raw_bytes:>10} {op.wire_bytes:>10} "
+              f"{mark} {op.name}", file=out)
+    kinds = ", ".join(f"{k}: {n}" for k, n in sorted(inv.kind_counts().items()))
+    print(f"  total: {inv.collectives} collectives ({kinds}), "
+          f"raw {inv.raw_bytes} B, analytic wire {inv.wire_bytes} B",
+          file=out)
+    if inv.cost:
+        cost = ", ".join(f"{k}={v:g}" for k, v in sorted(inv.cost.items()))
+        print(f"  compiler cost analysis: {cost}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# Source 2: crash bundles (type-16 flight events)
+# ---------------------------------------------------------------------------
+
+def bundle_hlo_events(path: str) -> Dict[int, Dict[str, int]]:
+    """Tally hloinspect flight events per rank from a postmortem bundle:
+    digests in postmortem.json plus full flight.<rank>.json dumps (which
+    supersede the digest for the same rank)."""
+    if os.path.isdir(path):
+        directory, pm_path = path, os.path.join(path, "postmortem.json")
+    else:
+        directory, pm_path = os.path.dirname(path) or ".", path
+    per_rank_events: Dict[int, List[list]] = {}
+    types: Dict[str, str] = {}
+    if os.path.exists(pm_path):
+        with open(pm_path) as f:
+            pm = json.load(f)
+        types = pm.get("types") or {}
+        for rank_str, rec in (pm.get("ranks") or {}).items():
+            per_rank_events[int(rank_str)] = rec.get("events") or []
+    for fp in sorted(glob.glob(os.path.join(directory, "flight.*.json"))):
+        m = re.match(r"flight\.(\d+)\.json$", os.path.basename(fp))
+        if not m:
+            continue
+        try:
+            with open(fp) as f:
+                dump = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        types = types or dump.get("types") or {}
+        per_rank_events[int(m.group(1))] = dump.get("events") or []
+
+    def _is_hlo(typ: int) -> bool:
+        name = types.get(str(typ))
+        if name is not None:
+            return name == "hloinspect"
+        return typ == FLIGHT_HLO_INSPECT_TYPE
+
+    tally: Dict[int, Dict[str, int]] = {}
+    for rank, events in per_rank_events.items():
+        rows = [e for e in events
+                if isinstance(e, list) and len(e) >= 6 and _is_hlo(e[2])]
+        if rows:
+            tally[rank] = {"traces": len(rows),
+                           "ops": sum(e[4] for e in rows),
+                           "wire_bytes": sum(e[5] for e in rows)}
+    return tally
+
+
+def render_bundle(tally: Dict[int, Dict[str, int]], out=sys.stdout) -> None:
+    print("\nhloinspect flight events (type 16) per rank", file=out)
+    print("-" * 72, file=out)
+    if not tally:
+        print("  none recorded (eager-plane run, HOROVOD_HLO_INSPECT=0, "
+              "or a pre-introspection .so)", file=out)
+        return
+    for rank in sorted(tally):
+        t = tally[rank]
+        print(f"  rank {rank:<4} traces={t['traces']:<4} "
+              f"collectives={t['ops']:<6} "
+              f"analytic wire bytes={t['wire_bytes']}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# Cross-checks
+# ---------------------------------------------------------------------------
+
+def crosscheck_metrics(invs: List[hlo_inspect.TraceInventory],
+                       metrics_path: str, out=sys.stdout) -> bool:
+    """Compare the HLO inputs' analytic totals against the gspmd byte
+    counters of a saved hvd.metrics() dump.  Exact equality is the bar:
+    live counters and this tool share one integer wire model."""
+    with open(metrics_path) as f:
+        dump = json.load(f)
+    counters = dump.get("counters") or {}
+    live_raw = int(counters.get("gspmd_raw_bytes", 0))
+    live_wire = int(counters.get("gspmd_wire_bytes", 0))
+    raw = sum(i.raw_bytes for i in invs)
+    wire = sum(i.wire_bytes for i in invs)
+    ok = (raw == live_raw) and (wire == live_wire)
+    print(f"\ncross-check vs {metrics_path}", file=out)
+    print("-" * 72, file=out)
+    print(f"  analytic (HLO inputs): raw {raw} B, wire {wire} B", file=out)
+    print(f"  live counters        : raw {live_raw} B, wire {live_wire} B",
+          file=out)
+    print(f"  {'MATCH' if ok else 'MISMATCH'}", file=out)
+    return ok
+
+
+def live_check(devices: int = 8, out=sys.stdout) -> bool:
+    """Run one gspmd SGD step on a forced multi-device CPU mesh through
+    the instrumented path and verify inventory == live counters."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    os.environ.pop("HOROVOD_HLO_INSPECT", None)  # the check needs it on
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.ops import gspmd_plane as gp
+    from horovod_tpu.optimizer import DistributedOptimizer
+
+    hlo_inspect.reset()
+    mesh = gp.build_gspmd_mesh()
+    rs = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(rs.randn(64, 4), jnp.float32),
+                       NamedSharding(mesh, P(gp.BATCH_AXIS)))
+    y = jax.device_put(jnp.asarray(rs.randn(64), jnp.float32),
+                       NamedSharding(mesh, P(gp.BATCH_AXIS)))
+    params = {"w": jnp.zeros((4,), jnp.float32),
+              "b": jnp.zeros((), jnp.float32)}
+    tx = DistributedOptimizer(optax.sgd(0.1), plane="gspmd")
+    state = tx.init(params)
+
+    def step(p, s, xs, ys):
+        def loss(p):
+            return jnp.mean((xs @ p["w"] + p["b"] - ys) ** 2)
+        g = jax.grad(loss)(p)
+        u, s2 = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    wrapped = hlo_inspect.instrument(jax.jit(step), label="live_check")
+    params, state = wrapped(params, state, x, y)
+    jax.block_until_ready(params)
+
+    invs = hlo_inspect.inventories()
+    raw, wire = hlo_inspect.gspmd_byte_counters()
+    for inv in invs:
+        render_inventory(inv, out=out)
+    a_raw = sum(i.raw_bytes for i in invs)
+    a_wire = sum(i.wire_bytes for i in invs)
+    ok = bool(invs) and invs[0].collectives > 0 \
+        and a_raw == raw and a_wire == wire
+    print(f"\nlive check: {len(invs)} trace(s), analytic raw/wire "
+          f"{a_raw}/{a_wire} B vs counters {raw}/{wire} B -> "
+          f"{'MATCH' if ok else 'MISMATCH'}", file=out)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("hlo", nargs="*",
+                   help="optimized HLO module text dumps to inventory")
+    p.add_argument("--bundle", default=None, metavar="DIR",
+                   help="postmortem bundle: tally type-16 flight events")
+    p.add_argument("--metrics", default=None, metavar="FILE",
+                   help="saved hvd.metrics() JSON: cross-check byte totals")
+    p.add_argument("--live", action="store_true",
+                   help="self-check on a forced multi-device CPU mesh")
+    p.add_argument("--devices", type=int, default=8,
+                   help="forced CPU device count for --live (default 8)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit machine-readable JSON instead of text")
+    args = p.parse_args(argv)
+    if not (args.hlo or args.bundle or args.live):
+        p.error("nothing to do: give HLO dumps, --bundle, or --live")
+
+    result: Dict[str, object] = {}
+    ok = True
+    invs = inventories_from_files(args.hlo) if args.hlo else []
+    if invs:
+        result["traces"] = [i.to_dict() for i in invs]
+    if args.bundle:
+        tally = bundle_hlo_events(args.bundle)
+        result["bundle"] = {str(r): t for r, t in sorted(tally.items())}
+    sink = sys.stderr if args.as_json else sys.stdout
+    if args.live:
+        ok = live_check(args.devices, out=sink) and ok
+        result["live_ok"] = ok
+    if not args.as_json:
+        for inv in invs:
+            render_inventory(inv)
+        if args.bundle:
+            render_bundle(bundle_hlo_events(args.bundle))
+    if args.metrics:
+        if not invs:
+            print("--metrics needs HLO inputs to cross-check against",
+                  file=sys.stderr)
+            return 2
+        match = crosscheck_metrics(invs, args.metrics, out=sink)
+        result["metrics_match"] = match
+        ok = match and ok
+    if args.as_json:
+        json.dump(result, sys.stdout, indent=2)
+        print()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,9 @@ OLD_ABI_TOLERANT = {"hvd_metrics_dump", "hvd_data_plane_stats2",
                     "hvd_autotune_plane",
                     "hvd_migrate_note",
                     "hvd_elastic_generation_set", "hvd_step_trace",
-                    "hvd_fleet_history"}
+                    "hvd_fleet_history",
+                    "hvd_gspmd_plane_note", "hvd_gspmd_plane_stats",
+                    "hvd_step_trace_note_plane"}
 
 # HOROVOD_* variables read directly by C++ getenv (not routed through
 # utils/env.py): plane/topology knobs consumed below the ctypes ABI, where

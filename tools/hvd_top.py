@@ -142,11 +142,17 @@ def render(state: dict, width: int = 78, color: bool = False,
                      "(N=negotiation-wait F=fusion R=ring B=fence I=idle):")
         lines.append("  " + stacked_bar(totals, min(width - 4, 60), color))
         latest = shown[-1]
+        # Data-plane tag per step (cockpit normalizes the numeric tag;
+        # "?" covers old payloads and steps traced before any optimizer
+        # noted a plane).
+        planes = {s.get("plane", "?") for s in shown}
+        plane = planes.pop() if len(planes) == 1 else "mixed"
         lines.append(
             f"  dominant: {latest.get('dominant_phase', '?')}"
             f" on rank {latest.get('dominant_rank', -1)}"
             f"  (step {latest.get('step')},"
-            f" {latest.get('reported', 0)} ranks reported)")
+            f" {latest.get('reported', 0)} ranks reported,"
+            f" plane {plane})")
         lines.append("")
         lines.append("per-rank announce lag (latest step):")
         lines.extend(skew_lines(latest.get("lag_us") or []))

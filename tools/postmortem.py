@@ -95,7 +95,7 @@ FLIGHT_TYPES = {
     1: "ctrl_send", 2: "ctrl_recv", 3: "rendezvous", 4: "verdict",
     5: "ring_hop", 6: "wire_codec", 7: "shm_fence", 8: "shm_map",
     9: "tree_aggregate", 10: "fault_trip", 11: "abort", 12: "digest",
-    13: "autopilot", 14: "migrate", 15: "sentinel",
+    13: "autopilot", 14: "migrate", 15: "sentinel", 16: "hloinspect",
 }
 
 
@@ -123,6 +123,11 @@ def _fmt_event(row: List[int], types: Dict[str, str],
         rank_s = str(rank) if rank >= 0 else "-"
         return (f"{rel}seq={seq:<8} {name:<14} tid={tid} "
                 f"kind={kind} rank={rank_s} value={b}")
+    if name == "hloinspect":
+        # a = compiler-inserted collective op count for the inspected
+        # gspmd trace; b = its analytic wire bytes (ops/hlo_inspect.py).
+        return (f"{rel}seq={seq:<8} {name:<14} tid={tid} "
+                f"ops={a} wire_bytes={b}")
     return f"{rel}seq={seq:<8} {name:<14} tid={tid} a={a} b={b}"
 
 

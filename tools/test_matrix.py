@@ -73,6 +73,14 @@ combination of:
            under the eager and gspmd calling conventions and asserts
            parity within fp32 reduction-order tolerance; the gspmd
            on-combo rides in the quick set
+- hloinspect: def / on / off (HOROVOD_HLO_INSPECT, compiled-collective
+           introspection over a forced 8-device host) — "on" runs a
+           gspmd-plane train step through ops/hlo_inspect.instrument and
+           asserts a non-empty collective inventory whose analytic byte
+           totals match the live gspmd counters exactly; "off" asserts
+           HOROVOD_HLO_INSPECT=0 returns the step unchanged (identity
+           wrapper, zero per-step work) and every counter stays zero;
+           the on-combo rides in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
 consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
@@ -334,6 +342,62 @@ WORKLOAD = textwrap.dedent("""
                                        np.asarray(pe["b"]),
                                        rtol=2e-6, atol=1e-7)
 
+    # hloinspect axis: compiled-collective introspection — a gspmd-plane
+    # train step through ops/hlo_inspect.instrument must yield a
+    # non-empty inventory whose analytic byte totals match the live
+    # counters exactly; "off" asserts HOROVOD_HLO_INSPECT=0 makes
+    # instrument the identity (same object back, counters untouched).
+    hli = os.environ.get("HVD_MATRIX_HLOINSPECT", "def")
+    if hli != "def":
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from horovod_tpu.ops import gspmd_plane as gp
+        from horovod_tpu.ops import hlo_inspect as hi
+        from horovod_tpu.optimizer import DistributedOptimizer
+
+        devs = jax.devices()
+        assert len(devs) >= 2, "hloinspect combo expects a multi-dev host"
+        hi.reset()
+        hmesh = gp.build_gspmd_mesh()
+        hn = hmesh.shape[gp.BATCH_AXIS] * 4
+        hrs = np.random.RandomState(11)
+        hx = jax.device_put(jnp.asarray(hrs.randn(hn, 4), jnp.float32),
+                            NamedSharding(hmesh, P(gp.BATCH_AXIS)))
+        hy = jax.device_put(jnp.asarray(hrs.randn(hn), jnp.float32),
+                            NamedSharding(hmesh, P(gp.BATCH_AXIS)))
+        hp = {"w": jnp.zeros((4,), jnp.float32)}
+        htx = DistributedOptimizer(optax.sgd(0.1), plane="gspmd")
+        hst = htx.init(hp)
+
+        def hstep(p, st, xs, ys):
+            def hl(p):
+                return jnp.mean((xs @ p["w"] - ys) ** 2)
+            g = jax.grad(hl)(p)
+            u, st2 = htx.update(g, st, p)
+            return optax.apply_updates(p, u), st2
+
+        hbase = jax.jit(hstep)
+        hwrapped = hi.instrument(hbase, label="matrix")
+        if hli == "on":
+            hp, hst = hwrapped(hp, hst, hx, hy)
+            jax.block_until_ready(hp)
+            hinvs = [i for i in hi.inventories() if i.label == "matrix"]
+            assert hinvs, "gspmd trace yielded no collective inventory"
+            hinv = hinvs[-1]
+            assert hinv.collectives > 0, hinv.to_dict()
+            hraw, hwire = hi.gspmd_byte_counters()
+            assert (hinv.raw_bytes, hinv.wire_bytes) == (hraw, hwire), \
+                (hinv.raw_bytes, hinv.wire_bytes, hraw, hwire)
+        else:  # off: zero-overhead contract — the identity wrapper
+            assert hwrapped is hbase, \
+                "HOROVOD_HLO_INSPECT=0 must return the step unchanged"
+            hp, hst = hwrapped(hp, hst, hx, hy)
+            jax.block_until_ready(hp)
+            assert hi.inventories() == [], "introspection off but recorded"
+            assert hi.gspmd_byte_counters() == (0, 0)
+
     # flight axis: the always-on black box must have recorded the work
     # (ctrl frames exist at np>1 only; np=1 has no socket control plane).
     fl = os.environ.get("HOROVOD_FLIGHT_RECORDER", "")
@@ -375,9 +439,9 @@ WORKLOAD = textwrap.dedent("""
         assert t.get("completed", 0) > 0, t
         assert t["phases"] == ["negotiation_wait", "fusion", "ring",
                                "fence", "idle"], t["phases"]
-        assert t["steps"] and all(len(row) == 8 and row[2] >= row[1] > 0
+        assert t["steps"] and all(len(row) == 9 and row[2] >= row[1] > 0
                                   for row in t["steps"]), t["steps"][:3]
-        assert any(sum(row[3:]) > 0 for row in t["steps"]), t["steps"][:3]
+        assert any(sum(row[3:8]) > 0 for row in t["steps"]), t["steps"][:3]
         if r == 0 and s > 1:
             assert t["fleet"], "coordinator recorded no fleet attribution"
     elif tr == "0":
@@ -519,6 +583,10 @@ def combos(quick: bool):
         # plumbed env -> Config -> optimizer over a forced 4-dev host.
         yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
                "def", "off", "off", "off", "def", "def", "gspmd")
+        # hloinspect axis: the one quick on-combo — a gspmd trace's
+        # inventory matching the live byte counters bit-for-bit.
+        yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+               "def", "off", "off", "off", "def", "def", "off", "on")
         # migrate axis: the one quick on-combo — peer-shard replication
         # rides a committed elastic state over the shm data plane.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
@@ -620,6 +688,13 @@ def combos(quick: bool):
            "def", "off", "off", "off", "def", "def", "gspmd")
     yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
            "def", "off", "off", "off", "def", "def", "diff")
+    # hloinspect axis: compiled-collective introspection on (a gspmd
+    # trace's inventory matches the live counters exactly) and explicitly
+    # off (instrument is the identity, counters stay zero).
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "off", "off", "def", "def", "off", "on")
+    yield ("jax", "native", 1, "on", "on", "shm", "none", "off", "auto",
+           "def", "off", "off", "off", "def", "def", "off", "off")
     # Migrate axis: replication across the plane shapes the shards actually
     # ride in production — shm, the flat TCP ring, and the hier topology —
     # plus a metrics-on row so the hvd_migrate_* counters are scraped live.
@@ -775,7 +850,7 @@ def run_check(cmds, cwd: str, timeout: float) -> tuple:
 def run_combo(core: str, np_: int, fusion: str, cache: str,
               plane: str, wire: str, metrics: str, tree: str, flight: str,
               autopilot: str, qdev: str, migrate: str, trace: str,
-              fleet: str, dplane: str, script: str,
+              fleet: str, dplane: str, hloinspect: str, script: str,
               timeout: float) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -824,6 +899,9 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # The dplane axis owns the data-plane knob: an ambient gspmd request
     # would reroute every combo's optimizer path.
     env.pop("HOROVOD_DATA_PLANE", None)
+    # The hloinspect axis owns the introspection knob: "off" combos
+    # assert the identity-wrapper contract an ambient =1 would break.
+    env.pop("HOROVOD_HLO_INSPECT", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if core == "purepy":
@@ -891,6 +969,13 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
                                 " --xla_force_host_platform_device_count=4")
         if dplane == "gspmd":
             env["HOROVOD_DATA_PLANE"] = "gspmd"
+    if hloinspect != "def":
+        env["HVD_MATRIX_HLOINSPECT"] = hloinspect
+        env["HOROVOD_HLO_INSPECT"] = "1" if hloinspect == "on" else "0"
+        if "xla_force_host_platform_device_count" not in \
+                env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=8")
     if fleet == "on":
         # The fleet plane rides the metrics registry: sketches encode the
         # local histograms, so the combo forces the metrics plane on.
@@ -955,19 +1040,21 @@ def main() -> int:
                 combo = combo + ("def",)
             if len(combo) == 15:  # rows predating the dplane axis
                 combo = combo + ("off",)
+            if len(combo) == 16:  # rows predating the hloinspect axis
+                combo = combo + ("def",)
             (binding, core, np_, fusion, cache, plane, wire, metrics,
              tree, flight, autopilot, qdev, migrate, trace, fleet,
-             dplane) = combo
+             dplane, hloinspect) = combo
             label = (f"bind={binding:<5} core={core:<7} np={np_} "
                      f"fusion={fusion:<3} cache={cache:<3} plane={plane:<4} "
                      f"wire={wire:<4} metrics={metrics:<3} tree={tree:<4} "
                      f"flight={flight:<4} ap={autopilot} qdev={qdev} "
                      f"mig={migrate} trace={trace} fleet={fleet} "
-                     f"dp={dplane}")
+                     f"dp={dplane} hlo={hloinspect}")
             ok, dt, detail = run_combo(core, np_, fusion, cache, plane,
                                        wire, metrics, tree, flight,
                                        autopilot, qdev, migrate, trace,
-                                       fleet, dplane,
+                                       fleet, dplane, hloinspect,
                                        script=scripts[binding],
                                        timeout=args.timeout)
             print(f"{'PASS' if ok else 'FAIL'}  {label}  ({dt:5.1f}s)",

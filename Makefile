@@ -10,4 +10,7 @@ selftest:
 test:
 	env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
-.PHONY: lint selftest test
+clean:
+	$(MAKE) -C horovod_tpu/cpp clean
+
+.PHONY: lint selftest test clean

@@ -199,7 +199,13 @@ FaultAction FaultCheck(FaultSite site, int rank, long long* arg) {
     if (rule.cycle >= 0) {
       if (hit != rule.cycle) continue;
       bool expected = false;
-      if (!rule.fired.compare_exchange_strong(expected, true)) continue;
+      // relaxed both ways: the once-latch needs only RMW atomicity
+      // (exactly one winner); no payload is published through the flag.
+      if (!rule.fired.compare_exchange_strong(expected, true,
+                                              std::memory_order_relaxed,
+                                              std::memory_order_relaxed)) {
+        continue;
+      }
     }
     if (rule.action == FaultAction::kDie && !rule.arg_str.empty()) {
       // Once-latch: fire only if we can create the flag file.  A respawned

@@ -69,7 +69,11 @@ struct State {
 };
 
 State& S() {
-  static State* s = new State();  // never destroyed: signal-safe forever
+  // Never destroyed, and allocated exactly once — at init time: handlers
+  // install strictly after the first S() call, so the signal path only
+  // ever takes the already-initialized fast path.
+  // lint: sigsafe-ok(one-time init allocation precedes handler install)
+  static State* s = new State();
   return *s;
 }
 
@@ -359,7 +363,14 @@ void FlightDumpToFile() {
   State& s = S();
   if (s.dump_path[0] == 0) return;
   bool expected = false;
-  if (!s.dumping.compare_exchange_strong(expected, true)) return;
+  // acquire on the winning latch: the dumper must observe every ring
+  // write published (release) by recorder threads before it started;
+  // failure needs no ordering (the loser just returns).
+  if (!s.dumping.compare_exchange_strong(expected, true,
+                                         std::memory_order_acquire,
+                                         std::memory_order_relaxed)) {
+    return;
+  }
   int fd = ::open(s.tmp_path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (fd >= 0) {
     SafeWriter w;
@@ -369,7 +380,9 @@ void FlightDumpToFile() {
     ::close(fd);
     ::rename(s.tmp_path, s.dump_path);
   }
-  s.dumping.store(false);
+  // release: the completed dump (file rename included) must be visible
+  // before the next dumper can win the latch above.
+  s.dumping.store(false, std::memory_order_release);
 }
 
 std::string FlightDumpPath() { return S().dump_path; }

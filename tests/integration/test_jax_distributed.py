@@ -20,7 +20,10 @@ WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
     import numpy as np, jax.numpy as jnp
     from jax.sharding import PartitionSpec as P, NamedSharding
-    from jax import shard_map
+    try:                     # same jax-version drift shim as device_plane
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     import horovod_tpu as hvd
 
     hvd.init()   # jax.distributed via HOROVOD_JAX_DISTRIBUTED + coordinator

@@ -45,7 +45,10 @@ def _eager_worker():
 def _traced_results():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:                     # same jax-version drift shim as device_plane
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     import horovod_tpu as hvd

@@ -9,7 +9,10 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.5 layout
+    from jax.experimental.shard_map import shard_map
 
 import horovod_tpu as hvd
 

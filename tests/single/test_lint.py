@@ -506,3 +506,320 @@ def test_protocol_device_codec_without_enum_id_is_found():
         'DEVICE_WIRE_CODECS = ("none", "int8", "int4", "int8g")',
         'DEVICE_WIRE_CODECS = ("none", "int8", "int4", "int8g", "fp8")'))}
     assert "PROTO-DEVICE-CODEC-UNKNOWN:fp8" in keys
+
+
+# ---------------------------------------------------------------------------
+# atomic pass fixtures: explicit memory_order on always-on hot paths
+# ---------------------------------------------------------------------------
+
+ATOMIC_CC_OK = """
+#include <atomic>
+
+namespace hvdtpu {
+
+std::atomic<long> g_count{0};
+
+void Bump() {
+  g_count.fetch_add(1, std::memory_order_relaxed);
+}
+
+void MultiLineExplicit() {
+  g_count.store(
+      0,
+      std::memory_order_release);
+}
+
+}  // namespace hvdtpu
+"""
+
+# The exact pre-fix shape of the two real violations this PR fixed
+# (flight_recorder.cc dumping latch): a CAS and a store with no order.
+ATOMIC_CC_PREFIX_BUG = """
+void FlightDumpToFile() {
+  bool expected = false;
+  if (!s.dumping.compare_exchange_strong(expected, true)) {
+    return;
+  }
+  s.dumping.store(false);
+}
+"""
+
+
+def _atomic(cc, base="flight_recorder.cc"):
+    return hvd_lint.atomic_pass({f"horovod_tpu/cpp/{base}": cc})
+
+
+def test_atomic_clean_fixture():
+    assert _atomic(ATOMIC_CC_OK) == []
+
+
+def test_atomic_implicit_order_is_found_with_file_and_symbol():
+    findings = _atomic(ATOMIC_CC_PREFIX_BUG)
+    keys = {f.key for f in findings}
+    assert keys == {"ATOMIC-IMPLICIT:flight_recorder.cc:4",
+                    "ATOMIC-IMPLICIT:flight_recorder.cc:7"}
+    by_key = {f.key: f.message for f in findings}
+    assert "FlightDumpToFile" in by_key[
+        "ATOMIC-IMPLICIT:flight_recorder.cc:4"]
+    assert "compare_exchange_strong" in by_key[
+        "ATOMIC-IMPLICIT:flight_recorder.cc:4"]
+    assert "store" in by_key["ATOMIC-IMPLICIT:flight_recorder.cc:7"]
+
+
+def test_atomic_non_hot_file_is_ignored():
+    assert _atomic(ATOMIC_CC_PREFIX_BUG, base="socket_controller.cc") == []
+
+
+def test_atomic_escape_hatch_suppresses_and_goes_stale():
+    excused = ATOMIC_CC_PREFIX_BUG.replace(
+        "  s.dumping.store(false);",
+        "  // lint: seq_cst-ok(fixture wants the full fence)\n"
+        "  s.dumping.store(false);")
+    keys = {f.key for f in _atomic(excused)}
+    assert keys == {"ATOMIC-IMPLICIT:flight_recorder.cc:4"}
+
+    stale = ATOMIC_CC_OK.replace(
+        "void Bump() {",
+        "// lint: seq_cst-ok(nothing here needs it)\nvoid Bump() {")
+    keys = {f.key for f in _atomic(stale)}
+    assert len(keys) == 1 and next(iter(keys)).startswith(
+        "ATOMIC-STALE-OK:flight_recorder.cc:")
+
+
+def test_atomic_order_in_string_or_comment_does_not_excuse():
+    cc = """
+void F() {
+  // memory_order_relaxed (comment must not satisfy the check)
+  g.store(1);
+}
+"""
+    keys = {f.key for f in _atomic(cc)}
+    assert keys == {"ATOMIC-IMPLICIT:flight_recorder.cc:4"}
+
+
+# ---------------------------------------------------------------------------
+# lockorder pass fixtures: acquisition-graph cycles
+# ---------------------------------------------------------------------------
+
+LOCK_CC_CYCLE = """
+#include <mutex>
+
+std::mutex a_mu;
+std::mutex b_mu;
+
+void TakeAB() {
+  std::lock_guard<std::mutex> la(a_mu);
+  std::lock_guard<std::mutex> lb(b_mu);
+}
+
+void TakeBA() {
+  std::lock_guard<std::mutex> lb(b_mu);
+  std::lock_guard<std::mutex> la(a_mu);
+}
+"""
+
+LOCK_CC_SEQUENTIAL = """
+#include <mutex>
+
+std::mutex a_mu;
+std::mutex b_mu;
+
+void Sequential() {
+  {
+    std::lock_guard<std::mutex> la(a_mu);
+  }
+  std::lock_guard<std::mutex> lb(b_mu);
+}
+
+void Sequential2() {
+  {
+    std::lock_guard<std::mutex> lb(b_mu);
+  }
+  std::lock_guard<std::mutex> la(a_mu);
+}
+"""
+
+LOCK_CC_VIA_CALL = """
+#include <mutex>
+
+std::mutex a_mu;
+std::mutex b_mu;
+
+void Inner() {
+  std::lock_guard<std::mutex> la(a_mu);
+}
+
+void Outer() {
+  std::lock_guard<std::mutex> lb(b_mu);
+  Inner();
+}
+
+void Direct() {
+  std::lock_guard<std::mutex> la(a_mu);
+  std::lock_guard<std::mutex> lb(b_mu);
+}
+"""
+
+LOCK_CC_SELF = """
+#include <mutex>
+
+std::mutex m_mu;
+
+void Recur() {
+  std::lock_guard<std::mutex> l1(m_mu);
+  {
+    std::lock_guard<std::mutex> l2(m_mu);
+  }
+}
+"""
+
+
+def _lock(cc, base="socket_controller.cc"):
+    return hvd_lint.lockorder_pass({f"horovod_tpu/cpp/{base}": cc})
+
+
+def test_lockorder_two_function_cycle_has_both_witnesses():
+    findings = _lock(LOCK_CC_CYCLE)
+    keys = {f.key for f in findings}
+    assert keys == {"LOCKORDER-CYCLE:socket_controller.cc:a_mu->b_mu->a_mu"}
+    msg = findings[0].message
+    assert "TakeAB holds a_mu, acquires b_mu" in msg
+    assert "TakeBA holds b_mu, acquires a_mu" in msg
+
+
+def test_lockorder_scope_release_breaks_the_edge():
+    # Same two orders, but the first guard's scope closes before the
+    # second acquisition: no held-while-acquiring edge, no cycle.
+    assert _lock(LOCK_CC_SEQUENTIAL) == []
+
+
+def test_lockorder_cycle_through_callee_closure_is_found():
+    findings = _lock(LOCK_CC_VIA_CALL)
+    keys = {f.key for f in findings}
+    assert keys == {"LOCKORDER-CYCLE:socket_controller.cc:a_mu->b_mu->a_mu"}
+    msg = findings[0].message
+    assert "calls Inner which may acquire a_mu" in msg
+
+
+def test_lockorder_self_deadlock_is_found():
+    keys = {f.key for f in _lock(LOCK_CC_SELF)}
+    assert keys == {"LOCKORDER-SELF:socket_controller.cc:m_mu"}
+
+
+def test_lockorder_non_target_file_is_ignored():
+    assert _lock(LOCK_CC_CYCLE, base="metrics.cc") == []
+
+
+# ---------------------------------------------------------------------------
+# sigsafe pass fixtures: async-signal-safety of the handler call graph
+# ---------------------------------------------------------------------------
+
+SIG_CC_OK = """
+#include <csignal>
+
+void WriteAll(const char* p, long n) {
+  write(2, p, n);
+}
+
+void OnFatalSignal(int signo) {
+  WriteAll("boom", 4);
+  _exit(1);
+}
+
+void InstallHandlers() {
+  struct sigaction sa;
+  sa.sa_handler = OnFatalSignal;
+  sigaction(SIGSEGV, &sa, nullptr);
+}
+"""
+
+
+def test_sigsafe_clean_fixture():
+    assert hvd_lint.sigsafe_pass(SIG_CC_OK) == []
+
+
+def test_sigsafe_snprintf_in_signal_path_is_found_through_helper():
+    cc = SIG_CC_OK.replace(
+        "  write(2, p, n);",
+        "  char buf[64];\n"
+        "  snprintf(buf, 64, \"%s\", p);\n"
+        "  write(2, buf, n);")
+    findings = hvd_lint.sigsafe_pass(cc)
+    keys = {f.key for f in findings}
+    assert keys == {"SIGSAFE-UNSAFE-CALL:WriteAll:snprintf"}
+    assert "OnFatalSignal" in findings[0].message  # names the entry point
+
+
+def test_sigsafe_new_and_lock_in_signal_path_are_found():
+    cc = SIG_CC_OK.replace(
+        "  _exit(1);",
+        "  char* p = new char[64];\n"
+        "  std::lock_guard<std::mutex> l(g_mu);\n"
+        "  _exit(1);")
+    keys = {f.key for f in hvd_lint.sigsafe_pass(cc)}
+    assert any(k.startswith("SIGSAFE-NEW:OnFatalSignal:") for k in keys)
+    assert any(k.startswith("SIGSAFE-LOCK:OnFatalSignal:") for k in keys)
+
+
+def test_sigsafe_unreachable_unsafe_code_is_not_flagged():
+    # malloc in a function never called from the handler: out of scope.
+    cc = SIG_CC_OK + """
+void BackgroundOnly() {
+  char* p = static_cast<char*>(malloc(64));
+  free(p);
+}
+"""
+    assert hvd_lint.sigsafe_pass(cc) == []
+
+
+def test_sigsafe_no_entry_point_is_itself_a_finding():
+    keys = {f.key for f in hvd_lint.sigsafe_pass("void F() {}\n")}
+    assert keys == {"SIGSAFE-NO-ENTRY:flight_recorder.cc"}
+
+
+def test_sigsafe_escape_hatch_suppresses_and_goes_stale():
+    excused = SIG_CC_OK.replace(
+        "  _exit(1);",
+        "  // lint: sigsafe-ok(fixture: provably init-time only)\n"
+        "  Dumper* d = new Dumper();\n"
+        "  _exit(1);")
+    assert hvd_lint.sigsafe_pass(excused) == []
+
+    stale = SIG_CC_OK.replace(
+        "  _exit(1);",
+        "  // lint: sigsafe-ok(excuses nothing)\n"
+        "  _exit(1);")
+    keys = {f.key for f in hvd_lint.sigsafe_pass(stale)}
+    assert len(keys) == 1 and next(iter(keys)).startswith(
+        "SIGSAFE-STALE-OK:flight_recorder.cc:")
+
+
+# ---------------------------------------------------------------------------
+# repo-clean per-pass + --only CLI selection
+# ---------------------------------------------------------------------------
+
+def test_repo_concurrency_passes_clean():
+    for pass_name in ("atomic", "lockorder", "sigsafe"):
+        findings = hvd_lint.run_repo(REPO, only=[pass_name])
+        assert findings == [], "\n".join(
+            f"{f.key}: {f.message}" for f in findings)
+
+
+def test_cli_only_selection_and_timings():
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "hvd_lint.py"),
+         "--only", "atomic,sigsafe"],
+        capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "[atomic]" in run.stdout and "[sigsafe]" in run.stdout
+    assert "[abi]" not in run.stdout and "[lockorder]" not in run.stdout
+    assert " ms)" in run.stdout  # per-pass wall time
+
+
+def test_cli_only_rejects_unknown_pass():
+    run = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "hvd_lint.py"),
+         "--only", "atomic,bogus"],
+        capture_output=True, text=True, timeout=120)
+    assert run.returncode == 2
+    assert "bogus" in run.stderr

@@ -18,6 +18,24 @@ not crash):
             kFlightTypesLegend JSON, tools/postmortem.py's FLIGHT_TYPES
             fallback, and the marked table in docs/observability.md
 
+Three further passes turn the C++ spine's concurrency discipline — the
+invariants TSan can only sample dynamically — into static, fail-on-drift
+checks:
+
+  atomic    every std::atomic load/store/RMW in the always-on hot-path
+            files (ATOMIC_HOT_FILES) must name an explicit memory_order;
+            implicit seq_cst is a finding, escapable per site with
+            `// lint: seq_cst-ok(<reason>)` (stale hatches are findings)
+  lockorder mutex acquisitions per function in LOCKORDER_FILES, closed
+            over the intra-file call graph into an inter-mutex acquisition
+            graph; any cycle (or same-mutex re-acquisition) is reported as
+            a potential deadlock with witness paths
+  sigsafe   from the fatal-signal handlers installed in flight_recorder.cc,
+            walk the intra-file call graph and flag any reachable call
+            outside the async-signal-safe allowlist, any `new`, and any
+            lock — statically pinning the PR 8 signal-dump claim;
+            per-site escape: `// lint: sigsafe-ok(<reason>)`
+
 Each pass is a pure text analysis (no build, no import of horovod_tpu), so
 this runs in tier-1 CI on a bare checkout.  Output is a human report plus
 optional JSON; findings are compared against a committed baseline
@@ -27,6 +45,7 @@ baseline is empty by policy — pre-existing drift gets fixed, not baselined.
 Usage:
     python tools/hvd_lint.py                # human report, exit 1 on new findings
     python tools/hvd_lint.py --json out.json
+    python tools/hvd_lint.py --only atomic,lockorder   # subset, timed
     python tools/hvd_lint.py --update-baseline
 """
 
@@ -38,6 +57,7 @@ import json
 import os
 import re
 import sys
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -134,7 +154,7 @@ INTERNAL_VARS = {
 
 @dataclasses.dataclass
 class Finding:
-    pass_name: str  # "abi" | "env" | "protocol" | "flight"
+    pass_name: str  # one of PASS_NAMES ("abi", "env", ..., "sigsafe")
     key: str        # stable id, e.g. "ABI-ARITY:hvd_init"
     message: str
 
@@ -725,6 +745,606 @@ def flight_pass(fr_h_text: str, fr_cc_text: str, postmortem_text: str,
 
 
 # ---------------------------------------------------------------------------
+# Shared C++ mini-parser for the concurrency passes
+#
+# Pure text analysis, like every other pass: comments and string/char
+# literals are blanked (length-preserving, so offsets stay line-accurate),
+# then function bodies are located by brace matching.  The parser is
+# deliberately scoped to this codebase's style (Google C++, no raw string
+# literals, no preprocessor function definitions); it is not a general C++
+# front end.
+# ---------------------------------------------------------------------------
+
+# `// lint: seq_cst-ok(<reason>)` / `// lint: sigsafe-ok(<reason>)` on the
+# flagged line (or the line immediately above it) suppresses that site.
+# Hatches are stale-checked like the env whitelists: one that no longer
+# suppresses anything is itself a finding.
+_HATCH_RE = re.compile(r"//\s*lint:\s*(seq_cst-ok|sigsafe-ok)\(([^)\n]*)\)")
+
+_CPP_KEYWORDS = {
+    "if", "for", "while", "switch", "catch", "do", "else", "return",
+    "sizeof", "alignof", "decltype", "throw", "case", "default", "new",
+    "delete", "static_cast", "reinterpret_cast", "const_cast",
+    "dynamic_cast", "defined", "not", "and", "or", "assert",
+    "static_assert", "typeid", "noexcept",
+}
+
+
+def strip_cpp(text: str) -> str:
+    """Blank comments and string/char literals, preserving length/newlines."""
+    out = list(text)
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            if j < 0:
+                j = n
+            for k in range(i, j):
+                out[k] = " "
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            for k in range(i, j):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j
+        elif c in "\"'":
+            q = c
+            j = i + 1
+            while j < n and text[j] != q:
+                j += 2 if text[j] == "\\" else 1
+            for k in range(i + 1, min(j, n)):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = min(j, n) + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+def collect_hatches(raw_text: str) -> Dict[int, str]:
+    """{1-based line: hatch kind} for every `// lint: *-ok(...)` comment."""
+    hatches: Dict[int, str] = {}
+    for lineno, line in enumerate(raw_text.splitlines(), 1):
+        m = _HATCH_RE.search(line)
+        if m:
+            hatches[lineno] = m.group(1)
+    return hatches
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _match_brace(text: str, open_pos: int) -> int:
+    """Index of the '}' matching the '{' at open_pos (len(text) if none)."""
+    depth = 0
+    for i in range(open_pos, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i
+    return len(text)
+
+
+def _header_function_name(header: str) -> Optional[str]:
+    """Function name if `header {` opens a function body, else None.
+
+    Containers (namespace/struct/class/enum/extern blocks), control flow,
+    brace initializers, and lambdas all return None.
+    """
+    header = header.strip()
+    # Constructor member-initializer list: cut at the single ':' that sits
+    # at paren depth 0 after the parameter list ("Foo::Foo(x) : a_(x)").
+    depth = 0
+    for i, ch in enumerate(header):
+        if ch in "(<[":
+            depth += 1
+        elif ch in ")>]":
+            depth = max(0, depth - 1)
+        elif (ch == ":" and depth == 0 and header[i - 1:i] != ":"
+              and header[i + 1:i + 2] != ":" and header[:i].rstrip().endswith(")")):
+            header = header[:i]
+            break
+    # Strip trailing qualifiers so the header ends at the param list.
+    while True:
+        stripped = header.rstrip()
+        for qual in ("const", "noexcept", "override", "final"):
+            if stripped.endswith(qual):
+                header = stripped[: -len(qual)]
+                break
+        else:
+            break
+    header = header.rstrip()
+    if not header.endswith(")"):
+        return None
+    # Backward-match the parameter list's opening paren.
+    depth = 0
+    open_idx = -1
+    for i in range(len(header) - 1, -1, -1):
+        if header[i] == ")":
+            depth += 1
+        elif header[i] == "(":
+            depth -= 1
+            if depth == 0:
+                open_idx = i
+                break
+    if open_idx <= 0:
+        return None
+    before = header[:open_idx].rstrip()
+    if before.endswith("]"):  # lambda introducer
+        return None
+    m = re.search(r"([A-Za-z_~]\w*)$", before)
+    if not m:
+        return None
+    name = m.group(1)
+    if name in _CPP_KEYWORDS:
+        return None
+    return name
+
+
+def parse_cpp_functions(stripped: str) -> List[Tuple[str, int, int]]:
+    """[(name, body_open_idx, body_close_idx)] for every function definition.
+
+    Containers (namespaces, classes, extern "C" blocks) are descended into;
+    function bodies are consumed whole, so lambdas and control-flow braces
+    inside them never register as functions of their own.
+    """
+    funcs: List[Tuple[str, int, int]] = []
+    i, n = 0, len(stripped)
+    last_stmt = 0
+    paren = 0
+    while i < n:
+        c = stripped[i]
+        if c == "(":
+            paren += 1
+        elif c == ")":
+            paren = max(0, paren - 1)
+        elif c == ";" and paren == 0:
+            last_stmt = i + 1
+        elif c == "}" and paren == 0:
+            last_stmt = i + 1
+        elif c == "{" and paren == 0:
+            name = _header_function_name(stripped[last_stmt:i])
+            if name is not None:
+                end = _match_brace(stripped, i)
+                funcs.append((name, i, end))
+                i = end
+                last_stmt = i + 1
+            else:
+                last_stmt = i + 1  # container or brace-init: descend
+        i += 1
+    return funcs
+
+
+def _enclosing_function(funcs: Sequence[Tuple[str, int, int]],
+                        pos: int) -> str:
+    for name, start, end in funcs:
+        if start <= pos <= end:
+            return name
+    return "<file scope>"
+
+
+# ---------------------------------------------------------------------------
+# atomic pass: explicit memory_order on every hot-path atomic op
+# ---------------------------------------------------------------------------
+
+# The always-on lock-free subsystems: every atomic op here runs on the
+# negotiation/record hot path (or a crash path) where an accidental
+# seq_cst fence is either a silent throughput tax or an unstated ordering
+# claim.  Each op must name its memory_order so the required ordering is a
+# reviewed decision, not a compiler default.
+ATOMIC_HOT_FILES = {
+    "metrics.cc", "metrics.h",
+    "flight_recorder.cc", "flight_recorder.h",
+    "step_trace.cc", "step_trace.h",
+    "fleet_telemetry.cc", "fleet_telemetry.h",
+    "fault_injection.cc", "fault_injection.h",
+}
+
+_ATOMIC_OP_RE = re.compile(
+    r"\.\s*(load|store|exchange|fetch_add|fetch_sub|fetch_and|fetch_or|"
+    r"fetch_xor|compare_exchange_strong|compare_exchange_weak)\s*\(")
+
+
+def _balanced_args(stripped: str, open_pos: int) -> str:
+    """The argument text of the call whose '(' is at open_pos."""
+    depth = 0
+    for i in range(open_pos, len(stripped)):
+        if stripped[i] == "(":
+            depth += 1
+        elif stripped[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return stripped[open_pos + 1:i]
+    return stripped[open_pos + 1:]
+
+
+def atomic_pass(cc_files: Dict[str, str],
+                hot_files: Optional[set] = None) -> List[Finding]:
+    hot_files = ATOMIC_HOT_FILES if hot_files is None else hot_files
+    findings: List[Finding] = []
+    for path, raw in sorted(cc_files.items()):
+        base = os.path.basename(path)
+        if base not in hot_files:
+            continue
+        stripped = strip_cpp(raw)
+        funcs = parse_cpp_functions(stripped)
+        hatches = collect_hatches(raw)
+        used_hatches: set = set()
+        for m in _ATOMIC_OP_RE.finditer(stripped):
+            op = m.group(1)
+            args = _balanced_args(stripped, m.end() - 1)
+            if "memory_order" in args:
+                continue
+            lineno = _line_of(stripped, m.start())
+            hatch_line = next(
+                (ln for ln in (lineno, lineno - 1)
+                 if hatches.get(ln) == "seq_cst-ok"), None)
+            if hatch_line is not None:
+                used_hatches.add(hatch_line)
+                continue
+            expr = re.search(r"[\w\]\[.>-]*$",
+                             stripped[:m.start()].split("\n")[-1])
+            site = (expr.group(0) if expr and expr.group(0) else "<expr>")
+            findings.append(Finding(
+                "atomic", f"ATOMIC-IMPLICIT:{base}:{lineno}",
+                f"{base}:{lineno} ({_enclosing_function(funcs, m.start())}): "
+                f"{site}.{op}() names no memory_order — implicit seq_cst "
+                f"is an unstated ordering claim (and a fence on the hot "
+                f"path); spell the required order or annotate "
+                f"`// lint: seq_cst-ok(<reason>)`"))
+        for ln in sorted(set(ln for ln, kind in hatches.items()
+                             if kind == "seq_cst-ok") - used_hatches):
+            findings.append(Finding(
+                "atomic", f"ATOMIC-STALE-OK:{base}:{ln}",
+                f"{base}:{ln}: `lint: seq_cst-ok` hatch suppresses nothing "
+                f"(no implicit-order atomic op on this or the next line) — "
+                f"remove it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lockorder pass: inter-mutex acquisition graph, cycles = deadlock risk
+# ---------------------------------------------------------------------------
+
+# The files whose mutexes guard the coordinator / ABI / shm planes.  The
+# analysis is per file: these mutexes are file-local, and internal calls
+# in them are unqualified member/free calls (dotted calls go to other
+# objects — sockets, maps — and are excluded from the call graph).
+LOCKORDER_FILES = {"socket_controller.cc", "core_api.cc", "shm_plane.cc"}
+
+_GUARD_RE = re.compile(
+    r"\b(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\s*<[^;(){}]*>\s*"
+    r"\w+\s*\(\s*([^(),;{}]+?)\s*[,)]")
+
+_CALL_RE = re.compile(r"(?<![\w.>:])([A-Za-z_]\w*)\s*\(")
+
+
+def _mutex_name(expr: str) -> str:
+    """'g->queue_mu' / 'S().init_mu' -> trailing identifier."""
+    ids = re.findall(r"\w+", expr)
+    return ids[-1] if ids else expr.strip()
+
+
+def _function_lock_profile(stripped: str, name: str, body: Tuple[int, int],
+                           local_funcs: set):
+    """(direct_edges, held_calls, acquires, callees) for one function body.
+
+    direct_edges: [(held_mutex, acquired_mutex, lineno)]
+    held_calls:   [(held_mutexes_frozenset, callee, lineno)]
+    acquires:     {mutex} acquired anywhere in the body
+    callees:      {local function} called anywhere in the body
+    """
+    start, end = body
+    text = stripped[start:end + 1]
+    events = []  # (offset, kind, payload)
+    for m in _GUARD_RE.finditer(text):
+        events.append((m.start(), "guard", _mutex_name(m.group(1))))
+    for m in _CALL_RE.finditer(text):
+        callee = m.group(1)
+        if callee in local_funcs and callee != name \
+                and callee not in _CPP_KEYWORDS:
+            events.append((m.start(), "call", callee))
+    events.sort()
+    direct_edges, held_calls = [], []
+    acquires, callees = set(), set()
+    held: List[Tuple[str, int]] = []  # (mutex, depth at declaration)
+    depth = 0
+    ei = 0
+    for i, ch in enumerate(text):
+        while ei < len(events) and events[ei][0] == i:
+            _, kind, payload = events[ei]
+            ei += 1
+            lineno = _line_of(stripped, start + i)
+            if kind == "guard":
+                acquires.add(payload)
+                for held_mu, _ in held:
+                    direct_edges.append((held_mu, payload, lineno))
+                held.append((payload, depth))
+            else:
+                callees.add(payload)
+                if held:
+                    held_calls.append(
+                        (frozenset(mu for mu, _ in held), payload, lineno))
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            held = [(mu, d) for mu, d in held if d <= depth]
+    return direct_edges, held_calls, acquires, callees
+
+
+def lockorder_pass(cc_files: Dict[str, str],
+                   files: Optional[set] = None) -> List[Finding]:
+    files = LOCKORDER_FILES if files is None else files
+    findings: List[Finding] = []
+    for path, raw in sorted(cc_files.items()):
+        base = os.path.basename(path)
+        if base not in files:
+            continue
+        stripped = strip_cpp(raw)
+        funcs = parse_cpp_functions(stripped)
+        local_funcs = {name for name, _, _ in funcs}
+        profiles = {}
+        for name, fstart, fend in funcs:
+            prof = _function_lock_profile(stripped, name, (fstart, fend),
+                                          local_funcs)
+            if name in profiles:  # overloads: union the profiles
+                old = profiles[name]
+                prof = (old[0] + prof[0], old[1] + prof[1],
+                        old[2] | prof[2], old[3] | prof[3])
+            profiles[name] = prof
+
+        # Transitive closure: every mutex a function may acquire, itself
+        # or via any intra-file callee.
+        closure = {name: set(prof[2]) for name, prof in profiles.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, prof in profiles.items():
+                for callee in prof[3]:
+                    extra = closure.get(callee, set()) - closure[name]
+                    if extra:
+                        closure[name] |= extra
+                        changed = True
+
+        # Edge set with witnesses.
+        edges: Dict[Tuple[str, str], List[str]] = {}
+        for name, (direct_edges, held_calls, _, _) in profiles.items():
+            for held_mu, acq_mu, lineno in direct_edges:
+                edges.setdefault((held_mu, acq_mu), []).append(
+                    f"{name} holds {held_mu}, acquires {acq_mu} "
+                    f"({base}:{lineno})")
+            for held_set, callee, lineno in held_calls:
+                for acq_mu in closure.get(callee, ()):
+                    for held_mu in held_set:
+                        edges.setdefault((held_mu, acq_mu), []).append(
+                            f"{name} holds {held_mu}, calls {callee} which "
+                            f"may acquire {acq_mu} ({base}:{lineno})")
+
+        # Self-deadlock: std::mutex is non-recursive, so A -> A is an
+        # immediate hang on the first path that actually nests.
+        for (a, b), wits in sorted(edges.items()):
+            if a == b:
+                findings.append(Finding(
+                    "lockorder", f"LOCKORDER-SELF:{base}:{a}",
+                    f"{base}: {a} may be acquired while already held "
+                    f"(std::mutex is non-recursive): {wits[0]}"))
+
+        # Cycles: Tarjan SCC, then one witness cycle per non-trivial SCC.
+        adj: Dict[str, set] = {}
+        for (a, b) in edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+                adj.setdefault(b, set())
+        for scc in _tarjan_sccs(adj):
+            if len(scc) < 2:
+                continue
+            cycle = _witness_cycle(adj, scc)
+            key_path = "->".join(cycle + [cycle[0]])
+            wit_lines = []
+            for x, y in zip(cycle, cycle[1:] + [cycle[0]]):
+                wit_lines.append(edges[(x, y)][0])
+            findings.append(Finding(
+                "lockorder", f"LOCKORDER-CYCLE:{base}:{key_path}",
+                f"{base}: lock-order cycle {key_path} — potential "
+                f"deadlock; witness paths: " + "; ".join(wit_lines)))
+    return findings
+
+
+def _tarjan_sccs(adj: Dict[str, set]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: set = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(adj.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            sccs.append(scc)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _witness_cycle(adj: Dict[str, set], scc: List[str]) -> List[str]:
+    """One simple cycle through the SCC, starting at its min node."""
+    scc_set = set(scc)
+    start = min(scc)
+    # BFS back to start restricted to the SCC.
+    from collections import deque
+    prev = {start: None}
+    dq = deque([start])
+    while dq:
+        v = dq.popleft()
+        for w in sorted(adj.get(v, ())):
+            if w == start and v != start:
+                path = [v]
+                while prev[path[-1]] is not None:
+                    path.append(prev[path[-1]])
+                return list(reversed(path))
+            if w in scc_set and w not in prev:
+                prev[w] = v
+                dq.append(w)
+    return [start]
+
+
+# ---------------------------------------------------------------------------
+# sigsafe pass: async-signal-safety of the fatal-signal dump path
+# ---------------------------------------------------------------------------
+
+# The file whose fatal-signal handlers this pass certifies.  Entry points
+# are discovered from the handler-installation sites (`sa_handler = X`,
+# `signal(SIG, X)`), so adding a handler automatically widens the audit.
+SIGSAFE_FILE = "flight_recorder.cc"
+
+_HANDLER_INSTALL_RE = re.compile(
+    r"(?:\.sa_handler\s*=\s*|\bsignal\s*\(\s*\w+\s*,\s*)([A-Za-z_]\w*)")
+
+# Callables permitted in a fatal-signal context: the POSIX
+# async-signal-safe set this code actually uses, allocation-free string/
+# memory primitives, and lock-free std::atomic member ops.  Everything
+# else reachable from a handler is a finding.
+SIGSAFE_ALLOWED_CALLS = {
+    # POSIX async-signal-safe functions
+    "write", "read", "open", "close", "rename", "unlink", "fsync",
+    "raise", "kill", "_exit", "abort", "sigaction", "sigemptyset",
+    "sigaddset", "signal", "clock_gettime", "time", "getpid",
+    # allocation-free libc string/memory primitives
+    "memcpy", "memmove", "memset", "strlen", "strncpy",
+    # lock-free atomic member ops
+    "load", "store", "exchange", "fetch_add", "fetch_sub", "fetch_and",
+    "fetch_or", "fetch_xor", "compare_exchange_strong",
+    "compare_exchange_weak",
+    # constexpr header-inline helpers (no allocation, no locks, no errno)
+    "min", "max",
+}
+
+# Tokens whose presence in a reachable body is an allocation or lock no
+# matter how it is spelled as a call.
+_SIGSAFE_NEW_RE = re.compile(r"\bnew\b")
+_SIGSAFE_LOCK_RE = re.compile(
+    r"\b(?:std::)?(?:lock_guard|unique_lock|scoped_lock)\b|\.\s*lock\s*\(")
+_SIGSAFE_CALL_RE = re.compile(r"(?<![\w>])([A-Za-z_]\w*)\s*\(")
+
+
+def sigsafe_pass(fr_cc_text: str,
+                 filename: str = SIGSAFE_FILE) -> List[Finding]:
+    findings: List[Finding] = []
+    stripped = strip_cpp(fr_cc_text)
+    hatches = collect_hatches(fr_cc_text)
+    used_hatches: set = set()
+    funcs = parse_cpp_functions(stripped)
+    bodies: Dict[str, List[Tuple[int, int]]] = {}
+    for name, start, end in funcs:
+        bodies.setdefault(name, []).append((start, end))
+
+    entries = sorted(set(_HANDLER_INSTALL_RE.findall(stripped))
+                     & set(bodies))
+    if not entries:
+        findings.append(Finding(
+            "sigsafe", f"SIGSAFE-NO-ENTRY:{filename}",
+            f"{filename}: no fatal-signal handler installation found "
+            f"(sa_handler = X / signal(SIG, X)) — the signal-dump "
+            f"async-signal-safety claim has nothing to anchor to"))
+        return findings
+
+    def _body_calls(name: str) -> List[Tuple[str, int]]:
+        out = []
+        for start, end in bodies.get(name, ()):
+            text = stripped[start:end + 1]
+            for m in _SIGSAFE_CALL_RE.finditer(text):
+                out.append((m.group(1), _line_of(stripped, start + m.start())))
+        return out
+
+    # Reachability over the intra-file call graph (dotted calls included:
+    # SafeWriter-style local struct methods are called through a value).
+    reachable: List[str] = []
+    seen = set(entries)
+    queue = list(entries)
+    while queue:
+        fn = queue.pop(0)
+        reachable.append(fn)
+        for callee, _ in _body_calls(fn):
+            if callee in bodies and callee not in seen:
+                seen.add(callee)
+                queue.append(callee)
+
+    def _excused(lineno: int) -> bool:
+        for ln in (lineno, lineno - 1):
+            if hatches.get(ln) == "sigsafe-ok":
+                used_hatches.add(ln)
+                return True
+        return False
+
+    for fn in reachable:
+        for callee, lineno in _body_calls(fn):
+            if callee in bodies or callee in SIGSAFE_ALLOWED_CALLS \
+                    or callee in _CPP_KEYWORDS:
+                continue
+            if _excused(lineno):
+                continue
+            findings.append(Finding(
+                "sigsafe", f"SIGSAFE-UNSAFE-CALL:{fn}:{callee}",
+                f"{filename}:{lineno}: {fn} (reachable from fatal-signal "
+                f"handler {'/'.join(entries)}) calls {callee}(), which is "
+                f"not on the async-signal-safe allowlist"))
+        for start, end in bodies.get(fn, ()):
+            text = stripped[start:end + 1]
+            for m in _SIGSAFE_NEW_RE.finditer(text):
+                lineno = _line_of(stripped, start + m.start())
+                if _excused(lineno):
+                    continue
+                findings.append(Finding(
+                    "sigsafe", f"SIGSAFE-NEW:{fn}:{lineno}",
+                    f"{filename}:{lineno}: {fn} (reachable from the "
+                    f"fatal-signal handler) allocates with `new` — malloc "
+                    f"is not async-signal-safe"))
+            for m in _SIGSAFE_LOCK_RE.finditer(text):
+                lineno = _line_of(stripped, start + m.start())
+                if _excused(lineno):
+                    continue
+                findings.append(Finding(
+                    "sigsafe", f"SIGSAFE-LOCK:{fn}:{lineno}",
+                    f"{filename}:{lineno}: {fn} (reachable from the "
+                    f"fatal-signal handler) takes a lock — a mutex held "
+                    f"by the interrupted thread deadlocks the dump"))
+    for ln in sorted(set(ln for ln, kind in hatches.items()
+                         if kind == "sigsafe-ok") - used_hatches):
+        findings.append(Finding(
+            "sigsafe", f"SIGSAFE-STALE-OK:{filename}:{ln}",
+            f"{filename}:{ln}: `lint: sigsafe-ok` hatch suppresses "
+            f"nothing (no unsafe construct on this or the next line) — "
+            f"remove it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -748,7 +1368,22 @@ def _collect(root: str, subdir: str, exts: Sequence[str]) -> Dict[str, str]:
     return out
 
 
-def run_repo(root: str = REPO) -> List[Finding]:
+PASS_NAMES = ("abi", "env", "protocol", "flight", "atomic", "lockorder",
+              "sigsafe")
+
+
+def run_repo(root: str = REPO, only: Optional[Sequence[str]] = None,
+             timings: Optional[Dict[str, float]] = None) -> List[Finding]:
+    """Run the selected passes (all by default) over the repo at `root`.
+
+    `only` narrows to a subset of PASS_NAMES; `timings`, when given, is
+    filled with {pass_name: wall_seconds} for the passes that ran.
+    """
+    selected = set(PASS_NAMES) if only is None else set(only)
+    unknown = selected - set(PASS_NAMES)
+    if unknown:
+        raise ValueError(f"unknown pass(es): {sorted(unknown)}; "
+                         f"valid: {', '.join(PASS_NAMES)}")
     py_files = _collect(root, "horovod_tpu", (".py",))
     cc_files = _collect(root, os.path.join("horovod_tpu", "cpp"),
                         (".cc", ".h"))
@@ -757,27 +1392,42 @@ def run_repo(root: str = REPO) -> List[Finding]:
     if os.path.exists(readme):
         with open(readme, encoding="utf-8") as f:
             doc_files["README.md"] = f.read()
-
-    findings: List[Finding] = []
-    findings += abi_pass(cc_files["horovod_tpu/cpp/core_api.cc"], py_files)
-    findings += env_pass(py_files, cc_files, doc_files)
-    findings += protocol_pass(
-        cc_files["horovod_tpu/cpp/socket_controller.cc"],
-        cc_files["horovod_tpu/cpp/wire_codec.h"],
-        py_files["horovod_tpu/_core.py"],
-        py_files["horovod_tpu/runtime.py"],
-        py_files["horovod_tpu/utils/env.py"],
-        doc_files,
-        quantize_py_text=py_files.get("horovod_tpu/ops/quantize.py", ""))
     pm_path = os.path.join(root, "tools", "postmortem.py")
     pm_text = ""
     if os.path.exists(pm_path):
         with open(pm_path, encoding="utf-8", errors="replace") as f:
             pm_text = f.read()
-    findings += flight_pass(
-        cc_files["horovod_tpu/cpp/flight_recorder.h"],
-        cc_files["horovod_tpu/cpp/flight_recorder.cc"],
-        pm_text, doc_files)
+
+    runners = {
+        "abi": lambda: abi_pass(cc_files["horovod_tpu/cpp/core_api.cc"],
+                                py_files),
+        "env": lambda: env_pass(py_files, cc_files, doc_files),
+        "protocol": lambda: protocol_pass(
+            cc_files["horovod_tpu/cpp/socket_controller.cc"],
+            cc_files["horovod_tpu/cpp/wire_codec.h"],
+            py_files["horovod_tpu/_core.py"],
+            py_files["horovod_tpu/runtime.py"],
+            py_files["horovod_tpu/utils/env.py"],
+            doc_files,
+            quantize_py_text=py_files.get("horovod_tpu/ops/quantize.py",
+                                          "")),
+        "flight": lambda: flight_pass(
+            cc_files["horovod_tpu/cpp/flight_recorder.h"],
+            cc_files["horovod_tpu/cpp/flight_recorder.cc"],
+            pm_text, doc_files),
+        "atomic": lambda: atomic_pass(cc_files),
+        "lockorder": lambda: lockorder_pass(cc_files),
+        "sigsafe": lambda: sigsafe_pass(
+            cc_files.get("horovod_tpu/cpp/" + SIGSAFE_FILE, "")),
+    }
+    findings: List[Finding] = []
+    for pass_name in PASS_NAMES:
+        if pass_name not in selected:
+            continue
+        t0 = time.perf_counter()
+        findings += runners[pass_name]()
+        if timings is not None:
+            timings[pass_name] = time.perf_counter() - t0
     return findings
 
 
@@ -786,6 +1436,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--repo", default=REPO)
     ap.add_argument("--json", metavar="PATH",
                     help="also write the full machine-readable report here")
+    ap.add_argument("--only", metavar="PASS[,PASS...]",
+                    help="run only these passes (of: %s) — lets CI rows "
+                    "run the cheap passes quickly and attribute slow ones"
+                    % ", ".join(PASS_NAMES))
     ap.add_argument("--baseline",
                     default=os.path.join(REPO, "tools",
                                          "hvd_lint_baseline.json"))
@@ -793,16 +1447,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="accept all current findings as the new baseline")
     args = ap.parse_args(argv)
 
-    findings = run_repo(args.repo)
+    only = None
+    if args.only:
+        only = [p.strip() for p in args.only.split(",") if p.strip()]
+        try:
+            run_names = [p for p in PASS_NAMES if p in set(only)]
+            if set(only) - set(PASS_NAMES):
+                raise ValueError
+        except ValueError:
+            ap.error(f"--only: unknown pass in {args.only!r}; valid: "
+                     f"{', '.join(PASS_NAMES)}")
+    else:
+        run_names = list(PASS_NAMES)
+
+    timings: Dict[str, float] = {}
+    findings = run_repo(args.repo, only=only, timings=timings)
     baseline_keys: set = set()
     if os.path.exists(args.baseline):
         with open(args.baseline, encoding="utf-8") as f:
             baseline_keys = set(json.load(f).get("findings", []))
     new = [f for f in findings if f.key not in baseline_keys]
 
-    for pass_name in ("abi", "env", "protocol", "flight"):
+    for pass_name in run_names:
         hits = [f for f in findings if f.pass_name == pass_name]
-        print(f"[{pass_name}] {len(hits)} finding(s)")
+        print(f"[{pass_name}] {len(hits)} finding(s) "
+              f"({timings.get(pass_name, 0.0) * 1000:.1f} ms)")
         for f in hits:
             marker = " " if f.key in baseline_keys else "*"
             print(f"  {marker} {f.key}: {f.message}")

@@ -83,7 +83,9 @@ combination of:
            the on-combo rides in the quick set
 
 Plus non-workload check rows: `lint` (tools/hvd_lint.py — ABI/env/protocol
-consistency, both sets), `fault-spec` (the HOROVOD_FAULT_INJECT parser
+consistency, both sets), `lint-atomic`/`lint-lockorder`/`lint-sigsafe`
+(the concurrency-discipline passes standalone via `--only`, both sets),
+`fault-spec` (the HOROVOD_FAULT_INJECT parser
 contract, both sets), and — full set only — the ASan/UBSan selftest
 builds, the `chaos` fault-injection/fast-abort selftest, the np=4
 fault-injection pytest (`fault-np4`: abort bound, corrupt-tag fail-fast,
@@ -757,6 +759,14 @@ def checks(quick: bool):
     yield ("lint",
            [[sys.executable, os.path.join(REPO, "tools", "hvd_lint.py")]],
            REPO)
+    # The concurrency-discipline passes also run standalone so a failure
+    # is attributed to the discipline that broke (atomic memory-order
+    # audit / lock-order cycles / async-signal-safety), not just "lint".
+    for cpass in ("atomic", "lockorder", "sigsafe"):
+        yield (f"lint-{cpass}",
+               [[sys.executable, os.path.join(REPO, "tools", "hvd_lint.py"),
+                 "--only", cpass]],
+               REPO)
     yield ("fault-spec",
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "single", "test_fault_spec.py")]],

@@ -25,11 +25,11 @@ namespace {
 
 const char* const kSiteNames[kNumFaultSites] = {
     "rendezvous-accept", "coordinator-recv", "ring-send",  "ring-recv",
-    "shm-fence",         "frame-header",     "leader-recv"};
+    "shm-fence",         "frame-header",     "leader-recv", "super-recv"};
 
 constexpr const char* kValidSites =
     "rendezvous-accept, coordinator-recv, ring-send, ring-recv, shm-fence, "
-    "frame-header, leader-recv";
+    "frame-header, leader-recv, super-recv";
 constexpr const char* kValidActions =
     "drop, truncate, delay (arg = ms), corrupt-tag, die (arg = optional "
     "flag-file path)";

@@ -7,7 +7,7 @@
 //
 // Spec (HOROVOD_FAULT_INJECT): comma-separated `site:cycle:rank:action[:arg]`
 //   site   = rendezvous-accept | coordinator-recv | ring-send | ring-recv |
-//            shm-fence | frame-header | leader-recv
+//            shm-fence | frame-header | leader-recv | super-recv
 //   cycle  = '*' (every matching hit) or a 0-based hit index at that
 //            (site, rank) — one-shot, latched once fired
 //   rank   = '*' or the acting rank (for coordinator-side sites: the REMOTE
@@ -38,7 +38,11 @@ enum FaultSite : int {
   // v9 leader tree: a host leader receiving a child's CYCLE frame.  The
   // rank field is the REMOTE child rank (mirror of coordinator-recv).
   kFaultLeaderRecv = 6,
-  kNumFaultSites = 7,
+  // v12 adaptive depth: a mid-level super-leader receiving a downstream
+  // leader's [-3] aggregate frame.  The rank field is the REMOTE child
+  // leader rank; the coordinator's own gathers keep coordinator-recv.
+  kFaultSuperRecv = 7,
+  kNumFaultSites = 8,
 };
 
 enum class FaultAction : int {

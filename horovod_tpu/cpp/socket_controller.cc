@@ -29,7 +29,13 @@ constexpr double kConnectTimeoutS = 60.0;
 // (requests, responses, cache frames) so mixed-build jobs fail with a
 // named error instead of desynchronized garbled frames.
 constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
-// v11: fleet-telemetry sketch section — a length-prefixed cumulative
+// v12: adaptive-depth leader tree — the rendezvous book's tree trailer
+// grows the coordinator's agreed [i32 fanout][i32 depth] after the v9
+// ctrl_tree bit, mid-level super-leaders merge downstream leaders' [-3]
+// aggregates into one frame upward, and a departing leader's BYE (direct
+// or forwarded as an aggregate rest) releases its whole SUBTREE at the
+// coordinator (v9 released only the leader's host).  v11 added the
+// fleet-telemetry sketch section — a length-prefixed cumulative
 // histogram sketch between the cached pairs and the full requests of every
 // CYCLE frame, after the [-3] sentinel of leader aggregates (host-summed),
 // and trailing upward BYEs (the rank's FINAL sketch, so fleet histograms
@@ -42,7 +48,7 @@ constexpr int32_t kProtocolMagic = 0x48565354;  // "HVST"
 // snapshot trailer on worker CYCLE frames, v6 the wire_comp codec byte in
 // responses, v5 the host key in the rendezvous HELLO/book + the hier bit
 // in responses)
-constexpr int32_t kProtocolVersion = 11;
+constexpr int32_t kProtocolVersion = 12;
 // Mesh-HELLO psid for child->leader ctrl-tree links: negative, so it can
 // never collide with a real process-set id (those start at 1) and always
 // lands in the pending-channel stash when it races a mesh establishment.
@@ -205,6 +211,36 @@ SocketController::SocketController(const CoreConfig& cfg)
     } else if (!v.empty()) {
       HVD_LOG(WARNING) << "unrecognized HOROVOD_CONTROL_TREE=" << v
                        << " (expected auto|on|off); using auto";
+    }
+  }
+  // v12 adaptive depth.  Fanout: the per-node fan-in bound the clustering
+  // pass targets (min 2 — a 1-ary tree is a chain).  Depth: 0 = auto
+  // (cluster until the bound holds), else force exactly this many levels
+  // (2 = the v9 flat-leader shape).  Coordinator-authoritative, like the
+  // mode: the agreed values ride the rendezvous book.
+  if (const char* env = ::getenv("HOROVOD_CTRL_TREE_FANOUT")) {
+    char* end = nullptr;
+    long long v = std::strtoll(env, &end, 10);
+    if (end && *end == '\0' && v >= 2) {
+      ctrl_tree_fanout_ = static_cast<int>(std::min<long long>(v, 0x800));
+    } else if (*env) {
+      HVD_LOG(WARNING) << "ignoring HOROVOD_CTRL_TREE_FANOUT=" << env
+                       << " (expected an integer >= 2)";
+    }
+  }
+  if (const char* env = ::getenv("HOROVOD_CONTROL_TREE_DEPTH")) {
+    std::string v = env;
+    if (v == "auto" || v == "0") {
+      ctrl_tree_depth_ = 0;
+    } else {
+      char* end = nullptr;
+      long long d = std::strtoll(env, &end, 10);
+      if (end && *end == '\0' && d >= 2 && d <= 8) {
+        ctrl_tree_depth_ = static_cast<int>(d);
+      } else if (!v.empty()) {
+        HVD_LOG(WARNING) << "ignoring HOROVOD_CONTROL_TREE_DEPTH=" << v
+                         << " (expected auto or an integer in [2, 8])";
+      }
     }
   }
   // Rendezvous acceptor shards: N threads accepting HELLOs concurrently on
@@ -370,6 +406,11 @@ Status SocketController::Initialize() {
       book.PutString(hosts[rank]);
     }
     book.PutI32(tree_on ? 1 : 0);
+    // v12: the agreed fanout/depth ride behind the verdict so divergent
+    // HOROVOD_CTRL_TREE_FANOUT / HOROVOD_CONTROL_TREE_DEPTH envs cannot
+    // make ranks compute different topologies.
+    book.PutI32(ctrl_tree_fanout_);
+    book.PutI32(ctrl_tree_depth_);
     for (int rank = 1; rank < cfg_.size; ++rank) {
       ctrl_msgs_sent_.fetch_add(1, std::memory_order_relaxed);
       ctrl_sent_.fetch_add(static_cast<int64_t>(book.data().size()),
@@ -451,6 +492,13 @@ Status SocketController::Initialize() {
     // HOROVOD_CONTROL_TREE is advisory only — obeying the book is what
     // keeps a mixed-env job from splitting into flat and tree halves.
     ctrl_tree_decision = (r.GetI32() == 1) && r.ok();
+    // v12 trailer: the agreed fanout/depth — same authority rule.
+    const int32_t agreed_fanout = r.GetI32();
+    const int32_t agreed_depth = r.GetI32();
+    if (r.ok()) {
+      ctrl_tree_fanout_ = agreed_fanout;
+      ctrl_tree_depth_ = agreed_depth;
+    }
     if (!r.ok()) {
       return Status::Error(StatusCode::PRECONDITION_ERROR,
                            "malformed rendezvous address book");
@@ -543,34 +591,157 @@ void SocketController::ComputeCtrlTree(bool on) {
       }
     }
   }
-  HVD_LOG(INFO) << "rank " << cfg_.rank << ": ctrl tree on, "
-                << groups.size() << " hosts, leader rank " << tree_.my_leader
+  // v12 adaptive depth: while the coordinator would gather more than
+  // `fanout` top-level nodes, partition the non-root top nodes (consecutive,
+  // so clusters follow host order) into ceil(n/fanout) balanced clusters
+  // and promote each cluster's lowest rank to super-leader.  Every pass
+  // adds one aggregation level.  A forced depth d runs exactly d-2 passes
+  // (stopping early only when a level has nothing left to cluster), so
+  // HOROVOD_CONTROL_TREE_DEPTH=2 pins the v9 shape and =3 always inserts
+  // one super-leader layer.  Deterministic and env-agreed, so every rank
+  // computes the identical parent_of map.
+  const int fanout = std::max(2, ctrl_tree_fanout_);
+  std::vector<int> top = tree_.leaders;  // ascending; top[0] == 0
+  int levels = 1;                        // aggregation layers so far
+  while (true) {
+    const int non_root = static_cast<int>(top.size()) - 1;
+    const bool grow = (ctrl_tree_depth_ > 0)
+                          ? (levels < ctrl_tree_depth_ - 1 && non_root > 1)
+                          : (non_root > fanout);
+    if (!grow) break;
+    const int n_clusters = (non_root + fanout - 1) / fanout;
+    std::vector<int> next = {0};
+    for (int c = 0; c < n_clusters; ++c) {
+      // Balanced split: cluster sizes differ by at most one.
+      const int lo = 1 + static_cast<int>(
+                             static_cast<int64_t>(c) * non_root / n_clusters);
+      const int hi = 1 + static_cast<int>(static_cast<int64_t>(c + 1) *
+                                          non_root / n_clusters);
+      const int head = top[lo];
+      next.push_back(head);
+      for (int i = lo + 1; i < hi; ++i) tree_.parent_of[top[i]] = head;
+    }
+    top.swap(next);
+    ++levels;
+  }
+  for (size_t i = 1; i < top.size(); ++i) tree_.parent_of[top[i]] = 0;
+  tree_.depth = levels + 1;
+  if (IsTreeLeader() && cfg_.rank != 0) {
+    auto it = tree_.parent_of.find(cfg_.rank);
+    tree_.parent = it == tree_.parent_of.end() ? 0 : it->second;
+  }
+  for (const auto& kv : tree_.parent_of) {
+    if (kv.second == cfg_.rank && kv.first != cfg_.rank) {
+      tree_.agg_children.push_back(kv.first);
+    }
+  }
+  HVD_LOG(INFO) << "rank " << cfg_.rank << ": ctrl tree on, " << groups.size()
+                << " hosts, depth " << tree_.depth << ", leader rank "
+                << tree_.my_leader
                 << (IsTreeLeader()
                         ? ", " + std::to_string(tree_.my_children.size()) +
-                              " children"
+                              " children, " +
+                              std::to_string(tree_.agg_children.size()) +
+                              " aggregate children, parent rank " +
+                              std::to_string(cfg_.rank == 0 ? -1
+                                                            : tree_.parent)
                         : "");
+}
+
+std::vector<int> SocketController::SubtreeOf(int rank) const {
+  // A rank is in `rank`'s subtree when `rank` appears on its aggregation
+  // path: itself -> its host leader -> parent_of chain -> coordinator.
+  // O(size * depth); only walked on departure/abort paths, never per cycle.
+  std::vector<int> out;
+  if (!tree_.on) {
+    out.push_back(rank);
+    return out;
+  }
+  for (int r = 0; r < cfg_.size; ++r) {
+    int node = r;
+    // Hop from a worker to its host leader first (workers never appear in
+    // parent_of; their parent is the host's first rank by construction).
+    if (std::find(tree_.leaders.begin(), tree_.leaders.end(), node) ==
+        tree_.leaders.end()) {
+      for (int l : tree_.leaders) {
+        if (host_keys_[l] == host_keys_[r]) {
+          node = l;
+          break;
+        }
+      }
+    }
+    bool under = (r == rank);
+    int hops = 0;
+    while (!under && node != 0 && hops++ <= cfg_.size) {
+      if (node == rank) {
+        under = true;
+        break;
+      }
+      auto it = tree_.parent_of.find(node);
+      node = it == tree_.parent_of.end() ? 0 : it->second;
+    }
+    if (under || node == rank) out.push_back(r);
+  }
+  return out;
+}
+
+void SocketController::DepartSubtree(int rank) {
+  for (int r : SubtreeOf(rank)) departed_ranks_.insert(r);
+}
+
+std::vector<int> SocketController::AncestorChain(int rank) const {
+  std::vector<int> out;
+  if (!tree_.on || rank <= 0 || rank >= cfg_.size) return out;
+  int node = rank;
+  if (std::find(tree_.leaders.begin(), tree_.leaders.end(), node) ==
+      tree_.leaders.end()) {
+    for (int l : tree_.leaders) {
+      if (host_keys_[l] == host_keys_[rank]) {
+        node = l;
+        break;
+      }
+    }
+    if (node != rank && node != 0) out.push_back(node);
+  }
+  int hops = 0;
+  while (node != 0 && hops++ <= cfg_.size) {
+    auto it = tree_.parent_of.find(node);
+    node = it == tree_.parent_of.end() ? 0 : it->second;
+    if (node != 0) out.push_back(node);
+  }
+  return out;
 }
 
 Status SocketController::SetupCtrlTreeLinks() {
   if (!tree_.on) return Status::OK();
   if (is_coordinator() || cfg_.rank == tree_.my_leader) {
     // Leaders (and the coordinator, leader of host 0) accept ctrl-tree
-    // HELLOs from this host's other ranks on the mesh data listener.  The
-    // coordinator's host-0 children keep coord_ctrl_ as their up-link, so
-    // it expects none here.
-    int needed = static_cast<int>(tree_.my_children.size());
+    // HELLOs from this host's other ranks — and, v12, from downstream
+    // leaders whose aggregates this node merges — on the mesh data
+    // listener.  The coordinator's children of BOTH kinds keep their
+    // rendezvous ctrl sockets, so it expects none here.
+    int needed = static_cast<int>(tree_.my_children.size() +
+                                  tree_.agg_children.size());
     if (is_coordinator()) needed = 0;
+    auto expected_child = [&](int rank) {
+      return std::find(tree_.my_children.begin(), tree_.my_children.end(),
+                       rank) != tree_.my_children.end() ||
+             std::find(tree_.agg_children.begin(), tree_.agg_children.end(),
+                       rank) != tree_.agg_children.end();
+    };
     // A child that finished its psid-0 mesh before this leader did may have
     // dialed already — ConnectMesh parked the unknown psid in the channel
     // stash.  Drain it before accepting fresh connections.
     if (needed > 0) {
       std::lock_guard<std::mutex> l(mesh_mu_);
-      for (int c : tree_.my_children) {
-        auto it = pending_channel_.find({c, kCtrlTreePsid});
-        if (it != pending_channel_.end()) {
-          tree_child_socks_[c] = std::move(it->second);
-          pending_channel_.erase(it);
-          --needed;
+      for (const auto* list : {&tree_.my_children, &tree_.agg_children}) {
+        for (int c : *list) {
+          auto it = pending_channel_.find({c, kCtrlTreePsid});
+          if (it != pending_channel_.end()) {
+            tree_child_socks_[c] = std::move(it->second);
+            pending_channel_.erase(it);
+            --needed;
+          }
         }
       }
     }
@@ -609,8 +780,7 @@ Status SocketController::SetupCtrlTreeLinks() {
         pending_channel_[{rank, psid}] = std::move(s);
         continue;
       }
-      if (std::find(tree_.my_children.begin(), tree_.my_children.end(),
-                    static_cast<int>(rank)) == tree_.my_children.end()) {
+      if (!expected_child(static_cast<int>(rank))) {
         return Status::Error(StatusCode::INVALID_ARGUMENT,
                              "ctrl-tree HELLO from rank " +
                                  std::to_string(rank) +
@@ -620,18 +790,24 @@ Status SocketController::SetupCtrlTreeLinks() {
       tree_child_socks_[rank] = std::move(s);
       --needed;
     }
-    return Status::OK();
+    // v12: a leader clustered under a super-leader dials its parent AFTER
+    // its own subtree is linked up.  Dials flow strictly child -> lower-
+    // ranked parent, so the chain completes bottom-up with no cycles.
+    if (is_coordinator() || tree_.parent <= 0) return Status::OK();
+  } else if (tree_.my_leader == 0) {
+    return Status::OK();  // host-0 child: coord_ctrl_
   }
-  if (tree_.my_leader == 0) return Status::OK();  // host-0 child: coord_ctrl_
-  // Child of a non-coordinator leader: dial the leader's mesh listener with
-  // a ctrl-tree HELLO.  Child rank > leader rank always holds (leader is
-  // the host's first rank), matching the mesh dial direction.
+  // Dial this rank's negotiation parent (the host leader for a worker, the
+  // super-leader for a clustered leader) on its mesh listener with a
+  // ctrl-tree HELLO.  Child rank > parent rank always holds (the parent is
+  // the first rank of its host / cluster), matching the mesh dial direction.
+  const int parent = IsTreeLeader() ? tree_.parent : tree_.my_leader;
   Socket s;
-  if (!s.Connect(mesh_addrs_[tree_.my_leader], mesh_ports_[tree_.my_leader],
+  if (!s.Connect(mesh_addrs_[parent], mesh_ports_[parent],
                  kConnectTimeoutS)) {
     return Status::Error(StatusCode::PRECONDITION_ERROR,
                          "ctrl-tree connect to leader rank " +
-                             std::to_string(tree_.my_leader) + " failed");
+                             std::to_string(parent) + " failed");
   }
   Writer hello;
   hello.PutI32(cfg_.rank);
@@ -639,18 +815,18 @@ Status SocketController::SetupCtrlTreeLinks() {
   if (!s.SendFrame(hello.data())) {
     return Status::Error(StatusCode::PRECONDITION_ERROR,
                          "ctrl-tree HELLO to leader rank " +
-                             std::to_string(tree_.my_leader) + " failed");
+                             std::to_string(parent) + " failed");
   }
   tree_parent_ = std::move(s);
   return Status::OK();
 }
 
 Socket& SocketController::UpLink() {
-  // The negotiation up-link: tree children of non-coordinator leaders talk
-  // to their leader; everyone else (flat mode, host-0 children, leaders
-  // themselves) talks straight to the coordinator.
-  if (tree_.on && !is_coordinator() && tree_.my_leader != 0 &&
-      tree_.my_leader != cfg_.rank && tree_parent_.valid()) {
+  // The negotiation up-link: a node whose parent is a non-coordinator
+  // (a tree child of a non-host-0 leader, or a v12 leader clustered under
+  // a super-leader) talks to that parent; everyone else (flat mode, host-0
+  // children, top-level leaders) talks straight to the coordinator.
+  if (tree_.on && !is_coordinator() && tree_parent_.valid()) {
     return tree_parent_;
   }
   return coord_ctrl_;
@@ -1265,21 +1441,12 @@ bool SocketController::StashFlightDigest(Reader* rd) {
 
 void SocketController::CollectFlightDigests(double deadline) {
   // Poll until the deadline or every reachable rank has reported.  A
-  // rank's digest may arrive on its LEADER's socket (forwarded verbatim
-  // by ForwardChildDigests), so completion counts ranks reported — never
-  // sockets drained — and a leader's socket stays in the poll set while
-  // any rank of its host is still outstanding, even after the leader's
-  // own digest landed.
-  auto leader_of = [&](int rank) -> int {
-    if (!tree_.on || rank >= static_cast<int>(host_keys_.size())) return -1;
-    for (int l : tree_.leaders) {
-      if (l < static_cast<int>(host_keys_.size()) &&
-          host_keys_[l] == host_keys_[rank]) {
-        return l;
-      }
-    }
-    return -1;
-  };
+  // rank's digest may arrive on any of its ANCESTORS' sockets (each relay
+  // hop lands the forwarded frame on the relaying leader's own rendezvous
+  // link — v12 trees relay through super-leaders too), so completion
+  // counts ranks reported — never sockets drained — and every ancestor's
+  // socket stays in the poll set while any rank below it is still
+  // outstanding, even after that ancestor's own digest landed.
   while (MonotonicSeconds() < deadline) {
     std::set<int> poll_ranks;  // socket owners worth polling this round
     int outstanding = 0;
@@ -1293,11 +1460,13 @@ void SocketController::CollectFlightDigests(double deadline) {
         reachable = true;
       }
       // Host-0 children (leader 0 = the coordinator itself) only have
-      // their direct sockets; remote children may report via their leader.
-      const int l = leader_of(rank);
-      if (l > 0 && l != rank && ctrl_socks_[l].valid()) {
-        poll_ranks.insert(l);
-        reachable = true;
+      // their direct sockets; remote ranks may report via any live
+      // ancestor (host leader, then each super-leader above it).
+      for (int l : AncestorChain(rank)) {
+        if (l > 0 && l != rank && ctrl_socks_[l].valid()) {
+          poll_ranks.insert(l);
+          reachable = true;
+        }
       }
       if (reachable) ++outstanding;  // unreachable: don't charge budget
     }
@@ -1342,7 +1511,12 @@ void SocketController::CollectFlightDigests(double deadline) {
 }
 
 void SocketController::ForwardChildDigests() {
-  if (tree_child_socks_.empty() || !coord_ctrl_.valid()) return;
+  // Relay upward on this node's own up-link: a host leader goes direct to
+  // the coordinator (or, v12, to its super-leader, which relays again), so
+  // every digest eventually lands on a rendezvous socket the coordinator
+  // polls.
+  Socket& up = UpLink();
+  if (tree_child_socks_.empty() || !up.valid()) return;
   // Children received the fanned-down ABORT moments ago and answer within
   // milliseconds; cap the relay window well inside the abort budget so a
   // mute child never delays this leader's own teardown.
@@ -1376,7 +1550,7 @@ void SocketController::ForwardChildDigests() {
       }
       Reader rd(frame);
       if (rd.GetI32() == -4) {
-        coord_ctrl_.SendFrame(frame);  // verbatim relay, best effort
+        up.SendFrame(frame);  // verbatim relay, best effort
         done.insert(rank);
       }
       // Stale frames (the child's in-flight CYCLE, an already-handled FIN)
@@ -1629,15 +1803,15 @@ Status SocketController::CoordinatorCycle(
   }
   // Own announcements first (deterministic: coordinator, then source order).
   for (auto& r : new_requests) Announce(0, std::move(r), &errors);
-  // Gather sources.  Flat: every worker.  Tree (v9): this host's children
-  // (individual frames) plus the other hosts' leaders ([-3] aggregates) —
-  // the O(ranks) -> O(local ranks + hosts) reduction the tree exists for.
+  // Gather sources.  Flat: every worker.  Tree: this host's children
+  // (individual frames) plus the coordinator's aggregate children ([-3]
+  // frames) — at depth 2 those are all other hosts' leaders (v9); at v12
+  // depth >= 3 only the top-level super-leaders, which keeps coordinator
+  // fan-in <= fanout at any host count.
   std::vector<int> sources;
   if (tree_.on) {
     sources = tree_.my_children;
-    for (int l : tree_.leaders) {
-      if (l != 0) sources.push_back(l);
-    }
+    for (int l : tree_.agg_children) sources.push_back(l);
   } else {
     for (int rank = 1; rank < cfg_.size; ++rank) sources.push_back(rank);
   }
@@ -1676,12 +1850,14 @@ Status SocketController::CoordinatorCycle(
       departed_ranks_.insert(rank);
       HVD_LOG(INFO) << "rank " << rank << " shut down cleanly";
       if (is_leader_src) {
-        // A departing leader severs its subtree: any child still running
-        // has lost its up-link, so the coordinator stops expecting its
-        // announcements rather than hanging tensors on a mute host.
-        for (int r = 1; r < cfg_.size; ++r) {
-          if (r != rank && host_keys_[r] == host_keys_[rank] &&
-              departed_ranks_.insert(r).second) {
+        // A departing leader severs its subtree: any descendant still
+        // running has lost its aggregation path, so the coordinator stops
+        // expecting its announcements rather than hanging tensors on a
+        // mute branch.  v12: the subtree is the whole branch below the
+        // leader (its host, plus every clustered host under it when it
+        // was a super-leader), not just its own host.
+        for (int r : SubtreeOf(rank)) {
+          if (r != rank && departed_ranks_.insert(r).second) {
             HVD_LOG(INFO) << "rank " << r << " departed with its leader "
                           << rank;
           }
@@ -2061,6 +2237,10 @@ int SocketController::FleetSourceCountForTest() {
   return static_cast<int>(fleet_sources_.size());
 }
 
+int64_t SocketController::FleetSumNegCountForTest() {
+  return FleetSum().negotiation_wait.count;
+}
+
 // ---------------------------------------------------------------------------
 // Fleet-autopilot policy channel (coordinator only)
 // ---------------------------------------------------------------------------
@@ -2427,8 +2607,11 @@ bool SocketController::ParseAggregate(int leader, Reader* rd,
     if (first == -1) {  // the member's BYE, forwarded by its leader
       // v11: the forwarded BYE's trailing sketch is deliberately SKIPPED —
       // the leader folded the child's final sketch into its own host sum,
-      // so reading it here would double-count the host.
-      departed_ranks_.insert(rank);
+      // so reading it here would double-count the host.  v12: when the
+      // departing rank is itself a leader (a super-leader forwarded a
+      // child leader's BYE), its whole subtree departs with it — those
+      // ranks have lost their aggregation path.
+      DepartSubtree(rank);
       HVD_LOG(INFO) << "rank " << rank << " shut down cleanly (via leader "
                     << leader << ")";
       continue;
@@ -2459,20 +2642,26 @@ Status SocketController::LeaderFinUp(int culprit, const std::string& why,
   aborted_ = true;
   if (!fin_sent_) {
     fin_sent_ = true;
-    if (forward_frame != nullptr) {
-      // A child's failure FIN, forwarded verbatim — its v9 culprit trailer
-      // already names the child.
-      coord_ctrl_.SendFrame(*forward_frame);
-    } else {
-      Writer w;
+    // Up the TREE first (v12: a clustered leader's parent is a super-
+    // leader whose gather loop relays the FIN hop by hop until it lands
+    // on a rendezvous socket the coordinator reads in-cycle), plus a
+    // best-effort direct copy so attribution survives a dead ancestor.
+    Socket& up = UpLink();
+    const std::string* frame = forward_frame;
+    Writer w;
+    if (frame == nullptr) {
       w.PutI32(-2);  // failure FIN in the cycle-frame position
       w.PutString(why);
       w.PutI32(culprit);
-      coord_ctrl_.SendFrame(w.data());  // best effort
+    }
+    const std::string& payload = frame != nullptr ? *frame : w.data();
+    if (up.valid()) up.SendFrame(payload);  // best effort
+    if (&up != &coord_ctrl_ && coord_ctrl_.valid()) {
+      coord_ctrl_.SendFrame(payload);
     }
   }
   // Await the coordinator's ABORT (and fan it down to surviving children)
-  // so every rank of this host reports the same culprit.
+  // so every rank of this subtree reports the same culprit.
   return WorkerAbortHandshake();
 }
 
@@ -2513,15 +2702,64 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
     if (rest != kEmptyTail) rests.emplace_back(rank, std::move(rest));
     return true;
   };
+  // v12: a super-leader merges a downstream leader's whole [-3] aggregate
+  // — subtree-summed sketch (replaces that child's last-known, keeping the
+  // running sum bucket-exact), cached groups unioned by id, rests appended
+  // verbatim — into the same `groups`/`rests` its worker children feed.
+  auto merge_aggregate = [&](int32_t child, const std::string& frame) -> bool {
+    Reader rd(frame);
+    if (rd.GetI32() != -3 || !rd.ok()) return false;
+    const std::string enc = rd.GetString();
+    if (!rd.ok()) return false;
+    if (!enc.empty()) {
+      FleetSketch s;
+      if (s.Decode(enc.data(), enc.size())) {
+        tree_child_sketches_[child] = std::move(s);
+      }
+    }
+    const int32_t n_groups = rd.GetI32();
+    if (!rd.ok() || n_groups < 0) return false;
+    for (int32_t g = 0; g < n_groups; ++g) {
+      const int64_t id = rd.GetI64();
+      const int32_t k = rd.GetI32();
+      if (!rd.ok() || k < 0) return false;
+      for (int32_t i = 0; i < k; ++i) {
+        const int32_t rank = rd.GetI32();
+        const int64_t handle = rd.GetI64();
+        if (!rd.ok() || rank < 0 || rank >= cfg_.size) return false;
+        groups[id].emplace_back(rank, handle);
+      }
+    }
+    const int32_t n_rest = rd.GetI32();
+    if (!rd.ok() || n_rest < 0) return false;
+    for (int32_t i = 0; i < n_rest; ++i) {
+      const int32_t rank = rd.GetI32();
+      if (!rd.ok() || rank < 0 || rank >= cfg_.size) return false;
+      std::string rest = rd.GetString();
+      if (!rd.ok()) return false;
+      rests.emplace_back(rank, std::move(rest));
+    }
+    return rd.ok();
+  };
   merge_frame(cfg_.rank, own);
-  for (int child : tree_.my_children) {
+  int32_t merged_frames = 1;  // own frame
+  // Gather this host's workers first, then (v12) downstream leaders'
+  // aggregates.  One flat list keeps the failure handling identical: a
+  // dead link, BYE, or FIN from either kind takes the same path.
+  std::vector<std::pair<int, bool>> gather;  // (child rank, is aggregate)
+  for (int c : tree_.my_children) gather.emplace_back(c, false);
+  for (int c : tree_.agg_children) gather.emplace_back(c, true);
+  for (const auto& [child, is_agg] : gather) {
     if (tree_departed_children_.count(child)) continue;
     Socket* cs = TreeChildSock(child);
     if (cs == nullptr) continue;
     if (FaultInjectionOn()) {
       // Site rank = the REMOTE child whose frame this leader is gathering;
       // closing the link makes the recv below fail like a child death.
-      FaultAction fa = FaultCheck(kFaultLeaderRecv, child);
+      // Worker children are leader-recv sites; downstream leaders' links
+      // are the v12 super-recv sites.
+      FaultAction fa =
+          FaultCheck(is_agg ? kFaultSuperRecv : kFaultLeaderRecv, child);
       if (fa == FaultAction::kDrop || fa == FaultAction::kTruncate) {
         cs->Close();
       }
@@ -2538,9 +2776,10 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
     Reader rd(frame);
     const int32_t first = rd.GetI32();
     if (first == -1) {  // child BYE: forward the whole frame as its tail
-      // v11: keep the child's FINAL sketch so the host sum stays exact
-      // after it departs.  The coordinator skips the sketch on the
-      // forwarded BYE — this host's aggregate already carries it.
+      // v11: keep the child's FINAL sketch so the running sum stays exact
+      // after it departs (a leader child's BYE carries its whole subtree's
+      // final sum).  The coordinator skips the sketch on the forwarded
+      // BYE — this node's aggregate already carries it.
       const std::string enc = rd.GetString();
       if (rd.ok() && !enc.empty()) {
         FleetSketch s;
@@ -2562,30 +2801,36 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
       }
       return LeaderFinUp(culprit, why, &frame);
     }
-    if (!merge_frame(child, frame)) {
+    if (is_agg ? !merge_aggregate(child, frame)
+               : !merge_frame(child, frame)) {
       return LeaderFinUp(child,
-                         "malformed cycle frame from rank " +
+                         (is_agg ? "malformed aggregate frame from rank "
+                                 : "malformed cycle frame from rank ") +
                              std::to_string(child),
                          nullptr);
     }
+    ++merged_frames;
   }
   // Tree-aggregate merge: the leader's share of the fusion phase (the
   // coordinator's fuse/gate span is measured in CoordinatorCycle).
   const double agg_t0 = StepTraceOn() ? MonotonicSeconds() : 0.0;
   Writer w;
   w.PutI32(-3);  // leader aggregate sentinel in the cycle-frame position
-  // v11: ONE host-summed sketch per aggregate — own + every member's
+  // v11: ONE subtree-summed sketch per aggregate — own + every member's
   // last-known (a map entry per member only exists once its frame carried
-  // a non-empty section, so an all-off host writes an empty string).
+  // a non-empty section, so an all-off subtree writes an empty string).
+  // v12: entries under downstream-leader ranks already hold their whole
+  // subtree's sum, and rank keys are disjoint across subtrees, so one flat
+  // Merge stays bucket-exact at any depth.
   const double hs_now = MonotonicSeconds();
   if (tree_child_sketches_.empty() ||
       hs_now - fleet_leader_last_encode_ < kFleetEncodeIntervalS) {
     w.PutString("");
   } else {
     fleet_leader_last_encode_ = hs_now;
-    FleetSketch host_sum;
-    for (const auto& kv : tree_child_sketches_) host_sum.Merge(kv.second);
-    w.PutString(host_sum.Encode());
+    FleetSketch subtree_sum;
+    for (const auto& kv : tree_child_sketches_) subtree_sum.Merge(kv.second);
+    w.PutString(subtree_sum.Encode());
   }
   w.PutI32(static_cast<int32_t>(groups.size()));
   for (const auto& [id, members] : groups) {
@@ -2607,20 +2852,40 @@ Status SocketController::LeaderCycle(std::vector<TensorRequest>& new_requests,
         static_cast<int64_t>((MonotonicSeconds() - agg_t0) * 1e6));
   }
   if (FlightOn()) {
-    // One aggregate frame per host per cycle: how many child frames this
-    // leader merged (its own included) and the bytes pushed upward.
-    FlightRecord(kFlightTreeAgg,
-                 static_cast<int32_t>(tree_.my_children.size() -
-                                      tree_departed_children_.size() + 1),
+    // One aggregate frame per tree node per cycle: how many child frames
+    // this leader merged (its own included; downstream leaders' aggregates
+    // count as one each) and the bytes pushed upward.
+    FlightRecord(kFlightTreeAgg, merged_frames,
                  static_cast<int64_t>(w.data().size()));
   }
+  // v12: clustered leaders push to their super-leader, super-leaders (and
+  // host 0's fused leader/coordinator path, which never reaches here) to
+  // the coordinator.  Losing a super-leader is NOT losing the coordinator:
+  // the rendezvous link is still up, so FIN through it and let the
+  // coordinator attribute the death; only the top of the chain synthesizes
+  // the ABORT itself.
+  Socket& up = UpLink();
   CountCtrlSend(w.data().size());
-  if (!coord_ctrl_.SendFrame(w.data())) {
+  if (!up.SendFrame(w.data())) {
+    if (tree_.parent > 0) {
+      return LeaderFinUp(tree_.parent,
+                         "leader rank " + std::to_string(cfg_.rank) +
+                             " lost its super-leader rank " +
+                             std::to_string(tree_.parent) + " (send)",
+                         nullptr);
+    }
     aborted_ = true;
     return LeaderLostCoordinator("lost coordinator (send)");
   }
   std::string resp;
-  if (!coord_ctrl_.RecvFrame(&resp)) {
+  if (!up.RecvFrame(&resp)) {
+    if (tree_.parent > 0) {
+      return LeaderFinUp(tree_.parent,
+                         "leader rank " + std::to_string(cfg_.rank) +
+                             " lost its super-leader rank " +
+                             std::to_string(tree_.parent) + " (recv)",
+                         nullptr);
+    }
     aborted_ = true;
     return LeaderLostCoordinator("lost coordinator (recv)");
   }

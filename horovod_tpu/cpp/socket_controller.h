@@ -173,9 +173,16 @@ class SocketController : public Controller {
   std::string ClusterMetricsJson();
 
   // Coordinator-only: distinct fleet-sketch sources currently stored (the
-  // ctrl soak's tree+sketch arm asserts this equals local children +
-  // remote leaders, proving the tree kept coordinator inbound O(hosts)).
+  // ctrl soak's tree+sketch arm asserts this equals its direct sources —
+  // local children plus aggregate children — proving the tree kept
+  // coordinator inbound O(fanout) at any depth).
   int FleetSourceCountForTest();
+  // Coordinator-only: total negotiation-wait observations in the live
+  // fleet sum (own capture + every stored source).  The in-process soak's
+  // merge oracle: all np threads snapshot the SAME global registry, so the
+  // fleet sum can never exceed np x the registry's own count unless a
+  // subtree sketch was double-merged somewhere up the tree.
+  int64_t FleetSumNegCountForTest();
 
   // Fleet-autopilot policy channel (coordinator only, armed by
   // cfg_.autopilot_port > 0): a driver-facing JSON-lines endpoint serving
@@ -285,23 +292,38 @@ class SocketController : public Controller {
   Status WorkerCycle(std::vector<TensorRequest>& new_requests,
                      std::vector<Response>* out);
 
-  // -- leader-tree control plane (protocol v9) ------------------------------
-  // Two-level tree over the agreed host keys: the first rank of each host
+  // -- leader-tree control plane (protocol v9; n-level since v12) -----------
+  // Tree over the agreed host keys: the first rank of each host
   // (first-appearance order over rank order — the same election
   // MaybeSetupHier uses) is that host's leader.  Children exchange CYCLE /
   // RESPONSES frames with their leader; leaders merge child announcements
-  // into ONE aggregate frame per host toward the coordinator and fan the
-  // coordinator's responses (and abort broadcasts) back down verbatim.
-  // Rank 0 is always both the coordinator and its own host's leader, so its
-  // host's children keep their direct rendezvous ctrl sockets.  The
-  // engagement decision is COORDINATOR-AUTHORITATIVE: it rides the v9
-  // rendezvous book, so divergent HOROVOD_CONTROL_TREE envs cannot split
+  // into ONE aggregate frame per host toward their parent and fan the
+  // responses (and abort broadcasts) back down verbatim.  Protocol v12
+  // generalizes the upper level: when the host-leader count exceeds
+  // HOROVOD_CTRL_TREE_FANOUT, consecutive leaders are clustered under
+  // mid-level "super-leaders" (the lowest rank of each cluster) that merge
+  // their child leaders' [-3] aggregates into one frame upward, recursively,
+  // until the coordinator's fan-in is <= fanout.  Rank 0 is always both the
+  // coordinator and its own host's leader, so its host's children keep
+  // their direct rendezvous ctrl sockets.  The engagement decision AND the
+  // fanout/depth knobs are COORDINATOR-AUTHORITATIVE: they ride the
+  // rendezvous book, so divergent HOROVOD_CONTROL_TREE* envs cannot split
   // the ring.
   struct CtrlTree {
     bool on = false;
     std::vector<int> leaders;      // per-host leader ranks (ascending)
     int my_leader = -1;            // leader of this rank's host
     std::vector<int> my_children;  // leader only: this host's other ranks
+    // v12 adaptive depth.  parent_of maps every non-root LEADER node (host
+    // leaders and super-leaders) to the rank its aggregate flows to (0 =
+    // straight to the coordinator); identical on all ranks, so subtree
+    // membership and ancestor chains are computable anywhere.  Workers'
+    // negotiation parent stays my_leader.
+    std::map<int, int> parent_of;
+    int parent = -1;                // leader only: parent_of[rank]
+    std::vector<int> agg_children;  // downstream leader ranks whose [-3]
+                                    // aggregates THIS node gathers + merges
+    int depth = 2;  // tree levels: coordinator=1, +1 per aggregation layer
   };
   // Engagement rule, pure function of the mode string + agreed host keys
   // (mirrored by runtime.compute_ctrl_tree for the Python-side unit tests):
@@ -309,8 +331,23 @@ class SocketController : public Controller {
   // single-host jobs always demote to the flat plane.
   static bool DecideCtrlTree(const std::string& mode,
                              const std::vector<std::string>& host_keys);
-  // Build tree_ from host_keys_ (after the book agreed) per the decision.
+  // Build tree_ from host_keys_ (after the book agreed) per the decision,
+  // clustering host leaders under super-leaders until every node's fan-in
+  // is <= ctrl_tree_fanout_ (or exactly ctrl_tree_depth_ levels deep when
+  // the override is set).  Pure function of (host_keys_, fanout, depth) so
+  // every rank computes the identical topology.
   void ComputeCtrlTree(bool on);
+  // All ranks whose aggregation path runs through `rank`: the rank itself,
+  // its host's workers when it is a host leader, and recursively every
+  // clustered leader below it.  {rank} for a plain worker.
+  std::vector<int> SubtreeOf(int rank) const;
+  // Coordinator, protocol v12: a departing leader's BYE releases its whole
+  // subtree (v9 released only the leader's host).
+  void DepartSubtree(int rank);
+  // The chain of leader ranks relaying for `rank`, nearest first, stopping
+  // before the coordinator: host leader, then each super-leader above it.
+  // Empty for rank 0 and for direct children of the coordinator's host.
+  std::vector<int> AncestorChain(int rank) const;
   // Establish the child->leader ctrl links: children of non-coordinator
   // hosts dial their leader's data listener with a kCtrlTreePsid HELLO
   // (the mesh pending-stash absorbs arrival skew, like channel HELLOs).
@@ -318,8 +355,9 @@ class SocketController : public Controller {
   bool IsTreeLeader() const {
     return tree_.on && tree_.my_leader == cfg_.rank;
   }
-  // The ctrl socket toward this rank's negotiation parent: the leader link
-  // for a non-host-0 child, the coordinator link otherwise.
+  // The ctrl socket toward this rank's negotiation parent: tree_parent_
+  // when the parent is a non-coordinator node (a non-host-0 child's leader,
+  // or a v12 leader's super-leader), the coordinator link otherwise.
   Socket& UpLink();
   // Leader's link to child `rank` (the coordinator's local children live
   // in ctrl_socks_); null when unknown/closed.
@@ -397,12 +435,21 @@ class SocketController : public Controller {
   std::map<int, Socket> tree_child_socks_;
   // Children that sent a clean BYE (leader-side mirror of departed_ranks_).
   std::set<int> tree_departed_children_;
-  // Non-host-0 child: the ctrl link to this rank's leader.
+  // The ctrl link to this rank's negotiation parent when that parent is not
+  // the coordinator: a non-host-0 child's link to its host leader, or (v12)
+  // a leader's link to its super-leader.
   Socket tree_parent_;
   // HOROVOD_CONTROL_TREE (auto|on|off) and HOROVOD_RENDEZVOUS_ACCEPTORS
   // (ctor reads the env; the coordinator's mode decides for everyone).
   std::string control_tree_mode_ = "auto";
   int rendezvous_acceptors_ = 4;
+  // HOROVOD_CTRL_TREE_FANOUT (default 32, min 2): the per-node fan-in bound
+  // the adaptive-depth pass targets.  HOROVOD_CONTROL_TREE_DEPTH (0 = auto):
+  // force the tree to exactly this many levels (2 = the v9 flat-leader
+  // shape) regardless of the fanout bound.  Both are coordinator-
+  // authoritative — the agreed values ride the v12 rendezvous book.
+  int ctrl_tree_fanout_ = 32;
+  int ctrl_tree_depth_ = 0;
 
   // -- fast-abort propagation (protocol v8) ---------------------------------
   // Coordinator: broadcast ABORT(reason, culprit rank/host) on every live

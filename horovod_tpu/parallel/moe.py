@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.collectives import ensure_varying
+from ..ops.collectives import axis_size, ensure_varying
 
 
 def switch_moe(x, router_kernel, expert_fn: Callable, axis_name: str = "ep",
@@ -37,7 +37,7 @@ def switch_moe(x, router_kernel, expert_fn: Callable, axis_name: str = "ep",
     """
     x = ensure_varying(x, axis_name)
     tokens, d = x.shape
-    n_expert = lax.axis_size(axis_name)
+    n_expert = axis_size(axis_name)
     capacity = int(-(-tokens * capacity_factor // n_expert))  # ceil
 
     logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_kernel)
@@ -90,7 +90,7 @@ def moe_ffn(w_in_local, w_out_local, activation=jax.nn.gelu):
 def load_balancing_loss(x, router_kernel, axis_name: str = "ep"):
     """Switch-transformer auxiliary load-balance loss: E * sum_e f_e * P_e
     (fraction of tokens routed to e times mean router prob of e)."""
-    n_expert = lax.axis_size(axis_name)
+    n_expert = axis_size(axis_name)
     logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router_kernel)
     gates = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(gates, axis=-1)

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.collectives import ensure_varying
+from ..ops.collectives import axis_size, ensure_varying
 
 
 def gpipe(stage_fn: Callable, stage_params, x_microbatches,
@@ -37,7 +37,7 @@ def gpipe(stage_fn: Callable, stage_params, x_microbatches,
     pp rank (zeros elsewhere) — combine with a psum/ppermute or compute the
     loss on the last rank.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = x_microbatches.shape[0]
     mb_shape = x_microbatches.shape[1:]
@@ -87,7 +87,7 @@ def pipeline_stage_params(params_by_stage, axis_name: str = "pp"):
 
 def last_stage_value(x, axis_name: str = "pp"):
     """Broadcast the last pp rank's value to all ranks (one psum)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     contribution = jnp.where(idx == n - 1, x, jnp.zeros_like(x))
     return lax.psum(contribution, axis_name)

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.collectives import axis_size
+
 
 def _chunk_attention(q, k, v, scale, mask):
     """Attention stats for one (q-chunk, kv-chunk) pair.
@@ -66,7 +68,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     Returns [batch, seq_local, heads, head_dim] in q.dtype.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     seq_local = q.shape[1]
     head_dim = q.shape[-1]
@@ -176,7 +178,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     Requires heads % axis_size == 0.  Two all_to_alls instead of a ring —
     cheaper when heads are plentiful and the axis is small.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(f"ulysses needs heads ({q.shape[2]}) divisible by "
                          f"axis size ({n})")

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.collectives import ensure_varying
+from ..ops.collectives import axis_size, ensure_varying
 
 
 def column_parallel_dense(x, kernel_local, bias_local=None,
@@ -91,7 +91,7 @@ def vocab_parallel_embedding(ids, table_local, axis_name: str = "tp"):
 def shard_kernel(kernel, axis_name: str, dim: int):
     """Slice a replicated kernel to this shard's piece along ``dim`` —
     convenience for loading non-TP checkpoints into TP layers."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     if kernel.shape[dim] % n != 0:
         raise ValueError(

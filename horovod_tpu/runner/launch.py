@@ -104,11 +104,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "per trace (HOROVOD_DATA_PLANE)")
     p.add_argument("--control-tree", default=None,
                    choices=["auto", "on", "off"],
-                   help="leader-tree control plane (protocol v9): host "
+                   help="leader-tree control plane (protocol v12): host "
                         "leaders aggregate worker cycle frames so the "
-                        "coordinator handles O(hosts) messages instead of "
+                        "coordinator handles O(fanout) messages instead of "
                         "O(ranks); auto engages on multi-host jobs with "
                         "np >= 8 (HOROVOD_CONTROL_TREE)")
+    p.add_argument("--ctrl-tree-fanout", default=None, type=int,
+                   metavar="N",
+                   help="per-node fan-in bound of the adaptive-depth "
+                        "leader tree (default 32, min 2): when a job spans "
+                        "more hosts than this, mid-level super-leaders are "
+                        "inserted until every node gathers at most N "
+                        "aggregate links (HOROVOD_CTRL_TREE_FANOUT)")
+    p.add_argument("--control-tree-depth", default=None, type=int,
+                   metavar="D",
+                   help="force an exact leader-tree level count instead of "
+                        "the adaptive fanout rule: 2 pins the v9 two-level "
+                        "shape, 3+ always inserts super-leader layers; 0 "
+                        "or unset = adaptive (HOROVOD_CONTROL_TREE_DEPTH)")
     p.add_argument("--postmortem-dir", default=None, metavar="DIR",
                    help="crash-bundle directory: every rank dumps its "
                         "flight-recorder ring there on abort or fatal "
@@ -173,6 +186,8 @@ def _apply_config_file(args: argparse.Namespace,
         "wire_compression": cfg.get("wire-compression"),
         "data_plane": cfg.get("data-plane"),
         "control_tree": cfg.get("control-tree"),
+        "ctrl_tree_fanout": cfg.get("ctrl-tree-fanout"),
+        "control_tree_depth": cfg.get("control-tree-depth"),
     }
     tl = cfg.get("timeline") or {}
     flat["timeline_filename"] = tl.get("filename")
@@ -233,6 +248,10 @@ def _tuning_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HOROVOD_DATA_PLANE"] = args.data_plane
     if args.control_tree:
         env["HOROVOD_CONTROL_TREE"] = args.control_tree
+    if args.ctrl_tree_fanout is not None:
+        env["HOROVOD_CTRL_TREE_FANOUT"] = str(args.ctrl_tree_fanout)
+    if args.control_tree_depth is not None:
+        env["HOROVOD_CONTROL_TREE_DEPTH"] = str(args.control_tree_depth)
     if args.postmortem_dir:
         env["HOROVOD_POSTMORTEM_DIR"] = args.postmortem_dir
     if args.no_flight_recorder:

@@ -51,25 +51,37 @@ log = get_logger()
 # Mirror of kProtocolVersion in cpp/socket_controller.cc — the two MUST move
 # together (tools/hvd_lint.py enforces it).  Exposed so launcher diagnostics
 # and rendezvous error messages can name the wire generation they speak.
-PROTOCOL_VERSION = 11
+PROTOCOL_VERSION = 12
 
 
-def compute_ctrl_tree(host_keys, mode: str = "auto") -> dict:
-    """Pure-Python mirror of the C++ leader-tree topology (protocol v9).
+def compute_ctrl_tree(host_keys, mode: str = "auto", fanout: int = 32,
+                      depth: int = 0) -> dict:
+    """Pure-Python mirror of the C++ leader-tree topology (protocol v12).
 
     Mirrors ``SocketController::DecideCtrlTree`` + ``ComputeCtrlTree``:
     ranks are grouped by host key in first-appearance order over rank
     order, the first rank of each host is its leader, and rank 0 (when
     present) is always both the coordinator and its own host's leader.
+    When the leader count exceeds ``fanout`` (mirror of
+    ``HOROVOD_CTRL_TREE_FANOUT``), leaders are clustered under mid-level
+    super-leaders, adding levels until every node's fan-in is at most
+    ``fanout``; ``depth`` > 0 (mirror of ``HOROVOD_CONTROL_TREE_DEPTH``)
+    forces an exact level count instead.
 
     ``host_keys`` is either a list (index = rank) or a dict
     ``{rank: key}`` — the dict form models re-election over survivors
-    after ranks die (recompute with the dead ranks removed).
+    after ranks die (recompute with the dead ranks removed: the next
+    rank on a dead leader's host is promoted, and a dead super-leader's
+    cluster re-parents to whatever the fresh clustering assigns).
 
     Returns ``{"on": bool, "leaders": [rank...], "leader_of": {rank:
-    leader}, "children_of": {leader: [rank...]}}``.  When the engagement
-    rule demotes to flat (single host; or "auto" with fewer than 8
-    ranks), ``on`` is False and the topology fields are empty.
+    leader}, "children_of": {leader: [rank...]}, "parent_of": {leader:
+    parent-leader}, "agg_children": {leader: [leader...]}, "depth": int}``.
+    ``parent_of`` maps every non-root leader to the node that gathers its
+    aggregate (the coordinator or a super-leader); ``agg_children`` is
+    the inverse adjacency.  When the engagement rule demotes to flat
+    (single host; or "auto" with fewer than 8 ranks), ``on`` is False
+    and the topology fields are empty.
     """
     if mode not in ("auto", "on", "off"):
         raise ValueError(f"mode must be auto|on|off, got {mode!r}")
@@ -78,7 +90,8 @@ def compute_ctrl_tree(host_keys, mode: str = "auto") -> dict:
     else:
         items = list(enumerate(str(k) for k in host_keys))
     n = len(items)
-    off = {"on": False, "leaders": [], "leader_of": {}, "children_of": {}}
+    off = {"on": False, "leaders": [], "leader_of": {}, "children_of": {},
+           "parent_of": {}, "agg_children": {}, "depth": 0}
     if mode == "off" or n == 0:
         return off
     distinct = {k for _, k in items}
@@ -97,8 +110,41 @@ def compute_ctrl_tree(host_keys, mode: str = "auto") -> dict:
     leaders = [g[0] for g in groups]
     leader_of = {r: g[0] for g in groups for r in g}
     children_of = {g[0]: g[1:] for g in groups}
+    # Clustering pass (mirror of the C++ loop, including the balanced
+    # integer split): `top` is the frontier still parented directly by the
+    # root; each pass carves it into ceil(non_root / fanout) clusters and
+    # promotes the first leader of each to a super-leader.
+    fanout = max(2, int(fanout))
+    parent_of: Dict[int, int] = {}
+    top = list(leaders)
+    root = top[0]
+    levels = 1
+    while True:
+        non_root = len(top) - 1
+        grow = (levels < depth - 1 and non_root > 1) if depth > 0 \
+            else non_root > fanout
+        if not grow:
+            break
+        n_clusters = (non_root + fanout - 1) // fanout
+        nxt = [root]
+        for c in range(n_clusters):
+            lo = 1 + c * non_root // n_clusters
+            hi = 1 + (c + 1) * non_root // n_clusters
+            head = top[lo]
+            nxt.append(head)
+            for i in range(lo + 1, hi):
+                parent_of[top[i]] = head
+        top = nxt
+        levels += 1
+    for leader in top[1:]:
+        parent_of[leader] = root
+    agg_children: Dict[int, List[int]] = {}
+    for leader in leaders:
+        if leader in parent_of:
+            agg_children.setdefault(parent_of[leader], []).append(leader)
     return {"on": True, "leaders": leaders, "leader_of": leader_of,
-            "children_of": children_of}
+            "children_of": children_of, "parent_of": parent_of,
+            "agg_children": agg_children, "depth": levels + 1}
 
 
 @dataclasses.dataclass

@@ -40,10 +40,20 @@ IGNORED_VARS = (
 #   HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS  base delay of the exponential
 #                                     rendezvous retry backoff
 #   HOROVOD_CONTROL_TREE              leader-tree control plane (protocol
-#                                     v9): auto (default; engages on multi-
+#                                     v12): auto (default; engages on multi-
 #                                     host jobs with size >= 8) | on | off.
 #                                     Only the coordinator's value matters —
 #                                     its verdict rides the rendezvous book.
+#   HOROVOD_CTRL_TREE_FANOUT          per-node fan-in bound of the adaptive-
+#                                     depth tree (default 32, min 2): jobs
+#                                     spanning more hosts than this insert
+#                                     mid-level super-leaders until every
+#                                     node gathers at most this many
+#                                     aggregate links
+#   HOROVOD_CONTROL_TREE_DEPTH        force an exact tree level count (2 =
+#                                     the v9 two-level shape, 3+ = always
+#                                     insert super-leader layers); 0/unset
+#                                     = adaptive from the fanout rule
 #   HOROVOD_RENDEZVOUS_ACCEPTORS      coordinator-side rendezvous acceptor
 #                                     threads (default 4, clamped to 1..64)
 #                                     draining the worker HELLO herd in

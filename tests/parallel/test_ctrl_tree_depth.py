@@ -1,0 +1,156 @@
+"""Adaptive-depth leader tree end-to-end at np=8 (protocol v12).
+
+Forcing HOROVOD_CONTROL_TREE_DEPTH=3 at np=8 over four fake hosts makes
+rank 2 a *super-leader*: hosts {0,1} {2,3} {4,5} {6,7}, leaders 0/2/4/6,
+and the clustering pass parents leaders 4 and 6 under 2, so the
+coordinator gathers exactly two aggregate links (child 1, super 2) while
+rank 2 merges three subtrees into one frame.  The depth-3 tree must be
+observationally identical to both the flat plane and the v9 depth-2
+shape (results compared by tensor name), and the depth-specific failure
+mode — the *super-leader* dying mid-cycle — must abort every survivor
+within the propagation bound naming rank 2, including the two orphaned
+leaders (4, 6) whose uplinks died with it and their children.
+
+Mirror of tests/parallel/test_ctrl_tree_np8.py, one level deeper.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.runner import run
+
+pytestmark = pytest.mark.slow
+
+ABORT_TIMEOUT_S = 2.0   # the documented default, pinned explicitly below
+BOUND_SLACK_S = 13.0    # failure detection + scheduling on a loaded box
+
+BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HOROVOD_HIER_FAKE_HOSTS": "4",
+    "HOROVOD_SHM_DISABLE": "1",
+    "HOROVOD_ABORT_PROPAGATION_TIMEOUT": str(ABORT_TIMEOUT_S),
+}
+
+
+def _collective_worker():
+    """One deterministic pass over every collective, results keyed by
+    tensor name so runs at different depths compare positionally-
+    independent."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(build_mesh=False)
+    r = hvd.rank()
+    out = {"rank": r, "tensors": {}}
+    for i in range(3):
+        out["tensors"][f"ctd.ar.{i}"] = hvd.allreduce(
+            np.arange(16, dtype=np.float32) * (r + 1) + i,
+            op=hvd.Sum, name=f"ctd.ar.{i}").tolist()
+    out["tensors"]["ctd.ag"] = hvd.allgather(
+        np.full((r + 1, 2), float(r), np.float32), name="ctd.ag").tolist()
+    out["tensors"]["ctd.bc"] = hvd.broadcast(
+        np.full(8, float(r * 10 + 7), np.float32), root_rank=5,
+        name="ctd.bc").tolist()
+    hvd.barrier()
+    out["ctrl"] = hvd.metrics().get("counters", {})
+    hvd.shutdown()
+    return out
+
+
+def test_depth3_vs_depth2_vs_flat_collective_parity():
+    """Every collective result is identical whether frames flow flat,
+    through host leaders (depth 2), or through a super-leader (depth 3) —
+    the aggregate-merge path adds hops, never semantics."""
+    env = dict(BASE_ENV, HOROVOD_METRICS="1")
+    flat = run(_collective_worker, np=8,
+               env=dict(env, HOROVOD_CONTROL_TREE="off"))
+    d2 = run(_collective_worker, np=8,
+             env=dict(env, HOROVOD_CONTROL_TREE="on",
+                      HOROVOD_CONTROL_TREE_DEPTH="2"))
+    d3 = run(_collective_worker, np=8,
+             env=dict(env, HOROVOD_CONTROL_TREE="on",
+                      HOROVOD_CONTROL_TREE_DEPTH="3"))
+    by_rank = [{o["rank"]: o["tensors"] for o in res}
+               for res in (flat, d2, d3)]
+    for m in by_rank:
+        assert sorted(m) == list(range(8))
+    for r in range(8):
+        assert by_rank[0][r] == by_rank[1][r], f"rank {r}: flat vs depth-2"
+        assert by_rank[1][r] == by_rank[2][r], f"rank {r}: depth-2 vs depth-3"
+    # Control traffic flows through the native counters at every depth
+    # (exact msgs/cycle shapes are pinned by the deterministic C++ soak).
+    for res in (flat, d2, d3):
+        coord = next(o for o in res if o["rank"] == 0)
+        assert coord["ctrl"].get("ctrl_msgs_recv", 0) > 0, coord["ctrl"]
+        assert coord["ctrl"].get("ctrl_msgs_sent", 0) > 0, coord["ctrl"]
+
+
+def _collapse_worker(tmpdir: str):
+    """Allreduce until the injected fault collapses the job, then persist
+    what this rank observed (files, not return values: survivors must
+    outlive the launcher's SIGTERM to record their exception)."""
+    import signal
+    import time
+
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    r = int(os.environ.get("HOROVOD_RANK", "-1"))
+    out = {"rank": r, "error": "", "elapsed": -1.0, "iters": 0}
+    t0 = time.monotonic()
+    try:
+        hvd.init(build_mesh=False)
+        for i in range(2000):
+            t0 = time.monotonic()
+            hvd.allreduce(np.full(1024, float(r), np.float32), op=hvd.Sum,
+                          name=f"ctd.chaos.{i % 8}")
+            out["iters"] = i + 1
+    except HorovodInternalError as exc:
+        out["error"] = str(exc)
+        out["elapsed"] = time.monotonic() - t0
+    with open(os.path.join(tmpdir, f"rank{r}.json"), "w") as f:
+        json.dump(out, f)
+    try:
+        hvd.shutdown()
+    except Exception:
+        pass
+    return out
+
+
+def test_super_leader_death_aborts_all_within_bound(tmp_path):
+    """The v12-specific failure mode: the super-leader (rank 2) dies
+    mid-cycle — the super-recv die fires in rank 2's process at its 50th
+    gather of leader 4's aggregate, well into the training loop.  The
+    coordinator's own gather detects the dead aggregate link and
+    broadcasts the abort naming rank 2; the orphaned mid-level leaders
+    (4, 6) and their children must still be released within the bound by
+    draining their retained direct coordinator links."""
+    tmpdir = str(tmp_path)
+    latch = os.path.join(tmpdir, "die.latch")
+    env = dict(BASE_ENV, HOROVOD_CONTROL_TREE="on",
+               HOROVOD_CONTROL_TREE_DEPTH="3",
+               HOROVOD_FAULT_INJECT=f"super-recv:50:4:die:{latch}")
+    with pytest.raises(RuntimeError, match="rank 2"):
+        run(_collapse_worker, args=(tmpdir,), np=8, env=env)
+    assert os.path.exists(latch), "super-recv die never fired"
+    assert not os.path.exists(os.path.join(tmpdir, "rank2.json"))
+    outs = {}
+    for r in (0, 1, 3, 4, 5, 6, 7):
+        path = os.path.join(tmpdir, f"rank{r}.json")
+        assert os.path.exists(path), (r, os.listdir(tmpdir))
+        with open(path) as f:
+            outs[r] = json.load(f)
+    for r, out in outs.items():
+        assert out["error"], out
+        assert "culprit rank 2" in out["error"], out
+        assert 0 <= out["elapsed"] < ABORT_TIMEOUT_S + BOUND_SLACK_S, out
+    # The orphaned subtree specifically: leaders 4 and 6 lost their
+    # uplink the instant their parent died, and their children's frames
+    # died inside the super's unmerged gather — all four must still have
+    # been released by the coordinator's direct broadcast.
+    for orphan in (4, 5, 6, 7):
+        assert outs[orphan]["error"], outs[orphan]

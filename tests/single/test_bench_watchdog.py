@@ -16,13 +16,17 @@ BENCH = os.path.join(os.path.dirname(__file__), "..", "..", "bench.py")
 
 
 def _run_parent(child_script: str, budget: str = "20", probe: str = "5",
-                timeout: float = 60.0, cache_path: str | None = None):
+                timeout: float = 60.0, cache_path: str | None = None,
+                attempts: str = "3", backoff: str = "0.1"):
     import tempfile
 
     env = dict(os.environ)
     env.pop("_HVD_TPU_BENCH_CHILD", None)
     env["_HVD_TPU_BENCH_BUDGET_S"] = budget
     env["_HVD_TPU_BENCH_PROBE_S"] = probe
+    # Near-zero backoff: the retry *count* is under test, not the wait.
+    env["_HVD_TPU_BENCH_ATTEMPTS"] = attempts
+    env["_HVD_TPU_BENCH_BACKOFF_S"] = backoff
     with tempfile.NamedTemporaryFile("w", suffix="_fake_child.py",
                                      delete=False) as f:
         f.write(child_script)
@@ -87,10 +91,10 @@ def test_incremental_lines_last_one_wins():
     assert "note" not in result
 
 
-def test_fast_crash_retries_once():
+def test_fast_crash_retries_with_backoff():
     # Child crashes pre-probe with most of the budget left: the parent
-    # retries exactly once (counted via a marker file), then emits the
-    # value-0 error line.
+    # burns the full bounded-backoff attempt budget (counted via a marker
+    # file), then emits the value-0 error line.
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
@@ -104,7 +108,51 @@ def test_fast_crash_retries_once():
         assert rc == 1
         assert result["value"] == 0.0
         with open(marker) as f:
+            assert len(f.read()) == 3  # initial attempt + two retries
+
+
+def test_tunnel_down_retries_then_reports():
+    # The probe never completes (dead tunnel): each attempt is killed at
+    # the probe deadline and retried with backoff until the attempt budget
+    # is gone; the final line must name the tunnel.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        marker = os.path.join(td, "spawns")
+        rc, result = _run_parent(textwrap.dedent(f"""
+            import time
+            with open({marker!r}, "a") as f:
+                f.write("x")
+            time.sleep(3600)
+        """), budget="400", probe="3", attempts="2", timeout=120.0)
+        assert rc == 1
+        assert result["value"] == 0.0
+        assert "tunnel" in result["error"]
+        with open(marker) as f:
             assert len(f.read()) == 2  # initial attempt + one retry
+
+
+def test_retry_then_success_stamps_retry_count():
+    # First attempt crashes, second succeeds: the live result must carry
+    # the number of retries it took ("retries" provenance).
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        marker = os.path.join(td, "spawns")
+        rc, result = _run_parent(textwrap.dedent(f"""
+            import json, os, sys
+            first = not os.path.exists({marker!r})
+            with open({marker!r}, "a") as f:
+                f.write("x")
+            if first:
+                sys.exit(3)
+            print(json.dumps({{"phase": "probe"}}), flush=True)
+            print(json.dumps({{"metric": "m", "value": 7.0, "unit": "u",
+                              "vs_baseline": 1.0}}), flush=True)
+        """), budget="400", probe="5")
+        assert rc == 0
+        assert result["value"] == 7.0
+        assert result["retries"] == 1
 
 
 def test_post_probe_crash_reports_error_with_tail():

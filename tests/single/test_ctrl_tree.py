@@ -1,13 +1,16 @@
-"""Leader-tree topology unit tests (protocol v9 control plane).
+"""Leader-tree topology unit tests (protocol v12 control plane).
 
 `horovod_tpu.runtime.compute_ctrl_tree` is the pure-Python mirror of the
 C++ `SocketController::DecideCtrlTree` + `ComputeCtrlTree` pair, so these
 tests pin the topology contract both layers must agree on: grouping by
 host key in first-appearance order, first-rank-per-host leaders, the
-engagement rule ("auto" needs a multi-host job with np >= 8), and the
-dict form that models re-election over survivors after a leader dies
+engagement rule ("auto" needs a multi-host job with np >= 8), the v12
+adaptive-depth clustering (leaders exceeding the fanout are grouped
+under mid-level super-leaders until every node's fan-in is bounded), and
+the dict form that models re-election over survivors after a leader dies
 (the PR 5 culprit sweep removes the dead rank; recomputing over the rest
-must promote the next rank on that host).
+must promote the next rank on that host, and a dead super-leader's
+cluster re-parents to the fresh clustering's pick).
 """
 
 import pytest
@@ -21,7 +24,8 @@ def fake_hosts(np_, hosts):
     return [f"fakehost-{r * hosts // np_}" for r in range(np_)]
 
 
-FLAT = {"on": False, "leaders": [], "leader_of": {}, "children_of": {}}
+FLAT = {"on": False, "leaders": [], "leader_of": {}, "children_of": {},
+        "parent_of": {}, "agg_children": {}, "depth": 0}
 
 
 def test_fan_out_16_ranks_4_hosts():
@@ -126,3 +130,78 @@ def test_bad_mode_raises():
 def test_empty_is_flat():
     assert compute_ctrl_tree([]) == FLAT
     assert compute_ctrl_tree({}) == FLAT
+
+
+# --- v12 adaptive depth -----------------------------------------------------
+
+
+def test_small_job_stays_depth_2():
+    # 16 hosts with the default fanout of 32: 15 non-root leaders fit
+    # under the coordinator directly, so no super layer appears.
+    t = compute_ctrl_tree(fake_hosts(256, 16))
+    assert t["depth"] == 2
+    assert t["agg_children"] == {0: [16 * h for h in range(1, 16)]}
+    assert all(p == 0 for p in t["parent_of"].values())
+
+
+def test_pod_1024_grows_a_super_layer():
+    # 64 hosts exceed fanout 32: adaptive depth inserts one super level.
+    # 63 non-root leaders split into two balanced clusters headed by the
+    # first leader of each, and coordinator fan-in drops to 15 + 2 = 17.
+    t = compute_ctrl_tree(fake_hosts(1024, 64))
+    assert t["depth"] == 3
+    assert t["agg_children"][0] == [16, 512]
+    assert t["parent_of"][32] == 16
+    assert t["parent_of"][528] == 512
+    # Every node's aggregate fan-in stays at or below the fanout.
+    for kids in t["agg_children"].values():
+        assert len(kids) <= 32
+    # children_of (workers under their host leader) is depth-independent.
+    assert t["leader_of"][17] == 16
+
+
+def test_forced_depth_overrides_auto():
+    # depth=3 forces a super layer even when 15 leaders would fit flat
+    # under the coordinator; depth=2 pins the v9 shape even at pod scale.
+    t3 = compute_ctrl_tree(fake_hosts(256, 16), depth=3)
+    assert t3["depth"] == 3
+    assert t3["agg_children"][0] == [16]
+    assert t3["agg_children"][16] == [16 * h for h in range(2, 16)]
+    t2 = compute_ctrl_tree(fake_hosts(1024, 64), depth=2)
+    assert t2["depth"] == 2
+    assert len(t2["agg_children"][0]) == 63
+
+
+def test_small_fanout_grows_until_bounded():
+    # fanout=4 over 16 hosts: 15 non-root leaders need two extra levels
+    # before every fan-in is at most 4.
+    t = compute_ctrl_tree(fake_hosts(256, 16), fanout=4)
+    assert t["depth"] >= 3
+    for kids in t["agg_children"].values():
+        assert len(kids) <= 4
+    # Exactly the non-root leaders carry a parent, and walking parents
+    # always terminates at the coordinator.
+    assert set(t["parent_of"]) == set(t["leaders"]) - {0}
+    for leader in t["parent_of"]:
+        hops, node = 0, leader
+        while node != 0:
+            node = t["parent_of"][node]
+            hops += 1
+            assert hops < t["depth"]
+
+
+def test_super_leader_death_reparents_the_cluster():
+    # The first super-leader at pod scale is rank 16.  When it dies, the
+    # culprit sweep removes it; recomputing over survivors must promote
+    # rank 17 to host-1 leader AND hand it the same cluster headship.
+    keys = {r: k for r, k in enumerate(fake_hosts(1024, 64))}
+    before = compute_ctrl_tree(keys)
+    assert before["agg_children"][0] == [16, 512]
+    del keys[16]
+    after = compute_ctrl_tree(keys)
+    assert after["on"] is True
+    assert after["agg_children"][0] == [17, 512]
+    assert after["parent_of"][32] == 17
+    assert after["children_of"][17] == list(range(18, 32))
+    # The other cluster is untouched by the re-election.
+    assert after["agg_children"][512] == before["agg_children"][512]

@@ -92,10 +92,7 @@ def test_gpt_sequence_parallel_matches_dense():
     import dataclasses
 
     from jax.sharding import Mesh, PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # pre-0.5 layout
-        from jax.experimental.shard_map import shard_map
+    from horovod_tpu.ops.collectives import shard_map
 
     from horovod_tpu.models import GPT, GPT_TINY
 

@@ -8,16 +8,23 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # pre-0.5 layout
-    from jax.experimental.shard_map import shard_map
+from horovod_tpu.ops.collectives import shard_map
 
 import horovod_tpu as hvd
 
 pytestmark = pytest.mark.usefixtures("hvd_single")
 
 N_DEV = 8
+
+
+def _vma_tracking_available() -> bool:
+    # jax < 0.6 has no varying-manual-axes tracking (jax.typeof(...).vma);
+    # per-leaf invariance is then invisible to the optimizer, which
+    # documents the fallback as psum-over-all-axes.
+    try:
+        return hasattr(jax.typeof(jnp.zeros(())), "vma")
+    except Exception:
+        return False
 
 
 def test_distributed_optimizer_eager_matches_plain_sgd():
@@ -199,7 +206,7 @@ def test_sharded_optimizer_matches_replicated_trajectory():
 
         return jax.jit(shard_map(
             step_all, mesh=mesh, in_specs=({"x": P(None, "dp")},),
-            out_specs=P()))(data)
+            out_specs=P(), check_vma=False))(data)
 
     data = {"x": jnp.arange(5 * 4 * 7, dtype=jnp.float32).reshape(
         5, 4, 7) * 0.01}
@@ -250,7 +257,7 @@ def test_sharded_optimizer_handles_prereduced_leaves():
             return optax.apply_updates(params, updates)
 
         return jax.jit(shard_map(fn, mesh=mesh, in_specs=P("dp"),
-                                 out_specs=P()))(
+                                 out_specs=P(), check_vma=False))(
             jnp.arange(4 * 4, dtype=jnp.float32).reshape(4, 4))
 
     p_rep = one_step(tx_rep)
@@ -283,7 +290,7 @@ def test_sharded_optimizer_master_weights_bf16():
         return p
 
     p = jax.jit(shard_map(run, mesh=mesh, in_specs=P("dp"),
-                          out_specs=P()))(jnp.zeros(4))
+                          out_specs=P(), check_vma=False))(jnp.zeros(4))
     # 16 steps x 2^-11 = 2^-7 total: one full bf16 ulp below 1.0 at least.
     assert float(np.asarray(p["w"], np.float32)[0]) < 1.0, p
 
@@ -312,7 +319,7 @@ def test_sharded_optimizer_with_cross_rank_clip():
             return optax.apply_updates(params, updates)
 
         return jax.jit(shard_map(fn, mesh=mesh, in_specs=P("dp"),
-                                 out_specs=P()))(
+                                 out_specs=P(), check_vma=False))(
             jnp.arange(4 * 8, dtype=jnp.float32).reshape(4, 8))
 
     p_rep = one_step(tx_rep)
@@ -322,6 +329,10 @@ def test_sharded_optimizer_with_cross_rank_clip():
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.skipif(not _vma_tracking_available(),
+                    reason="needs shard_map vma tracking: without it the "
+                           "clip documents the psum-over-all-axes fallback "
+                           "this test exists to rule out")
 def test_sharded_optimizer_clip_multi_axis_mesh():
     """ADVICE r2: on a multi-axis mesh the sharded chunk is INVARIANT over
     every non-shard axis (already psummed before the reduce-scatter), so
@@ -350,7 +361,7 @@ def test_sharded_optimizer_clip_multi_axis_mesh():
 
         return jax.jit(shard_map(fn, mesh=mesh,
                                  in_specs=P("dp", "sp"),
-                                 out_specs=P()))(
+                                 out_specs=P(), check_vma=False))(
             jnp.arange(2 * 2 * 8, dtype=jnp.float32).reshape(2, 2, 8))
 
     p_rep = one_step(tx_rep)

@@ -8,10 +8,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # pre-0.5 layout
-    from jax.experimental.shard_map import shard_map
+from horovod_tpu.ops.collectives import shard_map
 
 from horovod_tpu.parallel import ring_attention, ulysses_attention
 

@@ -79,8 +79,10 @@ cost from the registry's.
 
 With --np-sweep N,N,... the tool instead sweeps job sizes over fake
 multi-host topologies (4 ranks per fake host) and prints the O(n)-vs-
-O(hosts) table behind the v9 leader tree: coordinator inbound control
-messages and bytes per negotiation cycle, flat vs tree, from the
+O(hosts)-vs-O(fanout) table behind the leader tree: coordinator inbound
+control messages and bytes per negotiation cycle — flat, auto-depth tree
+(v9 shape below 32 hosts), and the tree forced three levels deep
+(HOROVOD_CONTROL_TREE_DEPTH=3, the v12 adaptive-depth plane) — from the
 ctrl_msgs_/ctrl_bytes_ counters normalised by cycle_count.  Results are
 recorded in docs/benchmarks.md.
 """
@@ -449,21 +451,29 @@ def _sweep_worker(steps: int, tensors: int):
 
 
 def run_np_sweep(np_list, steps: int, tensors: int):
-    """Coordinator control messages + bytes per cycle, flat vs tree, at
-    each job size over fake hosts (4 consecutive ranks per host).  The
-    lockstep makes messages/cycle a topology constant — (np-1) flat,
-    (local-1)+(hosts-1) tree — so the per-cycle numbers are exact while
-    bytes/cycle reflect the measured aggregate framing overhead."""
+    """Coordinator control messages + bytes per cycle — flat vs the
+    auto-depth tree vs the tree forced three levels deep — at each job
+    size over fake hosts (4 consecutive ranks per host).  The lockstep
+    makes messages/cycle a topology constant — (np-1) flat,
+    (local-1)+(hosts-1) for the two-level tree, (local-1)+direct-children
+    once a super layer absorbs leader clusters — so the per-cycle numbers
+    are exact while bytes/cycle reflect the measured aggregate framing
+    overhead."""
     from horovod_tpu.runner import run
 
     for np_ in np_list:
         hosts = max(2, np_ // 4)
         row = {"metric": "ctrl_plane_np_sweep", "np": np_, "hosts": hosts}
-        for mode, tree in (("flat", "off"), ("tree", "on")):
+        modes = [("flat", "off", None), ("tree", "on", None)]
+        if hosts >= 3:  # depth 3 needs >= 3 leaders to grow a super layer
+            modes.append(("tree_d3", "on", "3"))
+        for mode, tree, depth in modes:
             env = {"JAX_PLATFORMS": "cpu", "HOROVOD_METRICS": "1",
                    "HOROVOD_SHM_DISABLE": "1",
                    "HOROVOD_HIER_FAKE_HOSTS": str(hosts),
                    "HOROVOD_CONTROL_TREE": tree}
+            if depth is not None:
+                env["HOROVOD_CONTROL_TREE_DEPTH"] = depth
             results = run(_sweep_worker, args=(steps, tensors), np=np_,
                           env=env, stream_prefix=False)
             coord = next(r for r in results if r["rank"] == 0)
@@ -475,6 +485,10 @@ def run_np_sweep(np_list, steps: int, tensors: int):
         row["msgs_ratio"] = round(
             row["flat_msgs_per_cycle"]
             / max(row["tree_msgs_per_cycle"], 1e-9), 2)
+        if "tree_d3_msgs_per_cycle" in row:
+            row["msgs_ratio_d3"] = round(
+                row["flat_msgs_per_cycle"]
+                / max(row["tree_d3_msgs_per_cycle"], 1e-9), 2)
         print(json.dumps(row), flush=True)
 
 
@@ -539,9 +553,10 @@ def main():
                          "best-of-3 (<= 1%% is the acceptance bar)")
     ap.add_argument("--np-sweep", default=None, metavar="N,N,...",
                     help="run ONLY the control-plane scaling sweep: "
-                         "coordinator ctrl messages + bytes per cycle, "
-                         "flat vs v9 leader tree, at each np over fake "
-                         "hosts (4 ranks/host)")
+                         "coordinator ctrl messages + bytes per cycle — "
+                         "flat vs auto-depth tree vs forced depth-3 "
+                         "(v12) — at each np over fake hosts "
+                         "(4 ranks/host)")
     ap.add_argument("--sweep-steps", type=int, default=30)
     args = ap.parse_args()
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)

@@ -102,6 +102,8 @@ NATIVE_READ_VARS = {
     "HOROVOD_RENDEZVOUS_RETRIES",
     "HOROVOD_RENDEZVOUS_BACKOFF_BASE_MS",
     "HOROVOD_CONTROL_TREE",
+    "HOROVOD_CTRL_TREE_FANOUT",
+    "HOROVOD_CONTROL_TREE_DEPTH",
     "HOROVOD_RENDEZVOUS_ACCEPTORS",
     "HOROVOD_FLEET_TELEMETRY",
     "HOROVOD_SENTINEL_ZSCORE",

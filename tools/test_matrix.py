@@ -23,10 +23,13 @@ combination of:
 - metrics: off / on (HOROVOD_METRICS=1) — native-core combos appended to
            the full set; the workload asserts the registry populated
            (cycle occupancy, negotiation-wait histogram) when enabled
-- ctrl_tree: auto (default) / on (HOROVOD_CONTROL_TREE, the v9 leader
-           tree) — "on" combos run over fake hosts since auto stays flat
-           below np=8; one on-combo in the quick set, the rest (plus a
-           single-host demotion row) full only
+- ctrl_tree: auto (default) / on (HOROVOD_CONTROL_TREE, the leader
+           tree) / d3 (tree forced three levels deep via
+           HOROVOD_CONTROL_TREE_DEPTH=3 over three fake hosts, the v12
+           adaptive-depth plane: coordinator <- super-leader <- leader) —
+           "on"/"d3" combos run over fake hosts since auto stays flat
+           below np=8; one on-combo and one d3-combo in the quick set,
+           the rest (plus a single-host demotion row) full only
 - flight:  def (ambient default) / on / off (HOROVOD_FLIGHT_RECORDER) —
            "on" combos assert the black box recorded the workload
            (hvd.flight_record() non-empty, right rank), "off" combos that
@@ -105,7 +108,12 @@ negotiation-wait), the np=4 anomaly-sentinel chaos pytest
 anomaly naming that rank, journaled and flight-recorded strictly before
 the eviction rule can fire), the np=256 control-plane soak (`ctrl-soak`:
 flat vs tree coordinator message counts, plus a migration-noting row),
-and the np=8 tree-vs-flat parity pytest (`ctrl-np8`).
+the np=1024 / 64-fake-host pod-scale soak (`ctrl-soak-1024`: the
+auto-grown three-level v12 tree holds coordinator inbound at O(fanout),
+bucket-exact sketch merges, chaos arms at every tree level), the np=8
+tree-vs-flat parity pytest (`ctrl-np8`), and the np=8 adaptive-depth
+pytest (`ctrl-depth-np8`: flat == depth-2 == depth-3 parity plus the
+super-leader-death abort bound).
 
 Usage:
     python tools/test_matrix.py              # full matrix
@@ -566,8 +574,11 @@ def combos(quick: bool):
         yield ("jax", "native", 3, "on", "off", "tcp0", "none", "off")
         yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off")
         yield ("jax", "native", 3, "on", "off", "hier", "int8", "off")
-        # ctrl_tree axis: the one quick on-combo (2 fake hosts via hier).
+        # ctrl_tree axis: the one quick on-combo (2 fake hosts via hier)
+        # plus the forced depth-3 combo (3 fake hosts; the v12 chain
+        # coordinator <- super <- leader carries every frame).
         yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "on")
+        yield ("jax", "native", 3, "on", "on", "hier", "none", "off", "d3")
         # flight axis: the one quick recorder-on combo.
         yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
                "on")
@@ -636,6 +647,11 @@ def combos(quick: bool):
     yield ("jax", "native", 3, "on", "on", "hier", "bf16", "off", "on")
     yield ("jax", "native", 3, "on", "on", "tcp", "none", "off", "on")
     yield ("torch", "native", 3, "on", "on", "hier", "none", "off", "on")
+    # Adaptive-depth (v12) rows: the forced depth-3 chain with metrics on
+    # (telemetry sketches relayed through the super-leader) and with
+    # caching/fusion off (every cycle renegotiates through two hops).
+    yield ("jax", "native", 3, "on", "on", "hier", "none", "on", "d3")
+    yield ("jax", "native", 3, "off", "off", "hier", "none", "off", "d3")
     # Flight-recorder axis: explicit on (black box populated) across plane
     # shapes including the v9 tree, and explicit off (flight_record == {}).
     yield ("jax", "native", 3, "on", "on", "shm", "none", "off", "auto",
@@ -835,11 +851,28 @@ def checks(quick: bool):
            [["make", "ctrl_soak_selftest"],
             [os.path.join(CPP_DIR, "ctrl_soak_selftest")]],
            CPP_DIR, 600.0)
+    # np=1024 / 64-fake-host pod-scale soak (v12): the auto-grown
+    # three-level tree cuts coordinator inbound to O(fanout) (17 msgs per
+    # cycle vs 1023 flat), bucket-exact sketch merges, and the chaos arms
+    # (super-leader death, mid-level leader death, adaptive-depth site)
+    # abort within the bound naming the right culprit.
+    yield ("ctrl-soak-1024",
+           [["make", "ctrl_soak_selftest"],
+            ["env", "CTRL_SOAK_NP=1024", "CTRL_SOAK_HOSTS=64",
+             os.path.join(CPP_DIR, "ctrl_soak_selftest")]],
+           CPP_DIR, 600.0)
     # np=8 fake-host end-to-end: tree-vs-flat collective/attribution
     # parity and leader-death abort bounds.
     yield ("ctrl-np8",
            [[sys.executable, "-m", "pytest", "-q",
              os.path.join("tests", "parallel", "test_ctrl_tree_np8.py")]],
+           REPO, 600.0)
+    # np=8 adaptive-depth end-to-end: flat == depth-2 == depth-3 parity
+    # and the super-leader-death abort bound (v12).
+    yield ("ctrl-depth-np8",
+           [[sys.executable, "-m", "pytest", "-q",
+             os.path.join("tests", "parallel",
+                          "test_ctrl_tree_depth.py")]],
            REPO, 600.0)
 
 
@@ -881,8 +914,12 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
     # An ambient fault-injection spec would sabotage every workload combo
     # (that's its job); faults belong to the dedicated check rows only.
     env.pop("HOROVOD_FAULT_INJECT", None)
-    # The ctrl_tree axis owns the control-plane topology knob.
+    # The ctrl_tree axis owns the control-plane topology knobs (v12:
+    # depth/fanout shape the tree, so ambient values would change every
+    # combo's frame routing).
     env.pop("HOROVOD_CONTROL_TREE", None)
+    env.pop("HOROVOD_CONTROL_TREE_DEPTH", None)
+    env.pop("HOROVOD_CTRL_TREE_FANOUT", None)
     # The flight axis owns the recorder knobs; an ambient postmortem dir
     # would scatter crash bundles on every combo failure.
     env.pop("HOROVOD_FLIGHT_RECORDER", None)
@@ -952,7 +989,13 @@ def run_combo(core: str, np_: int, fusion: str, cache: str,
             (1 << 30) if qdev == "demote" else 4096)
     if metrics == "on":
         env["HOROVOD_METRICS"] = "1"
-    if tree != "auto":
+    if tree == "d3":
+        # Forced three-level tree needs >= 3 leaders: three single-rank
+        # fake hosts give the chain coordinator <- super <- leaf leader.
+        env["HOROVOD_CONTROL_TREE"] = "on"
+        env["HOROVOD_CONTROL_TREE_DEPTH"] = "3"
+        env["HOROVOD_HIER_FAKE_HOSTS"] = "3"
+    elif tree != "auto":
         env["HOROVOD_CONTROL_TREE"] = tree
     if flight == "on":
         env["HOROVOD_FLIGHT_RECORDER"] = "1"
